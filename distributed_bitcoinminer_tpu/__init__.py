@@ -1,0 +1,22 @@
+"""distributed_bitcoinminer_tpu — a TPU-native distributed hash-search framework.
+
+A ground-up rebuild of the capabilities of the CMU 15-440 P1 reference system
+(`alexsun705/distributed_bitcoinMiner`): a reliable UDP transport ("LSP"), a
+fault-injecting simulated network, and a three-role distributed arg-min
+hash-search application (scheduler / miner / client) — with the compute plane
+redesigned TPU-first (JAX / XLA / Pallas / shard_map over a device Mesh).
+
+Two planes:
+
+- **Control plane** (``lsp``, ``lspnet``, ``apps``): Python asyncio actors
+  speaking a wire format byte-compatible with the Go reference
+  (JSON-encoded LSP messages over UDP), so stock reference harnesses remain
+  valid counterparties.
+- **Compute plane** (``ops``, ``parallel``, ``models``): a jitted,
+  mesh-sharded, Pallas-backed SHA-256 arg-min search program. The nonce range
+  is the "sequence" axis: blockwise chunks within a core (Pallas grid),
+  lane-vectorized hashing within a block, mesh-sharded ranges across cores
+  with an on-device lexicographic-min collective.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""Application layer: hash-search wire protocol + the compute op oracle.
+
+Wire-compatible with the reference ``bitcoin`` package
+(/root/reference/p1/src/github.com/cmu440/bitcoin).
+"""
+
+from .message import Message, MsgType, new_join, new_request, new_result
+from .hash import hash_op, MAX_U64
+
+__all__ = ["Message", "MsgType", "new_join", "new_request", "new_result",
+           "hash_op", "MAX_U64"]

@@ -1,0 +1,33 @@
+"""The compute op, host oracle tier.
+
+``hash_op(msg, nonce)`` = big-endian uint64 of the first 8 bytes of
+``sha256(f"{msg} {nonce}")`` with the nonce rendered as ASCII decimal
+(ref: bitcoin/hash.go:13-17). This is the bit-exactness oracle for the JAX and
+Pallas tiers in ``ops/``; the device kernels must agree with it on every nonce.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+MAX_U64 = (1 << 64) - 1
+
+
+def hash_op(msg: str, nonce: int) -> int:
+    digest = hashlib.sha256(f"{msg} {nonce}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def scan_min(msg: str, lower: int, upper: int) -> tuple[int, int]:
+    """CPU-oracle arg-min scan over the inclusive range [lower, upper].
+
+    Mirrors the reference miner's hot loop (ref: bitcoin/miner/miner.go:52-59):
+    strict ``<`` comparison, so the earliest nonce wins ties.
+    """
+    best_hash = MAX_U64
+    best_nonce = lower
+    for n in range(lower, upper + 1):
+        h = hash_op(msg, n)
+        if h < best_hash:
+            best_hash, best_nonce = h, n
+    return best_hash, best_nonce

@@ -1,0 +1,76 @@
+"""Join / Request / Result application messages.
+
+JSON layout matches Go ``encoding/json`` of the reference struct
+(ref: bitcoin/message.go:18-49): all fields always present, in struct order,
+``Lower``/``Upper``/``Hash``/``Nonce`` are uint64 numbers.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass
+
+
+def _go_json_string(s: str) -> str:
+    """Encode a string exactly like Go ``encoding/json`` (HTML-escaping on,
+    non-ASCII emitted as raw UTF-8, U+2028/U+2029 escaped)."""
+    out = json.dumps(s, ensure_ascii=False)
+    out = out.replace("<", "\\u003c").replace(">", "\\u003e").replace("&", "\\u0026")
+    out = out.replace("\u2028", "\\u2028").replace("\u2029", "\\u2029")
+    return out
+
+
+class MsgType(enum.IntEnum):
+    JOIN = 0     # miner -> server: register for work
+    REQUEST = 1  # client -> server and server -> miner: search [lower, upper]
+    RESULT = 2   # miner -> server and server -> client: (min hash, argmin nonce)
+
+
+@dataclass
+class Message:
+    type: MsgType = MsgType.JOIN
+    data: str = ""
+    lower: int = 0
+    upper: int = 0
+    hash: int = 0
+    nonce: int = 0
+
+    def to_json(self) -> bytes:
+        return (
+            '{"Type":%d,"Data":%s,"Lower":%d,"Upper":%d,"Hash":%d,"Nonce":%d}'
+            % (int(self.type), _go_json_string(self.data), self.lower, self.upper,
+               self.hash, self.nonce)
+        ).encode("utf-8")
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Message":
+        obj = json.loads(raw)
+        return cls(
+            type=MsgType(obj.get("Type", 0)),
+            data=obj.get("Data", ""),
+            lower=int(obj.get("Lower", 0)),
+            upper=int(obj.get("Upper", 0)),
+            hash=int(obj.get("Hash", 0)),
+            nonce=int(obj.get("Nonce", 0)),
+        )
+
+    def __str__(self) -> str:
+        # Same pretty-print as the reference (ref: bitcoin/message.go:52-62).
+        if self.type == MsgType.REQUEST:
+            return f"[Request {self.data} {self.lower} {self.upper}]"
+        if self.type == MsgType.RESULT:
+            return f"[Result {self.hash} {self.nonce}]"
+        return "[Join]"
+
+
+def new_join() -> Message:
+    return Message(type=MsgType.JOIN)
+
+
+def new_request(data: str, lower: int, upper: int) -> Message:
+    return Message(type=MsgType.REQUEST, data=data, lower=lower, upper=upper)
+
+
+def new_result(hash_value: int, nonce: int) -> Message:
+    return Message(type=MsgType.RESULT, hash=hash_value, nonce=nonce)

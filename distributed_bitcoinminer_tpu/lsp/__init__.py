@@ -1,0 +1,21 @@
+"""LSP — Live Sequence Protocol: reliable, in-order, exactly-once transport over UDP.
+
+Provides the same guarantees as the reference Go implementation
+(/root/reference/p1/src/github.com/cmu440/lsp): sliding-window flow control,
+per-message exponential-backoff retransmission, epoch heartbeats,
+connection-loss detection, integrity checksums, and graceful close.
+"""
+
+from .message import Message, MsgType, new_connect, new_data, new_ack
+from .checksum import int2checksum, bytearray2checksum, make_checksum
+from .params import Params
+from .client import Client, new_client
+from .server import Server, new_server
+from .errors import LspError, ConnectionLost, ConnectionClosed, ConnectTimeout
+
+__all__ = [
+    "Message", "MsgType", "new_connect", "new_data", "new_ack",
+    "int2checksum", "bytearray2checksum", "make_checksum",
+    "Params", "Client", "new_client", "Server", "new_server",
+    "LspError", "ConnectionLost", "ConnectionClosed", "ConnectTimeout",
+]

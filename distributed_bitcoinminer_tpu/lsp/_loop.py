@@ -1,0 +1,32 @@
+"""Shared background event loop for the synchronous (Go-style) API facade.
+
+All LSP endpoints created through the sync API run on one daemon-thread
+asyncio loop; blocking calls bridge in with ``run_coroutine_threadsafe``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Awaitable, TypeVar
+
+T = TypeVar("T")
+
+_lock = threading.Lock()
+_loop: asyncio.AbstractEventLoop | None = None
+
+
+def get_loop() -> asyncio.AbstractEventLoop:
+    global _loop
+    with _lock:
+        if _loop is None or _loop.is_closed():
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(target=loop.run_forever,
+                                      name="lsp-event-loop", daemon=True)
+            thread.start()
+            _loop = loop
+        return _loop
+
+
+def run_sync(coro: Awaitable[T], timeout: float | None = None) -> T:
+    return asyncio.run_coroutine_threadsafe(coro, get_loop()).result(timeout)

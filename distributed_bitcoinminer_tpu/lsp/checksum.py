@@ -1,0 +1,38 @@
+"""LSP integrity checksum.
+
+Semantics match the reference bit-for-bit (ref: lsp/checksum.go:10-48 and the
+fold in lsp/client_impl.go:183-198): sum 16-bit little-endian halves of the
+header integers and of the payload (odd tail zero-padded) into a 32-bit
+accumulator, then fold carries down to 16 bits.
+"""
+
+from __future__ import annotations
+
+_U32 = 0xFFFFFFFF
+
+
+def int2checksum(value: int) -> int:
+    """32-bit partial sum for one header integer (two LE 16-bit halves)."""
+    v = value & _U32
+    return (v & 0xFFFF) + (v >> 16)
+
+
+def bytearray2checksum(value: bytes) -> int:
+    """32-bit partial sum over LE 16-bit chunks; odd trailing byte zero-padded."""
+    total = 0
+    n = len(value)
+    even = n - (n % 2)
+    for i in range(0, even, 2):
+        total += value[i] | (value[i + 1] << 8)
+    if n % 2:
+        total += value[-1]
+    return total & _U32
+
+
+def make_checksum(conn_id: int, seq_num: int, size: int, payload: bytes) -> int:
+    """Fold the four partial sums into the final 16-bit wire checksum."""
+    total = (int2checksum(conn_id) + int2checksum(seq_num)
+             + int2checksum(size) + bytearray2checksum(payload)) & _U32
+    while total > 0xFFFF:
+        total = (total >> 16) + (total & 0xFFFF)
+    return total

@@ -1,0 +1,146 @@
+"""LSP client endpoint: async engine + Go-style blocking facade.
+
+Same four-method surface as the reference ``Client`` interface
+(ref: lsp/client_api.go:6-30): ``conn_id``, blocking ``read``, non-blocking
+``write``, flushing ``close``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Union
+
+from .. import lspnet
+from ._engine import Conn, ConnState, integrity_check
+from ._loop import run_sync
+from .errors import ConnectionClosed, LspError
+from .message import Message, MsgType, new_connect
+from .params import Params
+
+
+class AsyncClient:
+    """Asyncio-native LSP client. Create via :func:`new_async_client`."""
+
+    def __init__(self) -> None:
+        self._ep: Optional[lspnet.UDPEndpoint] = None
+        self._conn: Optional[Conn] = None
+        self._read_queue: asyncio.Queue[Union[bytes, Exception]] = asyncio.Queue()
+        self._recv_task: Optional[asyncio.Task] = None
+
+    async def _connect(self, host: str, port: int, params: Params) -> None:
+        self._ep = await lspnet.dial_udp(host, port)
+        self._conn = Conn(
+            params=params,
+            conn_id=0,
+            send_raw=lambda raw: self._ep.send(raw),
+            deliver=self._read_queue.put_nowait,
+            broken=self._read_queue.put_nowait,
+            connect_msg=new_connect(),
+        )
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+        self._recv_task.add_done_callback(self._recv_done)
+        try:
+            await self._conn.connected
+        except LspError:
+            await self._teardown()
+            raise
+
+    def _recv_done(self, task: asyncio.Task) -> None:
+        # A crashed receive loop must not leave the endpoint silently deaf.
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self._read_queue.put_nowait(
+                ConnectionClosed(f"receive loop crashed: {exc!r}"))
+
+    async def _recv_loop(self) -> None:
+        while True:
+            item = await self._ep.recv()
+            if item is None:
+                return
+            raw, _addr = item
+            try:
+                msg = Message.from_json(raw)
+            except ValueError:
+                continue
+            if not integrity_check(msg):
+                continue
+            if msg.type == MsgType.CONNECT:
+                continue  # clients never accept connects
+            self._conn.on_message(msg)
+
+    # ------------------------------------------------------------ public API
+
+    def conn_id(self) -> int:
+        return self._conn.conn_id if self._conn else 0
+
+    async def read(self) -> bytes:
+        item = await self._read_queue.get()
+        if isinstance(item, Exception):
+            # Leave the error visible for any other pending readers.
+            self._read_queue.put_nowait(item)
+            raise item
+        return item
+
+    def write(self, payload: bytes) -> None:
+        self._conn.write(payload)
+
+    async def close(self) -> None:
+        if self._conn is None:
+            return
+        self._conn.begin_close()
+        await self._conn.closed_event.wait()
+        await self._teardown()
+        self._read_queue.put_nowait(ConnectionClosed("client closed"))
+
+    async def _teardown(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except asyncio.CancelledError:
+                pass
+            self._recv_task = None
+        if self._conn is not None:
+            self._conn.abort()
+        if self._ep is not None:
+            self._ep.close()
+
+    @property
+    def state(self) -> ConnState:
+        return self._conn.state if self._conn else ConnState.CLOSED
+
+
+async def new_async_client(hostport: str, params: Optional[Params] = None) -> AsyncClient:
+    """Connect to an LSP server; raises ConnectTimeout after EpochLimit epochs."""
+    host, _, port = hostport.rpartition(":")
+    client = AsyncClient()
+    await client._connect(host or "127.0.0.1", int(port), params or Params())
+    return client
+
+
+class Client:
+    """Blocking facade over :class:`AsyncClient` (Go-style surface)."""
+
+    def __init__(self, inner: AsyncClient):
+        self._inner = inner
+
+    def conn_id(self) -> int:
+        return self._inner.conn_id()
+
+    def read(self) -> bytes:
+        return run_sync(self._inner.read())
+
+    def write(self, payload: bytes) -> None:
+        run_sync(self._write_async(payload))
+
+    async def _write_async(self, payload: bytes) -> None:
+        self._inner.write(payload)
+
+    def close(self) -> None:
+        run_sync(self._inner.close())
+
+
+def new_client(hostport: str, params: Optional[Params] = None) -> Client:
+    return Client(run_sync(new_async_client(hostport, params)))

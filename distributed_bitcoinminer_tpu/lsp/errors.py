@@ -1,0 +1,22 @@
+"""LSP error taxonomy.
+
+The reference returns plain Go errors; here each failure mode is a distinct
+exception so applications can branch on cause. The sync facades convert these
+to (value, error) pairs where a Go-like surface is needed.
+"""
+
+
+class LspError(Exception):
+    """Base class for all LSP failures."""
+
+
+class ConnectTimeout(LspError):
+    """Connect handshake received no Ack within EpochLimit epochs."""
+
+
+class ConnectionLost(LspError):
+    """EpochLimit epochs passed with no traffic from the peer."""
+
+
+class ConnectionClosed(LspError):
+    """The local endpoint was explicitly closed."""

@@ -1,0 +1,93 @@
+"""LSP wire message: type, connection id, sequence number, size, checksum, payload.
+
+Wire format is Go ``encoding/json`` of the reference's ``Message`` struct
+(ref: lsp/message.go:11-55): all six fields always present, ``Payload`` is
+standard-base64 (or ``null`` when absent). Field order in the emitted JSON
+matches Go's struct order so captured goldens compare byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import base64
+import enum
+import json
+from dataclasses import dataclass, field
+
+
+class MsgType(enum.IntEnum):
+    CONNECT = 0  # sent by clients to establish a connection
+    DATA = 1     # sent by either side to transfer a payload
+    ACK = 2      # acknowledges a connect or data message; seq 0 = heartbeat
+
+
+@dataclass
+class Message:
+    type: MsgType = MsgType.CONNECT
+    conn_id: int = 0
+    seq_num: int = 0
+    size: int = 0
+    checksum: int = 0
+    payload: bytes | None = field(default=None)
+
+    def to_json(self) -> bytes:
+        """Marshal exactly like Go ``json.Marshal(&Message{...})``."""
+        if self.payload is None:
+            p = "null"
+        else:
+            p = '"' + base64.b64encode(self.payload).decode("ascii") + '"'
+        return (
+            '{"Type":%d,"ConnID":%d,"SeqNum":%d,"Size":%d,"Checksum":%d,"Payload":%s}'
+            % (int(self.type), self.conn_id, self.seq_num, self.size,
+               self.checksum, p)
+        ).encode("ascii")
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Message":
+        """Unmarshal; raises ValueError on malformed input (caller drops packet)."""
+        try:
+            obj = json.loads(data)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bad LSP message: {e}") from e
+        if not isinstance(obj, dict):
+            raise ValueError("bad LSP message: not an object")
+        raw_payload = obj.get("Payload")
+        payload = None
+        if raw_payload is not None:
+            try:
+                payload = base64.b64decode(raw_payload, validate=True)
+            except Exception as e:  # noqa: BLE001 — any decode failure is a bad packet
+                raise ValueError(f"bad LSP payload: {e}") from e
+        try:
+            return cls(
+                type=MsgType(obj.get("Type", 0)),
+                conn_id=int(obj.get("ConnID", 0)),
+                seq_num=int(obj.get("SeqNum", 0)),
+                size=int(obj.get("Size", 0)),
+                checksum=int(obj.get("Checksum", 0)),
+                payload=payload,
+            )
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"bad LSP message fields: {e}") from e
+
+    def __str__(self) -> str:
+        # Same pretty-print shape as the reference (ref: lsp/message.go:58-74).
+        if self.type == MsgType.CONNECT:
+            return f"[Connect {self.conn_id} {self.seq_num}]"
+        if self.type == MsgType.DATA:
+            body = self.payload.decode("utf-8", "replace") if self.payload else ""
+            return f"[Data {self.conn_id} {self.seq_num} {self.checksum} {body}]"
+        return f"[Ack {self.conn_id} {self.seq_num}]"
+
+
+def new_connect() -> Message:
+    return Message(type=MsgType.CONNECT)
+
+
+def new_data(conn_id: int, seq_num: int, size: int, payload: bytes,
+             checksum: int) -> Message:
+    return Message(type=MsgType.DATA, conn_id=conn_id, seq_num=seq_num,
+                   size=size, checksum=checksum, payload=payload)
+
+
+def new_ack(conn_id: int, seq_num: int) -> Message:
+    return Message(type=MsgType.ACK, conn_id=conn_id, seq_num=seq_num)

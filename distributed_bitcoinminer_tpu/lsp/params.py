@@ -1,0 +1,27 @@
+"""LSP endpoint configuration (ref: lsp/params.go:8-42)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DEFAULT_EPOCH_LIMIT = 5
+DEFAULT_EPOCH_MILLIS = 2000
+DEFAULT_WINDOW_SIZE = 1
+DEFAULT_MAX_BACKOFF_INTERVAL = 0
+
+
+@dataclass
+class Params:
+    # Epochs that may pass with no inbound traffic before the connection is lost.
+    epoch_limit: int = DEFAULT_EPOCH_LIMIT
+    # Milliseconds between epoch ticks.
+    epoch_millis: int = DEFAULT_EPOCH_MILLIS
+    # Max unacknowledged data messages outstanding at once.
+    window_size: int = DEFAULT_WINDOW_SIZE
+    # Cap on the gap (in epochs) between two retransmissions of one message.
+    max_backoff_interval: int = DEFAULT_MAX_BACKOFF_INTERVAL
+
+    def __str__(self) -> str:
+        return (f"[EpochLimit: {self.epoch_limit}, EpochMillis: {self.epoch_millis}, "
+                f"WindowSize: {self.window_size}, "
+                f"MaxBackOffInterval: {self.max_backoff_interval}]")

@@ -1,0 +1,214 @@
+"""LSP server endpoint: async engine + Go-style blocking facade.
+
+Same surface as the reference ``Server`` interface (ref: lsp/server_api.go:
+6-39): blocking ``read`` (any client), non-blocking ``write(conn_id)``,
+non-blocking ``close_conn``, blocking flushing ``close``. One asyncio loop
+owns every connection's state — the multi-connection analog of the
+reference's mainRoutine/clientMain goroutine pair (ref: lsp/server_impl.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple, Union
+
+from .. import lspnet
+from ._engine import Conn, ConnState, integrity_check
+from ._loop import run_sync
+from .errors import ConnectionClosed, LspError
+from .message import Message, MsgType, new_ack
+from .params import Params
+
+ReadItem = Tuple[int, Union[bytes, Exception]]
+
+
+class AsyncServer:
+    """Asyncio-native LSP server. Create via :func:`new_async_server`."""
+
+    def __init__(self, params: Params):
+        self._params = params
+        self._ep: Optional[lspnet.UDPEndpoint] = None
+        self._conns: dict[int, Conn] = {}
+        self._addr_map: dict[tuple, int] = {}
+        self._conn_addr: dict[int, tuple] = {}
+        self._next_conn_id = 1
+        self._read_queue: asyncio.Queue[Union[ReadItem, Exception]] = asyncio.Queue()
+        self._recv_task: Optional[asyncio.Task] = None
+        self._reaper_tasks: set[asyncio.Task] = set()
+        self._closed = False
+
+    async def _start(self, port: int, host: str = "127.0.0.1") -> None:
+        self._ep = await lspnet.listen_udp(host, port)
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+        self._recv_task.add_done_callback(self._recv_done)
+
+    def _recv_done(self, task: asyncio.Task) -> None:
+        # A crashed receive loop must not leave the endpoint silently deaf.
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self._read_queue.put_nowait(
+                ConnectionClosed(f"receive loop crashed: {exc!r}"))
+
+    @property
+    def port(self) -> int:
+        return self._ep.sockname[1]
+
+    # -------------------------------------------------------------- receive
+
+    async def _recv_loop(self) -> None:
+        while True:
+            item = await self._ep.recv()
+            if item is None:
+                return
+            raw, addr = item
+            try:
+                msg = Message.from_json(raw)
+            except ValueError:
+                continue
+            if not integrity_check(msg):
+                continue
+            if msg.type == MsgType.CONNECT:
+                self._on_connect(addr)
+                continue
+            conn = self._conns.get(msg.conn_id)
+            if conn is not None:
+                conn.on_message(msg)
+
+    def _on_connect(self, addr: tuple) -> None:
+        if self._closed:
+            return
+        existing = self._addr_map.get(addr)
+        if existing is not None:
+            # Repeat Connect (our ack was lost): re-ack with the same id
+            # (ref: lsp/server_impl.go searchClient dedup, :327-332).
+            self._ep.send(new_ack(existing, 0).to_json(), addr)
+            return
+        conn_id = self._next_conn_id
+        self._next_conn_id += 1
+        conn = Conn(
+            params=self._params,
+            conn_id=conn_id,
+            send_raw=lambda raw, a=addr: self._ep.send(raw, a),
+            deliver=lambda payload, cid=conn_id: self._read_queue.put_nowait(
+                (cid, payload)),
+            broken=lambda exc, cid=conn_id: self._on_broken(cid, exc),
+        )
+        self._conns[conn_id] = conn
+        self._addr_map[addr] = conn_id
+        self._conn_addr[conn_id] = addr
+        self._ep.send(new_ack(conn_id, 0).to_json(), addr)
+
+    def _on_broken(self, conn_id: int, exc: Exception) -> None:
+        self._read_queue.put_nowait((conn_id, exc))
+        self._remove(conn_id)
+
+    def _remove(self, conn_id: int) -> None:
+        self._conns.pop(conn_id, None)
+        addr = self._conn_addr.pop(conn_id, None)
+        if addr is not None:
+            self._addr_map.pop(addr, None)
+
+    # ------------------------------------------------------------ public API
+
+    async def read(self) -> ReadItem:
+        """Next in-order (conn_id, payload); (conn_id, exc) when a conn died.
+
+        Raises ConnectionClosed once the server itself has been closed.
+        """
+        item = await self._read_queue.get()
+        if isinstance(item, Exception):
+            self._read_queue.put_nowait(item)
+            raise item
+        return item
+
+    def write(self, conn_id: int, payload: bytes) -> None:
+        conn = self._conns.get(conn_id)
+        if conn is None or conn.state not in (ConnState.UP,):
+            raise ConnectionClosed(f"conn {conn_id} does not exist or is closed")
+        conn.write(payload)
+
+    def close_conn(self, conn_id: int) -> None:
+        """Non-blocking graceful close of one connection."""
+        conn = self._conns.get(conn_id)
+        if conn is None:
+            raise ConnectionClosed(f"conn {conn_id} does not exist")
+        conn.begin_close()
+        task = asyncio.get_running_loop().create_task(self._reap(conn_id, conn))
+        self._reaper_tasks.add(task)
+        task.add_done_callback(self._reaper_tasks.discard)
+
+    async def _reap(self, conn_id: int, conn: Conn) -> None:
+        await conn.closed_event.wait()
+        self._remove(conn_id)
+
+    async def close(self) -> None:
+        """Flush and close every connection, then tear down the socket."""
+        if self._closed:
+            return
+        self._closed = True
+        conns = list(self._conns.values())
+        for conn in conns:
+            conn.begin_close()
+        if conns:
+            await asyncio.gather(*(c.closed_event.wait() for c in conns))
+        for task in list(self._reaper_tasks):
+            task.cancel()
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except asyncio.CancelledError:
+                pass
+            self._recv_task = None
+        for conn in list(self._conns.values()):
+            conn.abort()
+        self._conns.clear()
+        self._addr_map.clear()
+        self._conn_addr.clear()
+        if self._ep is not None:
+            self._ep.close()
+        self._read_queue.put_nowait(ConnectionClosed("server closed"))
+
+    def conn_state(self, conn_id: int) -> Optional[ConnState]:
+        conn = self._conns.get(conn_id)
+        return conn.state if conn else None
+
+
+async def new_async_server(port: int, params: Optional[Params] = None,
+                           host: str = "127.0.0.1") -> AsyncServer:
+    server = AsyncServer(params or Params())
+    await server._start(port, host)
+    return server
+
+
+class Server:
+    """Blocking facade over :class:`AsyncServer` (Go-style surface)."""
+
+    def __init__(self, inner: AsyncServer):
+        self._inner = inner
+
+    @property
+    def port(self) -> int:
+        return self._inner.port
+
+    def read(self) -> ReadItem:
+        return run_sync(self._inner.read())
+
+    def write(self, conn_id: int, payload: bytes) -> None:
+        run_sync(self._call(self._inner.write, conn_id, payload))
+
+    def close_conn(self, conn_id: int) -> None:
+        run_sync(self._call(self._inner.close_conn, conn_id))
+
+    def close(self) -> None:
+        run_sync(self._inner.close())
+
+    @staticmethod
+    async def _call(fn, *args):
+        return fn(*args)
+
+
+def new_server(port: int, params: Optional[Params] = None) -> Server:
+    return Server(run_sync(new_async_server(port, params)))

@@ -1,0 +1,31 @@
+"""Simulated network: UDP endpoints with test-controlled fault injection.
+
+The framework's equivalent of the reference ``lspnet`` package
+(/root/reference/p1/src/github.com/cmu440/lspnet): every LSP endpoint sends
+and receives through this layer, and tests inject faults — per-side read/write
+drops, fixed 500 ms delays, payload shortening/lengthening, first-byte
+corruption — plus a packet sniffer that counts sent/dropped Data and Ack
+packets. All "multi-node" testing runs real localhost UDP through these knobs.
+"""
+
+from .faults import (
+    set_read_drop_percent, set_write_drop_percent,
+    set_client_read_drop_percent, set_client_write_drop_percent,
+    set_server_read_drop_percent, set_server_write_drop_percent,
+    set_msg_shortening_percent, set_msg_lengthening_percent,
+    set_msg_corrupted, set_delay_message_percent,
+    reset_drop_percent, reset_all_faults, enable_debug_logs,
+)
+from .sniff import start_sniff, stop_sniff, SniffResult
+from .net import UDPEndpoint, listen_udp, dial_udp
+
+__all__ = [
+    "set_read_drop_percent", "set_write_drop_percent",
+    "set_client_read_drop_percent", "set_client_write_drop_percent",
+    "set_server_read_drop_percent", "set_server_write_drop_percent",
+    "set_msg_shortening_percent", "set_msg_lengthening_percent",
+    "set_msg_corrupted", "set_delay_message_percent",
+    "reset_drop_percent", "reset_all_faults", "enable_debug_logs",
+    "start_sniff", "stop_sniff", "SniffResult",
+    "UDPEndpoint", "listen_udp", "dial_udp",
+]

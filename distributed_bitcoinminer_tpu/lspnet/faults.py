@@ -1,0 +1,103 @@
+"""Global fault-injection knobs, keyed by endpoint side.
+
+Mirrors the reference knob set (ref: lspnet/staff.go:20-116): four drop
+percentages (client/server × read/write), a delay percentage (fixed 500 ms),
+payload shortening/lengthening percentages, and a corruption flag. Knobs are
+process-global and read on every packet, so tests can flip them mid-stream.
+Plain attribute reads/writes are GIL-atomic, which is all the reference's
+atomics bought it.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+
+log = logging.getLogger("lspnet")
+
+DELAY_MILLIS = 500  # fixed injected delay, matches ref lspnet/conn.go:113
+
+
+class _Knobs:
+    client_read_drop = 0
+    client_write_drop = 0
+    server_read_drop = 0
+    server_write_drop = 0
+    shorten_percent = 0
+    lengthen_percent = 0
+    delay_percent = 0
+    corrupted = False
+    debug = False
+
+
+knobs = _Knobs()
+
+
+def set_client_read_drop_percent(p: int) -> None:
+    if 0 <= p <= 100:
+        knobs.client_read_drop = p
+
+
+def set_client_write_drop_percent(p: int) -> None:
+    if 0 <= p <= 100:
+        knobs.client_write_drop = p
+
+
+def set_server_read_drop_percent(p: int) -> None:
+    if 0 <= p <= 100:
+        knobs.server_read_drop = p
+
+
+def set_server_write_drop_percent(p: int) -> None:
+    if 0 <= p <= 100:
+        knobs.server_write_drop = p
+
+
+def set_read_drop_percent(p: int) -> None:
+    set_client_read_drop_percent(p)
+    set_server_read_drop_percent(p)
+
+
+def set_write_drop_percent(p: int) -> None:
+    set_client_write_drop_percent(p)
+    set_server_write_drop_percent(p)
+
+
+def set_msg_shortening_percent(p: int) -> None:
+    if 0 <= p <= 100:
+        knobs.shorten_percent = p
+
+
+def set_msg_lengthening_percent(p: int) -> None:
+    if 0 <= p <= 100:
+        knobs.lengthen_percent = p
+
+
+def set_delay_message_percent(p: int) -> None:
+    if 0 <= p <= 100:
+        knobs.delay_percent = p
+
+
+def set_msg_corrupted(corrupted: bool) -> None:
+    knobs.corrupted = corrupted
+
+
+def reset_drop_percent() -> None:
+    set_read_drop_percent(0)
+    set_write_drop_percent(0)
+
+
+def reset_all_faults() -> None:
+    reset_drop_percent()
+    knobs.shorten_percent = 0
+    knobs.lengthen_percent = 0
+    knobs.delay_percent = 0
+    knobs.corrupted = False
+
+
+def enable_debug_logs(enable: bool) -> None:
+    knobs.debug = enable
+
+
+def sometimes(percentage: int) -> bool:
+    return random.randrange(100) < percentage

@@ -1,0 +1,183 @@
+"""Asyncio UDP endpoints with fault injection on the send and receive paths.
+
+Equivalent of the reference's ``UDPConn`` wrapper (ref: lspnet/conn.go,
+lspnet/net.go): endpoints opened with :func:`listen_udp` are the "server"
+side and those opened with :func:`dial_udp` are the "client" side, which
+selects which drop knobs apply. Fault behavior matches the reference:
+
+- read drop: inbound datagram silently discarded before the protocol sees it;
+- write drop: outbound datagram discarded but reported as sent;
+- delay: outbound datagram delivered 500 ms late;
+- shorten/lengthen/corrupt: applied to Data messages only, mutating the
+  payload while leaving Size/Checksum stale so the receiver's integrity gate
+  must catch it;
+- sniffer: counts sent/dropped Data/Ack packets at write time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+
+from .faults import DELAY_MILLIS, knobs, log, sometimes
+from . import sniff
+
+
+def _mutate_data_packet(data: bytes) -> bytes:
+    """Apply shorten/lengthen/corrupt to a Data message (ref: lspnet/conn.go:143-175)."""
+    shorten = sometimes(knobs.shorten_percent)
+    lengthen = sometimes(knobs.lengthen_percent)
+    corrupt = knobs.corrupted
+    if not (shorten or lengthen or corrupt):
+        return data
+    try:
+        obj = json.loads(data)
+        if obj.get("Type") != 1:  # only Data messages are mutated
+            return data
+        payload = bytearray(base64.b64decode(obj["Payload"]) if obj.get("Payload") else b"")
+    except Exception:  # noqa: BLE001 — non-LSP traffic passes through untouched
+        return data
+    if shorten:
+        payload = payload[: len(payload) // 2]
+    elif lengthen:
+        payload += bytes([2, 3, 4])
+    elif corrupt:
+        if len(payload) == 0:
+            payload = bytearray([0xFF])
+        else:
+            payload[0] = payload[0] ^ 0xFF
+    obj["Payload"] = base64.b64encode(bytes(payload)).decode("ascii")
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
+def _packet_type(data: bytes) -> int:
+    try:
+        return int(json.loads(data).get("Type", -1))
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+class _Protocol(asyncio.DatagramProtocol):
+    """Binds to its UDPEndpoint after construction (the endpoint wraps the
+    transport, which only exists once the protocol has been created)."""
+
+    def __init__(self):
+        self._ep: UDPEndpoint | None = None
+        self._pending: list[tuple[bytes, tuple]] = []
+        self._lost = False
+
+    def bind(self, ep: "UDPEndpoint") -> None:
+        self._ep = ep
+        for data, addr in self._pending:
+            self._deliver(data, addr)
+        self._pending.clear()
+        if self._lost:
+            ep._recv_queue.put_nowait(None)
+
+    def _deliver(self, data: bytes, addr) -> None:
+        ep = self._ep
+        drop = knobs.server_read_drop if ep.is_server else knobs.client_read_drop
+        if sometimes(drop):
+            if knobs.debug:
+                log.info("DROPPING read packet of length %d", len(data))
+            return
+        ep._recv_queue.put_nowait((data, addr))
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        if self._ep is None:
+            self._pending.append((data, addr))
+        else:
+            self._deliver(data, addr)
+
+    def connection_lost(self, exc) -> None:
+        if self._ep is None:
+            self._lost = True
+        else:
+            self._ep._recv_queue.put_nowait(None)
+
+
+class UDPEndpoint:
+    """One UDP socket with fault injection. Not thread-safe; owned by one loop."""
+
+    def __init__(self, transport: asyncio.DatagramTransport, is_server: bool):
+        self._transport = transport
+        self.is_server = is_server
+        self._recv_queue: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        self._delay_tasks: set[asyncio.Task] = set()
+
+    @property
+    def sockname(self):
+        return self._transport.get_extra_info("sockname")
+
+    async def recv(self) -> tuple[bytes, tuple] | None:
+        """Next surviving inbound datagram, or None once the socket is closed."""
+        if self._closed and self._recv_queue.empty():
+            return None
+        item = await self._recv_queue.get()
+        return item
+
+    def send(self, data: bytes, addr=None) -> None:
+        """Send one datagram through the fault pipeline (ref: lspnet/conn.go:104-190)."""
+        if self._closed:
+            return
+        if sometimes(knobs.delay_percent):
+            if knobs.debug:
+                log.info("DELAYING written packet of length %d", len(data))
+            task = asyncio.get_running_loop().create_task(self._send_later(data, addr))
+            self._delay_tasks.add(task)
+            task.add_done_callback(self._delay_tasks.discard)
+            return
+        self._send_now(data, addr)
+
+    async def _send_later(self, data: bytes, addr) -> None:
+        await asyncio.sleep(DELAY_MILLIS / 1000.0)
+        if not self._closed:
+            self._send_now(data, addr)
+
+    def _send_now(self, data: bytes, addr) -> None:
+        # Only pay the JSON parse when a knob or the sniffer needs the type.
+        inspect = (sniff.is_sniffing() or knobs.shorten_percent
+                   or knobs.lengthen_percent or knobs.corrupted)
+        mtype = _packet_type(data) if inspect else -1
+        drop = knobs.server_write_drop if self.is_server else knobs.client_write_drop
+        if sometimes(drop):
+            if knobs.debug:
+                log.info("DROPPING written packet of length %d", len(data))
+            if sniff.is_sniffing():
+                sniff.record(mtype, sent=False)
+            return
+        if sniff.is_sniffing():
+            sniff.record(mtype, sent=True)
+        if inspect and mtype == 1:
+            data = _mutate_data_packet(data)
+        self._transport.sendto(data, addr)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for task in list(self._delay_tasks):
+            task.cancel()
+        self._transport.close()
+
+
+async def listen_udp(host: str = "127.0.0.1", port: int = 0) -> UDPEndpoint:
+    """Open a server-side endpoint (ref: lspnet/net.go ListenUDP)."""
+    loop = asyncio.get_running_loop()
+    transport, protocol = await loop.create_datagram_endpoint(
+        _Protocol, local_addr=(host, port))
+    ep = UDPEndpoint(transport, is_server=True)
+    protocol.bind(ep)
+    return ep
+
+
+async def dial_udp(host: str, port: int) -> UDPEndpoint:
+    """Open a client-side endpoint connected to (host, port) (ref: lspnet/net.go DialUDP)."""
+    loop = asyncio.get_running_loop()
+    transport, protocol = await loop.create_datagram_endpoint(
+        _Protocol, remote_addr=(host, port))
+    ep = UDPEndpoint(transport, is_server=False)
+    protocol.bind(ep)
+    return ep

@@ -1,0 +1,53 @@
+"""Packet sniffer: counts sent/dropped Data and Ack packets while enabled.
+
+The backoff tests grade retransmission timing by counting packets on the wire
+(ref: lspnet/sniff.go:9-60, used by lsp2_test.go TestExpBackOff).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+_TYPE_DATA = 1
+_TYPE_ACK = 2
+
+
+@dataclass
+class SniffResult:
+    num_sent_acks: int = 0
+    num_dropped_acks: int = 0
+    num_sent_data: int = 0
+    num_dropped_data: int = 0
+
+
+_sniffing = False
+_result = SniffResult()
+
+
+def start_sniff() -> None:
+    global _sniffing, _result
+    _result = SniffResult()
+    _sniffing = True
+
+
+def stop_sniff() -> SniffResult:
+    global _sniffing
+    _sniffing = False
+    return _result
+
+
+def is_sniffing() -> bool:
+    return _sniffing
+
+
+def record(msg_type: int, sent: bool) -> None:
+    if msg_type == _TYPE_DATA:
+        if sent:
+            _result.num_sent_data += 1
+        else:
+            _result.num_dropped_data += 1
+    elif msg_type == _TYPE_ACK:
+        if sent:
+            _result.num_sent_acks += 1
+        else:
+            _result.num_dropped_acks += 1

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE any jax import, so every
+sharding/collective codepath is exercised without TPU hardware (the driver
+separately dry-runs the multi-chip path; benches run on the real chip).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def reset_network_faults():
+    """Every test starts and ends with a clean fault-injection state."""
+    from distributed_bitcoinminer_tpu import lspnet
+    lspnet.reset_all_faults()
+    yield
+    lspnet.reset_all_faults()
+    lspnet.stop_sniff()

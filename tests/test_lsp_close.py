@@ -1,0 +1,184 @@
+"""Close/shutdown semantics + slow-start connect retries.
+
+Port of the reference lsp3_test.go scenarios: a client connecting before the
+server exists must keep retrying; Close flushes pending data both ways;
+CloseConn is non-blocking; the other side observes clean termination errors;
+loss detection fires after EpochLimit silent epochs.
+"""
+
+import asyncio
+
+import pytest
+
+from distributed_bitcoinminer_tpu import lspnet
+from distributed_bitcoinminer_tpu.lsp import Params
+from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+from distributed_bitcoinminer_tpu.lsp.errors import (
+    ConnectionClosed, ConnectionLost, ConnectTimeout, LspError)
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+
+
+def params_with(window=1, backoff=0, epoch_ms=50, limit=5):
+    return Params(epoch_limit=limit, epoch_millis=epoch_ms,
+                  window_size=window, max_backoff_interval=backoff)
+
+
+class TestConnect:
+    def test_connect_timeout_when_no_server(self):
+        async def scenario():
+            params = params_with(epoch_ms=40, limit=3)
+            with pytest.raises(ConnectTimeout):
+                # Port 1 on localhost: nothing listening.
+                await new_async_client("127.0.0.1:1", params)
+        asyncio.run(scenario())
+
+    def test_server_slow_start(self):
+        """Client keeps retrying Connect until a late server appears
+        (ref TestServerSlowStart, lsp3_test.go:176-182)."""
+        async def scenario():
+            params = params_with(epoch_ms=50, limit=12)
+            # Reserve a port, then release it for the late server.
+            probe = await new_async_server(0, params)
+            port = probe.port
+            await probe.close()
+
+            async def late_server():
+                await asyncio.sleep(0.3)  # ~6 epochs late
+                return await new_async_server(port, params)
+
+            server_task = asyncio.create_task(late_server())
+            client = await new_async_client(f"127.0.0.1:{port}", params)
+            server = await server_task
+            client.write(b"made it")
+            _, payload = await asyncio.wait_for(server.read(), 5)
+            assert payload == b"made it"
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+
+class TestClientClose:
+    def test_close_flushes_pending_writes(self):
+        """Writes issued immediately before Close must still arrive
+        (ref TestClientClose / fast-close family)."""
+        async def scenario():
+            params = params_with(window=2, backoff=1, epoch_ms=50, limit=30)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            n = 10
+            for i in range(n):
+                client.write(f"m{i}".encode())
+            await client.close()  # must block until all 10 acked
+            got = []
+            while len(got) < n:
+                _, payload = await asyncio.wait_for(server.read(), 5)
+                if isinstance(payload, bytes):
+                    got.append(payload)
+            assert got == [f"m{i}".encode() for i in range(n)]
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_read_after_close_raises(self):
+        async def scenario():
+            params = params_with()
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            await client.close()
+            with pytest.raises(LspError):
+                await asyncio.wait_for(client.read(), 2)
+            with pytest.raises(LspError):
+                client.write(b"nope")
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_server_detects_closed_clients(self):
+        """After clients vanish, server reads per-conn errors within
+        EpochLimit epochs (ref TestClientClose2 / server-detect pattern)."""
+        async def scenario():
+            params = params_with(epoch_ms=40, limit=4)
+            server = await new_async_server(0, params)
+            clients = [await new_async_client(f"127.0.0.1:{server.port}", params)
+                       for _ in range(3)]
+            for i, c in enumerate(clients):
+                c.write(f"hello{i}".encode())
+            seen = 0
+            while seen < 3:
+                _, item = await asyncio.wait_for(server.read(), 5)
+                if isinstance(item, bytes):
+                    seen += 1
+            for c in clients:
+                await c.close()
+            dead = set()
+            while len(dead) < 3:
+                conn_id, item = await asyncio.wait_for(server.read(), 5)
+                if isinstance(item, Exception):
+                    dead.add(conn_id)
+            assert len(dead) == 3
+            await server.close()
+        asyncio.run(scenario())
+
+
+class TestServerClose:
+    def test_server_close_flushes(self):
+        """Server Close flushes its pending writes to every client
+        (ref TestServerClose)."""
+        async def scenario():
+            params = params_with(window=2, backoff=1, epoch_ms=50, limit=30)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            client.write(b"register")
+            conn_id, _ = await asyncio.wait_for(server.read(), 5)
+            n = 8
+            for i in range(n):
+                server.write(conn_id, f"s{i}".encode())
+            await server.close()  # blocks until flushed
+            got = [await asyncio.wait_for(client.read(), 5) for _ in range(n)]
+            assert got == [f"s{i}".encode() for i in range(n)]
+            await client.close()
+        asyncio.run(scenario())
+
+    def test_close_conn_nonblocking_and_client_times_out(self):
+        """CloseConn returns immediately; the client later sees loss
+        (ref TestServerCloseConns)."""
+        async def scenario():
+            params = params_with(epoch_ms=40, limit=4)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            client.write(b"x")
+            conn_id, _ = await asyncio.wait_for(server.read(), 5)
+            server.close_conn(conn_id)
+            with pytest.raises(ConnectionClosed):
+                server.write(conn_id, b"after close")
+            # The closed server conn stops heartbeating; client times out.
+            with pytest.raises((ConnectionLost, ConnectionClosed)):
+                while True:
+                    await asyncio.wait_for(client.read(), 5)
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+
+class TestLossDetection:
+    def test_client_detects_dead_server(self):
+        async def scenario():
+            params = params_with(epoch_ms=40, limit=4)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            await server.close()
+            with pytest.raises((ConnectionLost, ConnectionClosed)):
+                while True:
+                    await asyncio.wait_for(client.read(), 5)
+            await client.close()
+        asyncio.run(scenario())
+
+    def test_write_after_loss_raises(self):
+        async def scenario():
+            params = params_with(epoch_ms=40, limit=3)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            await server.close()
+            await asyncio.sleep(0.4)  # > epoch_limit epochs
+            with pytest.raises(LspError):
+                client.write(b"into the void")
+            await client.close()
+        asyncio.run(scenario())

@@ -1,0 +1,112 @@
+"""Integrity gate: variable-length and corrupted messages.
+
+Port of the reference lsp5_test.go scenarios: lengthened payloads are
+truncated to Size and delivered; shortened payloads are silently rejected
+(receiver gets nothing); bit-flipped payloads are rejected via checksum.
+"""
+
+import asyncio
+
+from distributed_bitcoinminer_tpu import lspnet
+from distributed_bitcoinminer_tpu.lsp import Params
+from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+
+
+def params_with(epoch_ms=50, limit=30):
+    return Params(epoch_limit=limit, epoch_millis=epoch_ms,
+                  window_size=5, max_backoff_interval=1)
+
+
+async def _pair(params):
+    server = await new_async_server(0, params)
+    client = await new_async_client(f"127.0.0.1:{server.port}", params)
+    return server, client
+
+
+class TestVariableLength:
+    def test_lengthened_messages_are_truncated(self):
+        """Extended payloads must be cut back to Size and then pass the
+        checksum (ref TestVariableLengthMsgServer)."""
+        async def scenario():
+            params = params_with()
+            server, client = await _pair(params)
+            lspnet.set_msg_lengthening_percent(100)
+            n = 5
+            for i in range(n):
+                client.write(f"msg{i}".encode())
+            got = []
+            while len(got) < n:
+                _, payload = await asyncio.wait_for(server.read(), 10)
+                if isinstance(payload, bytes):
+                    got.append(payload)
+            assert got == [f"msg{i}".encode() for i in range(n)]
+            lspnet.set_msg_lengthening_percent(0)
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_shortened_messages_never_delivered(self):
+        """Truncated-on-the-wire payloads must be silently dropped; with
+        the fault always on, the receiver gets nothing
+        (ref TestVariableLengthMsgClient + 'correct if nothing received')."""
+        async def scenario():
+            params = params_with(epoch_ms=50, limit=100)
+            server, client = await _pair(params)
+            lspnet.set_msg_shortening_percent(100)
+            for i in range(3):
+                client.write(f"blocked{i}".encode())
+            try:
+                await asyncio.wait_for(server.read(), 0.8)
+                raise AssertionError("shortened message was delivered")
+            except asyncio.TimeoutError:
+                pass
+            lspnet.set_msg_shortening_percent(0)
+            await server.close()
+        asyncio.run(scenario())
+
+
+class TestCorruption:
+    def test_corrupted_messages_rejected_by_checksum(self):
+        async def scenario():
+            params = params_with(epoch_ms=50, limit=100)
+            server, client = await _pair(params)
+            lspnet.set_msg_corrupted(True)
+            for i in range(3):
+                client.write(f"tainted{i}".encode())
+            try:
+                await asyncio.wait_for(server.read(), 0.8)
+                raise AssertionError("corrupted message was delivered")
+            except asyncio.TimeoutError:
+                pass
+            lspnet.set_msg_corrupted(False)
+            # Once corruption stops, retransmits deliver the originals.
+            got = []
+            while len(got) < 3:
+                _, payload = await asyncio.wait_for(server.read(), 10)
+                if isinstance(payload, bytes):
+                    got.append(payload)
+            assert got == [f"tainted{i}".encode() for i in range(3)]
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_corruption_server_to_client(self):
+        async def scenario():
+            params = params_with(epoch_ms=50, limit=100)
+            server, client = await _pair(params)
+            client.write(b"reg")
+            conn_id, _ = await asyncio.wait_for(server.read(), 5)
+            lspnet.set_msg_corrupted(True)
+            server.write(conn_id, b"poisoned")
+            try:
+                await asyncio.wait_for(client.read(), 0.8)
+                raise AssertionError("corrupted message was delivered")
+            except asyncio.TimeoutError:
+                pass
+            lspnet.set_msg_corrupted(False)
+            got = await asyncio.wait_for(client.read(), 10)
+            assert got == b"poisoned"
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())

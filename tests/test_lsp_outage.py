@@ -1,0 +1,123 @@
+"""Buffering across total network outages.
+
+Port of the reference lsp4_test.go choreography: a "network master" toggles
+the write-drop knob between 0%% and 100%% while both sides keep streaming;
+every write issued during the outage must arrive after the network heals,
+and Close called during an outage must still flush afterwards.
+"""
+
+import asyncio
+
+from distributed_bitcoinminer_tpu import lspnet
+from distributed_bitcoinminer_tpu.lsp import Params
+from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+
+
+def params_with(window=5, backoff=1, epoch_ms=50, limit=60):
+    return Params(epoch_limit=limit, epoch_millis=epoch_ms,
+                  window_size=window, max_backoff_interval=backoff)
+
+
+class TestOutageBuffering:
+    def test_client_to_server_through_outage(self):
+        """Client streams during a dead network (ref TestClientToServer)."""
+        async def scenario():
+            params = params_with()
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            n = 30
+            lspnet.set_write_drop_percent(100)
+            for i in range(n):
+                client.write(f"m{i:02d}".encode())
+            await asyncio.sleep(0.3)  # outage persists while writes queue
+            lspnet.set_write_drop_percent(0)
+            got = []
+            while len(got) < n:
+                _, payload = await asyncio.wait_for(server.read(), 10)
+                if isinstance(payload, bytes):
+                    got.append(payload)
+            assert got == [f"m{i:02d}".encode() for i in range(n)]
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_server_to_client_through_outage(self):
+        """Server streams during a dead network (ref TestServerToClient)."""
+        async def scenario():
+            params = params_with()
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            client.write(b"reg")
+            conn_id, _ = await asyncio.wait_for(server.read(), 5)
+            n = 30
+            lspnet.set_write_drop_percent(100)
+            for i in range(n):
+                server.write(conn_id, f"s{i:02d}".encode())
+            await asyncio.sleep(0.3)
+            lspnet.set_write_drop_percent(0)
+            got = [await asyncio.wait_for(client.read(), 10) for _ in range(n)]
+            assert got == [f"s{i:02d}".encode() for i in range(n)]
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_round_trip_through_toggling_network(self):
+        """Echo stream while the network flaps (ref TestRoundTrip)."""
+        async def scenario():
+            params = params_with(window=8)
+            server = await new_async_server(0, params)
+
+            async def echo():
+                while True:
+                    conn_id, item = await server.read()
+                    if isinstance(item, bytes):
+                        server.write(conn_id, item)
+            echo_task = asyncio.create_task(echo())
+
+            async def flapper():
+                for _ in range(4):
+                    lspnet.set_write_drop_percent(100)
+                    await asyncio.sleep(0.15)
+                    lspnet.set_write_drop_percent(0)
+                    await asyncio.sleep(0.25)
+            flap_task = asyncio.create_task(flapper())
+
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            n = 40
+            for i in range(n):
+                client.write(f"rt{i:02d}".encode())
+            got = [await asyncio.wait_for(client.read(), 15) for _ in range(n)]
+            assert got == [f"rt{i:02d}".encode() for i in range(n)]
+            await flap_task
+            echo_task.cancel()
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_fast_close_during_outage_still_flushes(self):
+        """Close while the network is down: flush must complete once it
+        heals (ref TestServerFastClose choreography)."""
+        async def scenario():
+            params = params_with()
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            n = 10
+            lspnet.set_write_drop_percent(100)
+            for i in range(n):
+                client.write(f"f{i}".encode())
+
+            async def heal_later():
+                await asyncio.sleep(0.4)
+                lspnet.set_write_drop_percent(0)
+            heal_task = asyncio.create_task(heal_later())
+            await asyncio.wait_for(client.close(), 15)  # blocks through outage
+            await heal_task
+            got = []
+            while len(got) < n:
+                _, payload = await asyncio.wait_for(server.read(), 10)
+                if isinstance(payload, bytes):
+                    got.append(payload)
+            assert got == [f"f{i}".encode() for i in range(n)]
+            await server.close()
+        asyncio.run(scenario())

@@ -1,0 +1,36 @@
+"""The Go-style blocking facade (threads + shared background loop)."""
+
+import threading
+
+from distributed_bitcoinminer_tpu.lsp import Params, new_client, new_server
+
+
+def test_sync_echo_roundtrip():
+    params = Params(epoch_limit=10, epoch_millis=100, window_size=5,
+                    max_backoff_interval=1)
+    server = new_server(0, params)
+
+    def echo():
+        while True:
+            try:
+                conn_id, item = server.read()
+            except Exception:  # noqa: BLE001 — server closed
+                return
+            if isinstance(item, bytes):
+                try:
+                    server.write(conn_id, item)
+                except Exception:  # noqa: BLE001
+                    return
+    thread = threading.Thread(target=echo, daemon=True)
+    thread.start()
+
+    client = new_client(f"127.0.0.1:{server.port}", params)
+    assert client.conn_id() > 0
+    for i in range(10):
+        payload = f"sync{i}".encode()
+        client.write(payload)
+        assert client.read() == payload
+    client.close()
+    server.close()
+    thread.join(timeout=5)
+    assert not thread.is_alive()

@@ -1,0 +1,147 @@
+"""Sliding-window + exponential-backoff semantics.
+
+Port of the reference lsp2_test.go scenarios: max-capacity windows (acks
+blackholed => exactly the first W messages cross), out-of-order release, and
+the graded retransmit-counting law (sniff N epochs with acks dropped and
+assert the on-wire send count matches the XXOXOOX0000X backoff pattern;
+ref: lsp2_test.go:503-533).
+"""
+
+import asyncio
+
+from distributed_bitcoinminer_tpu import lspnet
+from distributed_bitcoinminer_tpu.lsp import Params
+from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+
+
+def params_with(window=1, backoff=0, epoch_ms=50, limit=5):
+    return Params(epoch_limit=limit, epoch_millis=epoch_ms,
+                  window_size=window, max_backoff_interval=backoff)
+
+
+class TestWindowMaxCapacity:
+    def test_only_window_size_messages_cross_without_acks(self):
+        """Blackhole server->client acks; client must stop at W unacked
+        (ref runMaxCapacityTest, lsp2_test.go:335-400)."""
+        async def scenario():
+            window = 3
+            params = params_with(window=window, backoff=1, epoch_ms=50, limit=60)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+
+            # Server writes nothing; acks from the server are dropped.
+            lspnet.set_server_write_drop_percent(100)
+            for i in range(10):
+                client.write(f"m{i}".encode())
+
+            received = []
+            async def reader():
+                while True:
+                    _, payload = await server.read()
+                    if isinstance(payload, bytes):
+                        received.append(payload)
+            reader_task = asyncio.create_task(reader())
+            await asyncio.sleep(0.6)  # several epochs of retransmits
+            assert sorted(received) == [f"m{i}".encode() for i in range(window)], \
+                f"window overflow: {received}"
+
+            # Heal the network: the rest must flow.
+            lspnet.set_server_write_drop_percent(0)
+            await asyncio.sleep(1.0)
+            assert sorted(received) == sorted(f"m{i}".encode() for i in range(10))
+            reader_task.cancel()
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+
+class TestOutOfOrder:
+    def test_in_order_release_with_delays(self):
+        """50% of packets delayed 500 ms; receiver must still see order
+        (ref runMessageOrderTest, lsp2_test.go:481-501)."""
+        async def scenario():
+            params = params_with(window=20, backoff=1, epoch_ms=300, limit=10)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            lspnet.set_delay_message_percent(50)
+            n = 30
+            for i in range(n):
+                client.write(f"m{i:03d}".encode())
+            got = []
+            while len(got) < n:
+                _, payload = await asyncio.wait_for(server.read(), 10)
+                if isinstance(payload, bytes):
+                    got.append(payload)
+            assert got == [f"m{i:03d}".encode() for i in range(n)]
+            lspnet.set_delay_message_percent(0)
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+
+class TestExpBackOff:
+    def test_retransmit_count_matches_backoff_law(self):
+        """Unbounded backoff: ~5 sends per message in 14 epochs, graded as
+        4-6x window x messages (ref lsp2_test.go:503-533)."""
+        async def scenario():
+            window = 2
+            epochs = 14
+            epoch_ms = 60
+            params = params_with(window=window, backoff=1000,
+                                 epoch_ms=epoch_ms, limit=epochs + 6)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            # Blackhole everything the server sends: no acks ever arrive.
+            lspnet.set_server_write_drop_percent(100)
+            lspnet.start_sniff()
+            for i in range(window):
+                client.write(f"m{i}".encode())
+            await asyncio.sleep(epochs * epoch_ms / 1000.0)
+            result = lspnet.stop_sniff()
+            lspnet.set_server_write_drop_percent(0)
+            total = result.num_sent_data
+            low, high = 4 * window, 6 * window
+            assert low <= total <= high, \
+                f"sent {total} data packets; expected [{low}, {high}]"
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+    def test_capped_backoff_resends_regularly(self):
+        """max_backoff=1 => a resend at least every 2 epochs."""
+        async def scenario():
+            epochs = 10
+            epoch_ms = 60
+            params = params_with(window=1, backoff=1, epoch_ms=epoch_ms,
+                                 limit=epochs + 6)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            lspnet.set_server_write_drop_percent(100)
+            lspnet.start_sniff()
+            client.write(b"x")
+            await asyncio.sleep(epochs * epoch_ms / 1000.0)
+            result = lspnet.stop_sniff()
+            lspnet.set_server_write_drop_percent(0)
+            # send pattern with cap 1: X X O X O X O X ... ~ 1 + ceil(epochs/2)
+            assert result.num_sent_data >= 1 + (epochs - 2) // 2, \
+                f"too few sends: {result.num_sent_data}"
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+
+class TestHeartbeat:
+    def test_idle_connection_stays_alive(self):
+        """No data for >> epoch_limit epochs; heartbeats keep the link up."""
+        async def scenario():
+            params = params_with(window=1, epoch_ms=40, limit=3)
+            server = await new_async_server(0, params)
+            client = await new_async_client(f"127.0.0.1:{server.port}", params)
+            await asyncio.sleep(0.5)  # ~12 epochs of silence
+            client.write(b"still here")
+            conn_id, payload = await asyncio.wait_for(server.read(), 5)
+            assert payload == b"still here"
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())

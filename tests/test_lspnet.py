@@ -1,0 +1,169 @@
+"""Simulated-network tests: UDP endpoints, fault knobs, sniffer.
+
+Covers the seven knob behaviors the 44-test LSP suite depends on
+(ref: lspnet/staff.go, lspnet/conn.go, lspnet/sniff.go).
+"""
+
+import asyncio
+
+from distributed_bitcoinminer_tpu import lspnet
+from distributed_bitcoinminer_tpu.lsp.message import new_ack, new_data
+from distributed_bitcoinminer_tpu.lsp.checksum import make_checksum
+
+
+def _data_packet(payload=b"1234", conn_id=1, seq=1):
+    return new_data(conn_id, seq, len(payload),
+                    payload, make_checksum(conn_id, seq, len(payload), payload)).to_json()
+
+
+async def _pair():
+    server = await lspnet.listen_udp()
+    client = await lspnet.dial_udp("127.0.0.1", server.sockname[1])
+    return server, client
+
+
+def test_basic_roundtrip():
+    async def scenario():
+        server, client = await _pair()
+        client.send(_data_packet(b"ping"))
+        raw, addr = await asyncio.wait_for(server.recv(), 2)
+        assert b"ping" not in raw  # payload is base64 on the wire
+        server.send(_data_packet(b"pong"), addr)
+        raw2, _ = await asyncio.wait_for(client.recv(), 2)
+        assert raw2 == _data_packet(b"pong")
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_write_drop_100_percent():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.set_client_write_drop_percent(100)
+        client.send(_data_packet())
+        with_timeout = asyncio.wait_for(server.recv(), 0.3)
+        try:
+            await with_timeout
+            raise AssertionError("packet should have been dropped")
+        except asyncio.TimeoutError:
+            pass
+        # Server side unaffected: client still receives.
+        lspnet.set_client_write_drop_percent(0)
+        client.send(_data_packet(b"probe"))
+        _, addr = await asyncio.wait_for(server.recv(), 2)
+        server.send(_data_packet(b"back"), addr)
+        await asyncio.wait_for(client.recv(), 2)
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_read_drop_applies_per_side():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.set_server_read_drop_percent(100)
+        client.send(_data_packet())
+        try:
+            await asyncio.wait_for(server.recv(), 0.3)
+            raise AssertionError("server read should have dropped")
+        except asyncio.TimeoutError:
+            pass
+        lspnet.set_server_read_drop_percent(0)
+        client.send(_data_packet())
+        await asyncio.wait_for(server.recv(), 2)
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_shortening_halves_payload_keeps_size():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.set_msg_shortening_percent(100)
+        client.send(_data_packet(b"123456"))
+        raw, _ = await asyncio.wait_for(server.recv(), 2)
+        from distributed_bitcoinminer_tpu.lsp.message import Message
+        msg = Message.from_json(raw)
+        assert msg.size == 6          # header untouched
+        assert len(msg.payload) == 3  # payload halved
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_lengthening_appends_bytes():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.set_msg_lengthening_percent(100)
+        client.send(_data_packet(b"1234"))
+        raw, _ = await asyncio.wait_for(server.recv(), 2)
+        from distributed_bitcoinminer_tpu.lsp.message import Message
+        msg = Message.from_json(raw)
+        assert msg.size == 4
+        assert len(msg.payload) == 7 and msg.payload[4:] == bytes([2, 3, 4])
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_corruption_flips_first_byte():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.set_msg_corrupted(True)
+        client.send(_data_packet(b"1234"))
+        raw, _ = await asyncio.wait_for(server.recv(), 2)
+        from distributed_bitcoinminer_tpu.lsp.message import Message
+        msg = Message.from_json(raw)
+        assert msg.payload[0] == ord("1") ^ 0xFF
+        assert msg.payload[1:] == b"234"
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_acks_never_mutated():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.set_msg_corrupted(True)
+        lspnet.set_msg_shortening_percent(100)
+        packet = new_ack(1, 5).to_json()
+        client.send(packet)
+        raw, _ = await asyncio.wait_for(server.recv(), 2)
+        assert raw == packet
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_delay_defers_delivery():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.set_delay_message_percent(100)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        client.send(_data_packet())
+        await asyncio.wait_for(server.recv(), 2)
+        assert loop.time() - t0 >= 0.45
+        server.close()
+        client.close()
+    asyncio.run(scenario())
+
+
+def test_sniffer_counts_sent_and_dropped():
+    async def scenario():
+        server, client = await _pair()
+        lspnet.start_sniff()
+        for _ in range(5):
+            client.send(_data_packet())
+        client.send(new_ack(1, 1).to_json())
+        lspnet.set_client_write_drop_percent(100)
+        for _ in range(3):
+            client.send(_data_packet())
+        await asyncio.sleep(0.1)
+        result = lspnet.stop_sniff()
+        assert result.num_sent_data == 5
+        assert result.num_dropped_data == 3
+        assert result.num_sent_acks == 1
+        server.close()
+        client.close()
+    asyncio.run(scenario())

@@ -1,0 +1,146 @@
+"""Wire-format tests: LSP Message JSON codec + checksum + bitcoin codec.
+
+Goldens below were captured from the Go reference semantics
+(encoding/json of lsp.Message / bitcoin.Message; lsp/checksum.go fold).
+"""
+
+import pytest
+
+from distributed_bitcoinminer_tpu.lsp import (
+    Message, MsgType, new_ack, new_connect, new_data,
+    bytearray2checksum, int2checksum, make_checksum,
+)
+from distributed_bitcoinminer_tpu.lsp.params import Params
+from distributed_bitcoinminer_tpu import bitcoin
+
+
+class TestLspMessageCodec:
+    def test_connect_golden(self):
+        # Go: json.Marshal(NewConnect())
+        assert new_connect().to_json() == (
+            b'{"Type":0,"ConnID":0,"SeqNum":0,"Size":0,"Checksum":0,"Payload":null}')
+
+    def test_ack_golden(self):
+        assert new_ack(7, 3).to_json() == (
+            b'{"Type":2,"ConnID":7,"SeqNum":3,"Size":0,"Checksum":0,"Payload":null}')
+
+    def test_data_golden_base64(self):
+        # Go base64-encodes []byte payloads: "abc" -> "YWJj".
+        msg = new_data(1, 2, 3, b"abc", 99)
+        assert msg.to_json() == (
+            b'{"Type":1,"ConnID":1,"SeqNum":2,"Size":3,"Checksum":99,"Payload":"YWJj"}')
+
+    def test_roundtrip(self):
+        msg = new_data(12, 34, 5, b"hello", make_checksum(12, 34, 5, b"hello"))
+        decoded = Message.from_json(msg.to_json())
+        assert decoded == msg
+
+    def test_decode_go_emitted(self):
+        # As emitted by the Go reference client for Write([]byte("1234")).
+        raw = b'{"Type":1,"ConnID":1,"SeqNum":1,"Size":4,"Checksum":26218,"Payload":"MTIzNA=="}'
+        msg = Message.from_json(raw)
+        assert msg.type == MsgType.DATA
+        assert msg.payload == b"1234"
+        assert msg.size == 4
+
+    def test_malformed_raises(self):
+        with pytest.raises(ValueError):
+            Message.from_json(b"not json")
+        with pytest.raises(ValueError):
+            Message.from_json(b'[1,2,3]')
+        with pytest.raises(ValueError):
+            Message.from_json(b'{"Type":1,"Payload":"###"}')
+
+    def test_str_matches_reference_format(self):
+        assert str(new_connect()) == "[Connect 0 0]"
+        assert str(new_ack(4, 9)) == "[Ack 4 9]"
+        assert str(new_data(1, 2, 2, b"hi", 7)) == "[Data 1 2 7 hi]"
+
+
+class TestChecksum:
+    def test_int2checksum_splits_halves(self):
+        assert int2checksum(0) == 0
+        assert int2checksum(1) == 1
+        assert int2checksum(0x10000) == 1          # upper half
+        assert int2checksum(0x1_0001) == 2         # both halves
+        assert int2checksum(0xFFFF_FFFF) == 0x1FFFE
+
+    def test_bytearray_le_chunks(self):
+        assert bytearray2checksum(b"") == 0
+        assert bytearray2checksum(b"\x01\x02") == 0x0201
+        # Odd length: trailing byte zero-padded (LE -> just the byte value).
+        assert bytearray2checksum(b"\x01\x02\x03") == 0x0201 + 0x03
+
+    def test_fold_carry(self):
+        # Large sums fold 16-bit carries back in until <= 0xffff.
+        payload = b"\xff\xff" * 40
+        value = make_checksum(0, 0, 0, payload)
+        assert 0 <= value <= 0xFFFF
+
+    def test_known_value(self):
+        # connID=1 seq=1 size=4 payload="1234":
+        # 1 + 1 + 4 + (0x3231 + 0x3433) = 0x666a, fits in 16 bits unfolded.
+        assert make_checksum(1, 1, 4, b"1234") == 0x666A
+
+    def test_checksum_detects_corruption(self):
+        good = make_checksum(3, 7, 5, b"hello")
+        assert make_checksum(3, 7, 5, b"hellp") != good
+        assert make_checksum(3, 8, 5, b"hello") != good
+
+
+class TestParams:
+    def test_defaults(self):
+        p = Params()
+        assert (p.epoch_limit, p.epoch_millis, p.window_size,
+                p.max_backoff_interval) == (5, 2000, 1, 0)
+
+    def test_str(self):
+        assert str(Params()) == ("[EpochLimit: 5, EpochMillis: 2000, "
+                                 "WindowSize: 1, MaxBackOffInterval: 0]")
+
+
+class TestBitcoinCodec:
+    def test_join_golden(self):
+        assert bitcoin.new_join().to_json() == (
+            b'{"Type":0,"Data":"","Lower":0,"Upper":0,"Hash":0,"Nonce":0}')
+
+    def test_request_golden(self):
+        assert bitcoin.new_request("cmu440", 0, 9999).to_json() == (
+            b'{"Type":1,"Data":"cmu440","Lower":0,"Upper":9999,"Hash":0,"Nonce":0}')
+
+    def test_result_uint64_range(self):
+        h = (1 << 64) - 1
+        msg = bitcoin.new_result(h, 123)
+        decoded = bitcoin.Message.from_json(msg.to_json())
+        assert decoded.hash == h and decoded.nonce == 123
+
+    def test_go_html_escaping(self):
+        # Go encoding/json escapes < > & and keeps non-ASCII as raw UTF-8.
+        assert bitcoin.new_request("a<b&c>", 0, 1).to_json() == (
+            b'{"Type":1,"Data":"a\\u003cb\\u0026c\\u003e",'
+            b'"Lower":0,"Upper":1,"Hash":0,"Nonce":0}')
+        assert b'h\xc3\xa9llo' in bitcoin.new_request("héllo", 0, 1).to_json()
+
+    def test_str(self):
+        assert str(bitcoin.new_join()) == "[Join]"
+        assert str(bitcoin.new_request("m", 1, 2)) == "[Request m 1 2]"
+        assert str(bitcoin.new_result(5, 6)) == "[Result 5 6]"
+
+
+class TestHashOracle:
+    def test_known_sha256(self):
+        # sha256("cmu440 0") computed with hashlib directly.
+        import hashlib
+        expected = int.from_bytes(
+            hashlib.sha256(b"cmu440 0").digest()[:8], "big")
+        assert bitcoin.hash_op("cmu440", 0) == expected
+
+    def test_scan_min_earliest_tie(self):
+        from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+        best, argmin = scan_min("cmu440", 0, 999)
+        # Brute-force verify.
+        import hashlib
+        vals = [int.from_bytes(hashlib.sha256(f"cmu440 {i}".encode()).digest()[:8], "big")
+                for i in range(1000)]
+        assert best == min(vals)
+        assert argmin == vals.index(min(vals))
