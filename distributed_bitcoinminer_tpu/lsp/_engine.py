@@ -148,6 +148,15 @@ class Conn:
     def _on_data(self, msg: Message) -> None:
         if self.state in (ConnState.CLOSED, ConnState.LOST):
             return
+        if self.state == ConnState.CONNECTING:
+            # Data from the server implies our Connect was accepted (the
+            # explicit Ack(id, 0) was lost/delayed): establish implicitly so
+            # the ack below carries the right conn id and delivery proceeds.
+            self.conn_id = msg.conn_id
+            self.state = ConnState.UP
+            self._connect_pending = None
+            if not self.connected.done():
+                self.connected.set_result(msg.conn_id)
         # Every received data message is acked, including duplicates
         # (exactly-once delivery comes from receive-side dedup, not ack
         # suppression; ref: lsp/server_impl.go:462-470).

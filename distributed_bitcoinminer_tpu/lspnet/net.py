@@ -24,17 +24,17 @@ from .faults import DELAY_MILLIS, knobs, log, sometimes
 from . import sniff
 
 
-def _mutate_data_packet(data: bytes) -> bytes:
-    """Apply shorten/lengthen/corrupt to a Data message (ref: lspnet/conn.go:143-175)."""
+def _mutate_data_packet(data: bytes, obj: dict) -> bytes:
+    """Apply shorten/lengthen/corrupt to a Data message (ref: lspnet/conn.go:143-175).
+
+    ``obj`` is the already-parsed JSON of ``data`` (parsed once by the caller).
+    """
     shorten = sometimes(knobs.shorten_percent)
     lengthen = sometimes(knobs.lengthen_percent)
     corrupt = knobs.corrupted
     if not (shorten or lengthen or corrupt):
         return data
     try:
-        obj = json.loads(data)
-        if obj.get("Type") != 1:  # only Data messages are mutated
-            return data
         payload = bytearray(base64.b64decode(obj["Payload"]) if obj.get("Payload") else b"")
     except Exception:  # noqa: BLE001 — non-LSP traffic passes through untouched
         return data
@@ -51,11 +51,12 @@ def _mutate_data_packet(data: bytes) -> bytes:
     return json.dumps(obj, separators=(",", ":")).encode("utf-8")
 
 
-def _packet_type(data: bytes) -> int:
+def _parse_packet(data: bytes) -> tuple[int, dict | None]:
     try:
-        return int(json.loads(data).get("Type", -1))
+        obj = json.loads(data)
+        return int(obj.get("Type", -1)), obj
     except Exception:  # noqa: BLE001
-        return -1
+        return -1, None
 
 
 class _Protocol(asyncio.DatagramProtocol):
@@ -140,7 +141,7 @@ class UDPEndpoint:
         # Only pay the JSON parse when a knob or the sniffer needs the type.
         inspect = (sniff.is_sniffing() or knobs.shorten_percent
                    or knobs.lengthen_percent or knobs.corrupted)
-        mtype = _packet_type(data) if inspect else -1
+        mtype, obj = _parse_packet(data) if inspect else (-1, None)
         drop = knobs.server_write_drop if self.is_server else knobs.client_write_drop
         if sometimes(drop):
             if knobs.debug:
@@ -150,8 +151,8 @@ class UDPEndpoint:
             return
         if sniff.is_sniffing():
             sniff.record(mtype, sent=True)
-        if inspect and mtype == 1:
-            data = _mutate_data_packet(data)
+        if inspect and mtype == 1 and obj is not None:
+            data = _mutate_data_packet(data, obj)
         self._transport.sendto(data, addr)
 
     def close(self) -> None:

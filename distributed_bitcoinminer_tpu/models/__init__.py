@@ -1,0 +1,5 @@
+"""Model layer: the flagship nonce-search program and its host orchestration."""
+
+from .miner_model import NonceSearcher
+
+__all__ = ["NonceSearcher"]

@@ -1,0 +1,130 @@
+"""The flagship model: exact SHA-256 arg-min search over a nonce range.
+
+Host orchestration around :func:`ops.search.search_span` — the TPU-native
+replacement for the reference miner's scalar hot loop
+(ref: bitcoin/miner/miner.go:52-59). The "sequence axis" of this framework is
+the nonce range; it is scaled by:
+
+1. digit-class splitting (decimal width must be static per device call);
+2. aligned 10^k blocks (top digits constant -> absorbed into a host
+   midstate; k <= 9 low digits formatted on device in uint32);
+3. a device-side fori_loop scan per block (no host round-trip inside);
+4. (parallel/) mesh sharding of blocks across devices with a collective
+   lexicographic-min merge.
+
+Results are bit-identical to the Go reference, including ties (earliest
+nonce wins everywhere).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..bitcoin.hash import MAX_U64
+from ..ops.search import search_span
+from ..ops.sha256_host import sha256_midstate
+from ..ops.sha256_jnp import build_tail_template
+
+_SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
+
+
+def _digit_classes(lower: int, upper: int):
+    """Split [lower, upper] at decimal-width boundaries (static width per
+    device call). Yields (digits, lo, hi) inclusive sub-ranges."""
+    for d in range(1, 21):
+        class_lo = 0 if d == 1 else 10 ** (d - 1)
+        class_hi = 10 ** d - 1
+        lo = max(lower, class_lo)
+        hi = min(upper, class_hi)
+        if lo <= hi:
+            yield d, lo, hi
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+@dataclass
+class _BlockPlan:
+    """One aligned 10^k block of the search, ready for device dispatch."""
+    base: int          # nonce value of lane i=0 (block_base)
+    lo_i: int          # first valid lane
+    hi_i: int          # last valid lane
+    midstate: tuple    # 8 x uint32 after absorbing data + " " + top_digits
+    template: np.ndarray
+    rem: int
+    k: int
+
+
+class NonceSearcher:
+    """Exact arg-min hash search for one message, chunk-schedulable.
+
+    ``batch`` is the lane count per device step; on TPU use >= 2**20 to keep
+    the VPU busy, on CPU tests a few thousand.
+    """
+
+    def __init__(self, data: str, batch: int = 1 << 20):
+        self.data = data
+        self.batch = batch
+        self._prefix = data.encode("utf-8") + b" "
+        self._midstate_cache: dict[str, tuple] = {}
+
+    def _plan_block(self, d: int, k: int, block_base: int, lo: int, hi: int) -> _BlockPlan:
+        top = str(block_base)[: d - k] if d > k else ""
+        key = (top, k)
+        cached = self._midstate_cache.get(key)
+        if cached is None:
+            prefix = self._prefix + top.encode("ascii")
+            midstate, tail = sha256_midstate(prefix)
+            template = build_tail_template(tail, k, len(prefix) + k)
+            cached = (midstate, template, len(tail))
+            self._midstate_cache[key] = cached
+        midstate, template, rem = cached
+        return _BlockPlan(
+            base=block_base,
+            lo_i=max(lo, block_base) - block_base,
+            hi_i=min(hi, block_base + 10 ** k - 1) - block_base,
+            midstate=midstate, template=template, rem=rem, k=k)
+
+    def plan(self, lower: int, upper: int):
+        """All aligned blocks covering [lower, upper], ascending."""
+        for d, lo, hi in _digit_classes(lower, upper):
+            k = min(d, 9)
+            span = 10 ** k
+            base = (lo // span) * span
+            while base <= hi:
+                yield self._plan_block(d, k, base, lo, hi)
+                base += span
+
+    def search_block(self, plan: _BlockPlan):
+        """Dispatch one block; returns (hi, lo, idx) device scalars."""
+        window = plan.hi_i - plan.lo_i + 1
+        nbatches = _pow2_ceil((window + self.batch - 1) // self.batch)
+        i0 = (plan.lo_i // self.batch) * self.batch
+        return search_span(
+            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+            np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+            rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
+
+    def search(self, lower: int, upper: int) -> tuple[int, int]:
+        """Exact (min_hash, argmin_nonce) over the inclusive range.
+
+        Dispatches every block asynchronously, then merges on host in
+        ascending order (strict less keeps the earliest nonce on ties).
+        """
+        if lower > upper:
+            raise ValueError("empty range")
+        results = [(plan.base, self.search_block(plan))
+                   for plan in self.plan(lower, upper)]
+        best_hash, best_nonce = MAX_U64, lower
+        seen = False
+        for base, (hi, lo, idx) in results:
+            hi, lo, idx = int(hi), int(lo), int(idx)
+            if (hi, lo) == _SENTINEL and idx == 0xFFFFFFFF:
+                continue
+            h = (hi << 32) | lo
+            if not seen or h < best_hash:
+                best_hash, best_nonce, seen = h, base + idx, True
+        return best_hash, best_nonce

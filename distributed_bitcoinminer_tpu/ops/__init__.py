@@ -1,0 +1,19 @@
+"""Compute kernels: SHA-256 arg-min search, TPU-first.
+
+Three tiers, all bit-identical to the host oracle
+(``distributed_bitcoinminer_tpu.bitcoin.hash_op``):
+
+- ``sha256_host``: pure-Python compression, used for midstates and tiny edges;
+- ``sha256_jnp``: jitted, lane-vectorized jnp implementation;
+- ``sha256_pallas``: Pallas TPU kernel with blockwise grid + fused argmin.
+"""
+
+from .sha256_host import sha256_midstate, compress_host, SHA256_H0, SHA256_K
+from .sha256_jnp import (
+    build_tail_template, chunk_search_fn, lex_argmin, digit_positions,
+)
+
+__all__ = [
+    "sha256_midstate", "compress_host", "SHA256_H0", "SHA256_K",
+    "build_tail_template", "chunk_search_fn", "lex_argmin", "digit_positions",
+]

@@ -1,0 +1,78 @@
+"""Device-side chunked scan over a nonce span with an argmin carry.
+
+One jitted dispatch covers a whole aligned 10^k block: a ``lax.fori_loop``
+walks the span in ``batch``-lane steps, each step hashing its lanes and
+folding into a running (hash_hi, hash_lo, index) best. Strict ``<`` keeps
+the earliest index across steps, matching the Go scan's tie rule
+(ref: bitcoin/miner/miner.go:54-58).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha256_host import SHA256_K
+from .sha256_jnp import _compress, digit_positions, lex_argmin
+
+_MAX_U32 = np.uint32(0xFFFFFFFF)
+
+
+def _hash_lanes(midstate, template, i, rem: int, k: int):
+    """Hash a lane vector of low-digit offsets; returns (hi, lo) uint32."""
+    contrib: dict[tuple[int, int], jax.Array] = {}
+    for j, (blk, word, shift) in enumerate(digit_positions(rem, k)):
+        div = np.uint32(10 ** (k - 1 - j))
+        digit = (i // div) % np.uint32(10) + np.uint32(48)
+        key = (blk, word)
+        add = digit << np.uint32(shift)
+        contrib[key] = contrib[key] + add if key in contrib else add
+
+    state = tuple(jnp.broadcast_to(midstate[r], i.shape) for r in range(8))
+    for blk in range(template.shape[0]):
+        w16 = []
+        for word in range(16):
+            base = jnp.broadcast_to(template[blk, word], i.shape)
+            if (blk, word) in contrib:
+                base = base | contrib[(blk, word)]
+            w16.append(base)
+        state = _compress(state, w16)
+    return state[0], state[1]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rem", "k", "batch", "nbatches"))
+def search_span(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
+                batch: int, nbatches: int):
+    """Scan lanes ``i0 + [0, nbatches*batch)`` masked to [lo_i, hi_i].
+
+    Returns (best_hi, best_lo, best_i) uint32 scalars; all-invalid spans
+    return the (0xffffffff, 0xffffffff, 0xffffffff) sentinel.
+    """
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+    lane = jnp.arange(batch, dtype=jnp.uint32)
+
+    def step(j, best):
+        i = i0 + j.astype(jnp.uint32) * np.uint32(batch) + lane
+        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k)
+        valid = (i >= lo_i) & (i <= hi_i)
+        hi_h = jnp.where(valid, hi_h, _MAX_U32)
+        lo_h = jnp.where(valid, lo_h, _MAX_U32)
+        idx = jnp.where(valid, i, _MAX_U32)
+        c_hi, c_lo, c_i = lex_argmin(hi_h, lo_h, idx)
+        b_hi, b_lo, b_i = best
+        # Strict less => the earlier batch keeps ties (Go first-seen-wins).
+        better = (c_hi < b_hi) | ((c_hi == b_hi) & (c_lo < b_lo))
+        return (jnp.where(better, c_hi, b_hi),
+                jnp.where(better, c_lo, b_lo),
+                jnp.where(better, c_i, b_i))
+
+    init = (_MAX_U32, _MAX_U32, _MAX_U32)
+    if nbatches == 1:
+        return step(jnp.uint32(0), init)
+    return jax.lax.fori_loop(0, nbatches, step, init,
+                             unroll=False)

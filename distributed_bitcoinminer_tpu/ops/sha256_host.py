@@ -1,0 +1,81 @@
+"""Host-side SHA-256 primitives: constants, pure-Python compression, midstates.
+
+The midstate trick is the core of the TPU design: the searched message is
+``data + " " + ascii_decimal(nonce)``, so for any fixed prefix all complete
+64-byte blocks can be absorbed ONCE on the host; the device kernel only
+processes the final one or two blocks where the nonce digits live. hashlib
+does not expose internal state, hence this small implementation.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_M32 = 0xFFFFFFFF
+
+# FIPS 180-4 round constants (first 32 bits of cube roots of primes 2..311).
+SHA256_K = (
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+)
+
+# Initial hash state (first 32 bits of square roots of primes 2..19).
+SHA256_H0 = (0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+             0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19)
+
+
+def _rotr(x: int, n: int) -> int:
+    return ((x >> n) | (x << (32 - n))) & _M32
+
+
+def compress_host(state: tuple, block: bytes) -> tuple:
+    """One SHA-256 compression round over a 64-byte block."""
+    assert len(block) == 64
+    w = list(struct.unpack(">16I", block))
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> 3)
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> 10)
+        w.append((w[t - 16] + s0 + w[t - 7] + s1) & _M32)
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g & _M32)
+        t1 = (h + s1 + ch + SHA256_K[t] + w[t]) & _M32
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj) & _M32
+        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & _M32, c, b, a, (t1 + t2) & _M32
+    return tuple((x + y) & _M32 for x, y in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+def sha256_midstate(prefix: bytes) -> tuple[tuple, bytes]:
+    """Absorb all complete 64-byte blocks of ``prefix``.
+
+    Returns (state after full blocks, remaining tail bytes). The caller
+    appends the nonce digits + padding to the tail and finishes on device.
+    """
+    state = SHA256_H0
+    full = len(prefix) - (len(prefix) % 64)
+    for off in range(0, full, 64):
+        state = compress_host(state, prefix[off:off + 64])
+    return state, prefix[full:]
+
+
+def sha256_finish_host(state: tuple, tail: bytes, total_len: int) -> bytes:
+    """Finish a hash from a midstate (host oracle for the device path)."""
+    padded = tail + b"\x80"
+    pad_blocks = 1 if len(padded) + 8 <= 64 else 2
+    padded = padded.ljust(pad_blocks * 64 - 8, b"\x00")
+    padded += struct.pack(">Q", total_len * 8)
+    for off in range(0, len(padded), 64):
+        state = compress_host(state, padded[off:off + 64])
+    return struct.pack(">8I", *state)

@@ -1,0 +1,146 @@
+"""Lane-vectorized SHA-256 arg-min search, jnp tier.
+
+TPU-first design (replaces the reference's scalar hot loop,
+ref: bitcoin/miner/miner.go:52-59 + bitcoin/hash.go:13-17):
+
+- The search range is split on the host into chunks that live inside one
+  aligned ``10^k`` block, so every nonce in a device call shares its top
+  decimal digits. Those top digits join the constant prefix
+  ``data + " " + top_digits`` whose complete 64-byte SHA blocks are absorbed
+  into a host midstate; only the final 1-2 blocks run on device.
+- A device call hashes a dense lane vector ``i = i0 + arange(B)`` of low-digit
+  offsets (``i < 10^k <= 10^9`` fits uint32), formats the k ASCII digits in
+  registers, runs the 64-round compression fully vectorized in uint32, and
+  reduces to an exact lexicographic (hash_hi, hash_lo, index) arg-min.
+- uint64 never materializes on device: the 8-byte big-endian hash prefix is
+  carried as two uint32 lanes; ties resolve to the lowest index, matching the
+  Go scan's first-seen-wins strict ``<``.
+
+Everything is static-shaped; one compilation per (rem, k, nblocks, batch)
+signature, reused across the whole search.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha256_host import SHA256_H0, SHA256_K
+
+_MAX_U32 = np.uint32(0xFFFFFFFF)
+
+
+def digit_positions(rem: int, k: int) -> list[tuple[int, int, int]]:
+    """Static placement of the k ASCII digit bytes inside the tail blocks.
+
+    Digit j (most significant first) sits at byte ``rem + j``; returns
+    (block, word, shift) per digit for big-endian uint32 word packing.
+    """
+    out = []
+    for j in range(k):
+        pos = rem + j
+        out.append((pos // 64, (pos % 64) // 4, (3 - pos % 4) * 8))
+    return out
+
+
+def build_tail_template(tail: bytes, k: int, total_len: int) -> np.ndarray:
+    """Padded final block(s) as (nblocks, 16) uint32, digit bytes zeroed.
+
+    ``tail`` is the prefix remainder (< 64 bytes); the k digit bytes follow
+    it, then 0x80, zero padding, and the 64-bit message bit length.
+    """
+    rem = len(tail)
+    msg_len = rem + k
+    data = bytearray(tail) + bytes(k)  # digit positions left as 0
+    data.append(0x80)
+    nblocks = 1 if msg_len + 1 + 8 <= 64 else 2
+    data = data.ljust(nblocks * 64 - 8, b"\x00")
+    data += int(total_len * 8).to_bytes(8, "big")
+    words = np.frombuffer(bytes(data), dtype=">u4").astype(np.uint32)
+    return words.reshape(nblocks, 16)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state, w16):
+    """One vectorized compression round. state: 8 arrays; w16: 16 arrays."""
+    w = list(w16)
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(SHA256_K[t]) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    return tuple(s + v for s, v in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+def lex_argmin(hi, lo, idx):
+    """Exact argmin over (hi, lo) uint32 pairs; lowest idx wins ties."""
+    min_hi = jnp.min(hi)
+    on_hi = hi == min_hi
+    min_lo = jnp.min(jnp.where(on_hi, lo, _MAX_U32))
+    on_both = on_hi & (lo == min_lo)
+    min_idx = jnp.min(jnp.where(on_both, idx, _MAX_U32))
+    return min_hi, min_lo, min_idx
+
+
+@functools.partial(jax.jit, static_argnames=("rem", "k", "batch"))
+def _search_chunk(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
+                  batch: int):
+    """Search lanes ``i0 + [0, batch)``; valid window is [lo_i, hi_i].
+
+    midstate: (8,) uint32 after absorbing the full prefix blocks.
+    template: (nblocks, 16) uint32 padded tail with digit bytes zeroed.
+    Returns (min_hi, min_lo, argmin_i) uint32 scalars; invalid lanes carry
+    the sentinel (0xffffffff, 0xffffffff, 0xffffffff).
+    """
+    i = i0 + jnp.arange(batch, dtype=jnp.uint32)
+    nblocks = template.shape[0]
+
+    # ASCII digit contributions, placed at their static byte positions.
+    contrib: dict[tuple[int, int], jax.Array] = {}
+    for j, (blk, word, shift) in enumerate(digit_positions(rem, k)):
+        div = np.uint32(10 ** (k - 1 - j))
+        digit = (i // div) % np.uint32(10) + np.uint32(48)
+        key = (blk, word)
+        add = digit << np.uint32(shift)
+        contrib[key] = contrib[key] + add if key in contrib else add
+
+    state = tuple(jnp.broadcast_to(midstate[r], i.shape) for r in range(8))
+    for blk in range(nblocks):
+        w16 = []
+        for word in range(16):
+            base = jnp.broadcast_to(template[blk, word], i.shape)
+            if (blk, word) in contrib:
+                base = base | contrib[(blk, word)]
+            w16.append(base)
+        state = _compress(state, w16)
+
+    valid = (i >= lo_i) & (i <= hi_i)
+    hi_h = jnp.where(valid, state[0], _MAX_U32)
+    lo_h = jnp.where(valid, state[1], _MAX_U32)
+    idx = jnp.where(valid, i, _MAX_U32)
+    return lex_argmin(hi_h, lo_h, idx)
+
+
+def chunk_search_fn(rem: int, k: int, batch: int):
+    """Bind the static signature; returns f(midstate, template, i0, lo, hi)."""
+    def run(midstate, template, i0, lo_i, hi_i):
+        return _search_chunk(
+            jnp.asarray(midstate, dtype=jnp.uint32),
+            jnp.asarray(template, dtype=jnp.uint32),
+            jnp.uint32(i0), jnp.uint32(lo_i), jnp.uint32(hi_i),
+            rem=rem, k=k, batch=batch)
+    return run

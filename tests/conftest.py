@@ -14,7 +14,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Persistent XLA compilation cache: the SHA-256 search graph is large and
+# compiles per (rem, k, nbatches, batch) signature; cache makes re-runs fast.
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest
 

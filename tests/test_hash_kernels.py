@@ -1,0 +1,117 @@
+"""Compute-kernel equivalence: every tier must be bit-identical to hashlib.
+
+The oracle is ``bitcoin.hash_op`` (= first 8 bytes of
+sha256(f"{data} {nonce}") big-endian, ref: bitcoin/hash.go:13-17).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op, scan_min
+from distributed_bitcoinminer_tpu.models import NonceSearcher
+from distributed_bitcoinminer_tpu.ops.sha256_host import (
+    SHA256_H0, compress_host, sha256_finish_host, sha256_midstate)
+
+
+class TestHostSha256:
+    @pytest.mark.parametrize("msg", [b"", b"abc", b"cmu440 0",
+                                     b"x" * 55, b"y" * 56, b"z" * 64,
+                                     b"w" * 119, b"v" * 120, b"u" * 500])
+    def test_matches_hashlib(self, msg):
+        midstate, tail = sha256_midstate(msg)
+        digest = sha256_finish_host(midstate, tail, len(msg))
+        assert digest == hashlib.sha256(msg).digest()
+
+    def test_compress_is_incremental(self):
+        msg = bytes(range(256))
+        midstate, tail = sha256_midstate(msg)
+        assert len(tail) == 0
+        state = SHA256_H0
+        for off in range(0, 256, 64):
+            state = compress_host(state, msg[off:off + 64])
+        assert state == midstate
+
+
+class TestDeviceSearch:
+    def test_small_range_matches_oracle(self):
+        searcher = NonceSearcher("cmu440", batch=512)
+        got_hash, got_nonce = searcher.search(0, 9999)
+        want_hash, want_nonce = scan_min("cmu440", 0, 9999)
+        assert (got_hash, got_nonce) == (want_hash, want_nonce)
+
+    def test_range_not_from_zero(self):
+        searcher = NonceSearcher("hello world", batch=256)
+        got = searcher.search(123, 4567)
+        want = scan_min("hello world", 123, 4567)
+        assert got == want
+
+    def test_range_spanning_digit_classes(self):
+        # 8..1042 crosses the 1/2/3/4-digit boundaries.
+        searcher = NonceSearcher("digit boundaries", batch=128)
+        got = searcher.search(8, 1042)
+        want = scan_min("digit boundaries", 8, 1042)
+        assert got == want
+
+    def test_large_nonces_use_top_digit_midstate(self):
+        # d > 9: top digits absorbed in the midstate, k=9 low digits on device.
+        base = 12_345_678_901  # 11 digits
+        searcher = NonceSearcher("bigvals", batch=256)
+        got = searcher.search(base, base + 2000)
+        want = scan_min("bigvals", base, base + 2000)
+        assert got == want
+
+    def test_block_boundary_crossing(self):
+        # Crosses an aligned 10^3... actually 10^9 is too big for a test;
+        # cross the 10^k alignment inside one digit class: 999_990..1_000_010
+        # crosses the 6->7 digit boundary AND the aligned block edge.
+        searcher = NonceSearcher("edge", batch=64)
+        got = searcher.search(999_990, 1_000_010)
+        want = scan_min("edge", 999_990, 1_000_010)
+        assert got == want
+
+    def test_long_data_multiblock_prefix(self):
+        # Prefix > 64 bytes: midstate absorbs full blocks; tail + digits may
+        # straddle two device blocks.
+        data = "m" * 100
+        searcher = NonceSearcher(data, batch=128)
+        got = searcher.search(0, 3000)
+        want = scan_min(data, 0, 3000)
+        assert got == want
+
+    @pytest.mark.parametrize("tail_len", [53, 54, 55, 56, 63])
+    def test_tail_pad_boundaries(self, tail_len):
+        # rem + k near the one-vs-two-block padding boundary.
+        data = "a" * (tail_len - 1)  # prefix = data + " " => rem = tail_len
+        searcher = NonceSearcher(data, batch=64)
+        got = searcher.search(0, 500)
+        want = scan_min(data, 0, 500)
+        assert got == want
+
+    def test_single_nonce_range(self):
+        searcher = NonceSearcher("one", batch=64)
+        got = searcher.search(42, 42)
+        assert got == (hash_op("one", 42), 42)
+
+    def test_earliest_nonce_wins_ties(self):
+        # Force a tie by duplicating: can't easily force SHA ties, but the
+        # merge path is covered: equal hashes across batches keep the lower
+        # nonce by strict-less merge. Verify via oracle over a range where
+        # batch boundaries fall inside (batch smaller than range).
+        searcher = NonceSearcher("tie-check", batch=32)
+        got = searcher.search(0, 2047)
+        want = scan_min("tie-check", 0, 2047)
+        assert got == want
+
+    def test_empty_data_string(self):
+        searcher = NonceSearcher("", batch=64)
+        got = searcher.search(0, 999)
+        want = scan_min("", 0, 999)
+        assert got == want
+
+    def test_unicode_data(self):
+        searcher = NonceSearcher("héllo wörld", batch=64)
+        got = searcher.search(0, 999)
+        want = scan_min("héllo wörld", 0, 999)
+        assert got == want

@@ -57,6 +57,42 @@ class TestConnect:
         asyncio.run(scenario())
 
 
+class TestImplicitEstablish:
+    def test_data_during_connecting_establishes_and_delivers(self):
+        """Connect-ack lost, but server Data arrives first: the client must
+        establish implicitly and deliver the message exactly once (regression:
+        data consumed-but-undelivered while CONNECTING)."""
+        async def scenario():
+            import distributed_bitcoinminer_tpu.lspnet as lspnet
+            params = params_with(epoch_ms=500, limit=10)
+            server = await new_async_server(0, params)
+            lspnet.set_server_write_drop_percent(100)  # connect ack vanishes
+
+            connect_task = asyncio.create_task(
+                new_async_client(f"127.0.0.1:{server.port}", params))
+            # Wait until the server has seen the Connect (conn exists).
+            for _ in range(100):
+                if server._conns:
+                    break
+                await asyncio.sleep(0.01)
+            assert server._conns, "server never saw the Connect"
+            conn_id = next(iter(server._conns))
+            lspnet.set_server_write_drop_percent(0)
+            server.write(conn_id, b"early bird")
+
+            client = await asyncio.wait_for(connect_task, 5)
+            assert client.conn_id() == conn_id
+            got = await asyncio.wait_for(client.read(), 5)
+            assert got == b"early bird"
+            # Exactly once: nothing further pending.
+            client.write(b"reply")
+            _, payload = await asyncio.wait_for(server.read(), 5)
+            assert payload == b"reply"
+            await client.close()
+            await server.close()
+        asyncio.run(scenario())
+
+
 class TestClientClose:
     def test_close_flushes_pending_writes(self):
         """Writes issued immediately before Close must still arrive
