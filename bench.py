@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Headline benchmark: nonce-search throughput of one TPU miner.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "nonces/sec", "vs_baseline": N}``.
+
+The reference publishes no numbers (see BASELINE.md); the baseline is the
+structural estimate of the Go miner's single-threaded hot loop
+(ref: bitcoin/miner/miner.go:53-59 — one stdlib sha256 + string format per
+nonce), taken at the generous top of its 10^6-10^7 nonces/s envelope.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+GO_MINER_BASELINE_NPS = 1.0e7  # upper structural estimate, BASELINE.md
+
+
+def main() -> None:
+    import jax
+
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+    from distributed_bitcoinminer_tpu.models import (
+        NonceSearcher, ShardedNonceSearcher)
+    from distributed_bitcoinminer_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    on_accel = devices[0].platform != "cpu"
+    batch = (1 << 20) if on_accel else (1 << 13)
+    upper = ((1 << 26) - 1) if on_accel else ((1 << 18) - 1)
+    data = "cmu440"
+
+    if len(devices) > 1:
+        searcher = ShardedNonceSearcher(data, batch=batch,
+                                        mesh=make_mesh(len(devices)))
+    else:
+        searcher = NonceSearcher(data, batch=batch)
+
+    # Correctness gate on a small range before timing.
+    small = searcher.search(0, 9999)
+    oracle = scan_min(data, 0, 9999)
+    assert small == oracle, f"bench correctness gate failed: {small} != {oracle}"
+
+    # Warm-up pass compiles every (rem, k, nbatches) signature of the range.
+    t0 = time.time()
+    searcher.search(0, upper)
+    warm_s = time.time() - t0
+
+    t0 = time.time()
+    best_hash, best_nonce = searcher.search(0, upper)
+    dt = time.time() - t0
+    rate = (upper + 1) / dt
+
+    print(json.dumps({
+        "metric": "nonce_search_throughput",
+        "value": round(rate, 1),
+        "unit": "nonces/sec",
+        "vs_baseline": round(rate / GO_MINER_BASELINE_NPS, 3),
+        "detail": {
+            "devices": len(devices),
+            "platform": devices[0].platform,
+            "range": upper + 1,
+            "batch": batch,
+            "search_s": round(dt, 3),
+            "warmup_s": round(warm_s, 3),
+            "min_hash": best_hash,
+            "argmin_nonce": best_nonce,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""The three-role distributed application: scheduler, miner worker, client.
+
+TPU-first split of the reference Part B (ref: bitcoin/server, bitcoin/miner,
+bitcoin/client): the scheduler and wire protocol are host-side asyncio actors
+speaking byte-compatible LSP; the miner's hot loop is the mesh-sharded JAX
+search program from ``models``/``parallel``. Scheduling semantics (FIFO queue,
+one request in flight, even split with remainder-to-first, argmin merge,
+miner-drop reassignment, client-drop cancellation) match the reference
+exactly — including its inclusive/exclusive bound quirk, see ``scheduler.py``.
+"""
+
+from .client import printable_result, submit
+from .miner import MinerWorker
+from .scheduler import Scheduler
+
+__all__ = ["Scheduler", "MinerWorker", "submit", "printable_result"]

@@ -1,0 +1,69 @@
+"""The request client: submit one nonce range, await the merged Result.
+
+Same contract as the reference submitter (ref: bitcoin/client/client.go):
+write Request(message, 0, maxNonce), block on Read, report
+``Result <hash> <nonce>`` or ``Disconnected`` when the connection is lost.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..bitcoin.message import Message, MsgType, new_request
+from ..lsp.client import new_async_client
+from ..lsp.errors import LspError
+from ..lsp.params import Params
+
+
+async def submit(hostport: str, message: str, max_nonce: int,
+                 params: Optional[Params] = None) -> Optional[Tuple[int, int]]:
+    """Submit and await one request; None means the connection was lost."""
+    client = await new_async_client(hostport, params)
+    client.write(new_request(message, 0, max_nonce).to_json())
+    try:
+        payload = await client.read()
+    except LspError:
+        return None
+    finally:
+        await client.close()
+    msg = Message.from_json(payload)
+    if msg.type != MsgType.RESULT:
+        return None
+    return msg.hash, msg.nonce
+
+
+def printable_result(result: Optional[Tuple[int, int]]) -> str:
+    """Exact stdout contract of the reference (client.go:61-68)."""
+    if result is None:
+        return "Disconnected"
+    return f"Result {result[0]} {result[1]}"
+
+
+def main(argv=None) -> int:
+    """CLI contract of the reference binary (ref: client.go:24-58):
+    ``client <hostport> <message> <maxNonce>``."""
+    import asyncio
+    import sys
+    argv = sys.argv if argv is None else argv
+    if len(argv) != 4:
+        print(f"Usage: ./{argv[0]} <hostport> <message> <maxNonce>", end="")
+        return 1
+    try:
+        max_nonce = int(argv[3])
+        if max_nonce < 0:
+            raise ValueError
+    except ValueError:
+        print(f"{argv[3]} is not a number.")
+        return 1
+    try:
+        result = asyncio.run(submit(argv[1], argv[2], max_nonce))
+    except LspError as exc:
+        print("Failed to connect to server:", exc)
+        return 1
+    print(printable_result(result))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,171 @@
+"""The TPU miner worker: an LSP client wrapped around the device search.
+
+Replaces the reference worker's scalar hot loop (ref: bitcoin/miner/miner.go)
+with the chunk-scheduled JAX program from ``models``: Join, then loop
+{read Request -> device arg-min search -> write Result}, exiting silently on
+transport errors exactly like the reference (miner.go:40-44, 63-66).
+
+The device search runs in a worker thread so the asyncio loop keeps serving
+LSP heartbeats/acks while the TPU is busy; JAX dispatch is thread-safe.
+
+Bound parity: the received ``Upper`` is treated as INCLUSIVE even though the
+scheduler computed it as an exclusive end — the reference miner does the same
+(miner.go:51-52), so each chunk scans one extra nonce.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..bitcoin.hash import MAX_U64
+from ..bitcoin.message import Message, MsgType, new_join, new_result
+from ..lsp.client import AsyncClient, new_async_client
+from ..lsp.errors import LspError
+from ..lsp.params import Params
+
+logger = logging.getLogger("dbm.miner")
+
+
+class HostSearcher:
+    """Device-free fallback: the host oracle scan (ref miner semantics)."""
+
+    def __init__(self, data: str):
+        self.data = data
+
+    def search(self, lower: int, upper: int):
+        from ..bitcoin.hash import scan_min
+        return scan_min(self.data, lower, upper)
+
+
+def default_searcher_factory(data: str, batch: Optional[int] = None):
+    """Pick the widest available compute plane for ``data``.
+
+    Multi-device -> mesh-sharded search; single device -> plain chunked scan;
+    ``DBM_COMPUTE=host`` -> pure-host scan (no JAX), for boxes without
+    accelerators and for process-level tests.
+    """
+    import os
+
+    if os.environ.get("DBM_COMPUTE") == "host":
+        return HostSearcher(data)
+
+    import jax
+
+    from ..models import NonceSearcher, ShardedNonceSearcher
+    from ..parallel import make_mesh
+
+    devices = jax.devices()
+    if batch is None:
+        batch = (1 << 20) if devices[0].platform != "cpu" else (1 << 12)
+    if len(devices) > 1:
+        return ShardedNonceSearcher(data, batch=batch, mesh=make_mesh())
+    return NonceSearcher(data, batch=batch)
+
+
+class MinerWorker:
+    """One miner process: joins the scheduler and serves search requests."""
+
+    # Searchers kept per message string; LRU-bounded so a stream of distinct
+    # messages can't grow device/midstate caches without bound.
+    SEARCHER_CACHE_SIZE = 4
+
+    def __init__(self, hostport: str, params: Optional[Params] = None,
+                 searcher_factory: Callable = default_searcher_factory,
+                 batch: Optional[int] = None):
+        self.hostport = hostport
+        self.params = params
+        self.searcher_factory = searcher_factory
+        self.batch = batch
+        self._searchers: OrderedDict[str, object] = OrderedDict()
+        self.client: Optional[AsyncClient] = None
+        self.jobs_done = 0
+
+    async def join(self) -> None:
+        """Connect and send Join (ref: miner.go:24-34)."""
+        self.client = await new_async_client(self.hostport, self.params)
+        self.client.write(new_join().to_json())
+
+    async def run(self) -> None:
+        """Serve Requests until the connection dies (silent exit, like ref)."""
+        if self.client is None:
+            await self.join()
+        while True:
+            try:
+                payload = await self.client.read()
+            except LspError:
+                return
+            try:
+                msg = Message.from_json(payload)
+            except ValueError:
+                continue
+            if msg.type != MsgType.REQUEST:
+                continue
+            # Compute off-loop so LSP heartbeats keep flowing mid-search.
+            try:
+                best_hash, best_nonce = await asyncio.to_thread(
+                    self._search, msg.data, msg.lower, msg.upper)
+            except Exception:
+                # A compute failure must not kill the worker (the scheduler
+                # would reassign the same poisoned chunk pool-wide); answer
+                # with the empty-scan sentinel instead.
+                logger.exception("search failed for %r [%d, %d]",
+                                 msg.data, msg.lower, msg.upper)
+                best_hash, best_nonce = MAX_U64, 0
+            try:
+                self.client.write(new_result(best_hash, best_nonce).to_json())
+            except LspError:
+                return
+            self.jobs_done += 1
+
+    def _search(self, data: str, lower: int, upper: int) -> tuple[int, int]:
+        if lower > upper:
+            # The Go miner's loop body never runs for an inverted range and
+            # it reports (maxUint, 0) (ref: miner.go:46-59); match that
+            # instead of letting the searcher raise.
+            return (MAX_U64, 0)
+        searcher = self._searchers.get(data)
+        if searcher is None:
+            searcher = self.searcher_factory(data, self.batch)
+            self._searchers[data] = searcher
+            while len(self._searchers) > self.SEARCHER_CACHE_SIZE:
+                self._searchers.popitem(last=False)
+        else:
+            self._searchers.move_to_end(data)
+        return searcher.search(lower, upper)
+
+    async def close(self) -> None:
+        if self.client is not None:
+            await self.client.close()
+
+
+async def _run_miner(hostport: str) -> int:
+    worker = MinerWorker(hostport)
+    try:
+        await worker.join()
+    except LspError as exc:
+        print("Failed to join with server:", exc)
+        return 1
+    try:
+        await worker.run()
+    finally:
+        await worker.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    """CLI contract of the reference binary (ref: miner.go:70-77):
+    ``miner <hostport>``; exits silently when the connection dies."""
+    import sys
+    argv = sys.argv if argv is None else argv
+    if len(argv) != 2:
+        print(f"Usage: ./{argv[0]} <hostport>", end="")
+        return 1
+    return asyncio.run(_run_miner(argv[1]))
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

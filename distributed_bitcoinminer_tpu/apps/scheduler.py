@@ -1,0 +1,246 @@
+"""The scheduler: shard nonce ranges over an elastic miner pool, merge argmins.
+
+Faithful state machine of the reference coordinator
+(ref: bitcoin/server/server.go:19-403), as one asyncio actor instead of
+channel-coupled goroutines:
+
+- FIFO request queue, ONE request in flight at a time (deliberate reference
+  simplification — no pipeline parallelism).
+- ``load_balance``: bounds become exclusive (``upper += 1``); even split
+  ``total // num_miners`` with the remainder given to the FIRST miner; when
+  there are more miners than nonces, only ``total`` miners get 1-nonce chunks
+  (ref: server.go:165-205).
+- Bound quirk preserved for bit parity: chunks are sent with EXCLUSIVE upper
+  bounds but the miner treats ``Upper`` as inclusive (ref: miner.go:51-52),
+  so each chunk scans one extra nonce and the system as a whole scans
+  ``[0, maxNonce+1]``.
+- Result merge: strict ``<`` on the uint64 hash; barrier releases the Result
+  to the client when every chunk of the request has been answered
+  (ref: server.go:257-325).
+- Miner drop: reassign its unanswered chunks to available miners, else park
+  them; parked chunks are re-issued when a miner joins or frees up
+  (ref: server.go:326-376, 222-244, 285-304).
+- Client drop: the in-flight request is cancelled immediately — miners are
+  freed, parked chunks cleared, the next queued request starts.
+
+Bookkeeping divergence from the reference (deliberate): the reference tracks
+one recorded chunk per miner plus a positional ``responsibleMiners`` list,
+which deadlocks or double-counts in several reachable states — a parked chunk
+whose client drops stalls every later request (server.go:377-400 never
+releases the barrier); a freed miner re-assigned before flushing its previous
+Result leaks that stale Result into the new request; an idle miner dropping
+reassigns a stale chunk from an older request (server.go:339-370). Here every
+Request written to a miner pushes a full chunk record onto that miner's
+pending FIFO; since miners answer sequentially over in-order exactly-once
+LSP, each arriving Result pops exactly the chunk it answers, so stale Results
+are identified precisely, and a dead miner's unanswered chunks are recovered
+individually. The observable contract (assignment order, chunk boundaries,
+merge rule, one-in-flight FIFO scheduling) is unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..bitcoin.hash import MAX_U64
+from ..bitcoin.message import Message, MsgType, new_request, new_result
+from ..lsp.errors import LspError
+from ..lsp.server import AsyncServer
+
+logger = logging.getLogger("dbm.scheduler")
+
+
+@dataclass
+class Chunk:
+    job_id: int
+    data: str
+    lower: int
+    upper: int              # exclusive end, as sent on the wire
+
+
+@dataclass
+class MinerState:
+    conn_id: int
+    available: bool = True
+    # Every Request written to this miner, in write order (see module doc).
+    pending: list = field(default_factory=list)
+
+
+@dataclass
+class Request:
+    conn_id: int
+    data: str
+    lower: int
+    upper: int              # inclusive on arrival; +1 at load_balance
+    job_id: int = 0
+    num_chunks: int = 0
+    min_hash: int = MAX_U64
+    min_nonce: int = 0
+    total_responses: int = 0
+
+
+class Scheduler:
+    """Single-actor scheduler over an :class:`AsyncServer`."""
+
+    def __init__(self, server: AsyncServer):
+        self.server = server
+        self.miners: list[MinerState] = []      # join order, like minersArray
+        self.parked: list[Chunk] = []           # chunks of dropped miners
+        self.queue: list[Request] = []
+        self.current: Optional[Request] = None
+        self._next_job_id = 0
+
+    # ------------------------------------------------------------- main loop
+
+    async def run(self) -> None:
+        """Serve until the LSP server is closed."""
+        while True:
+            try:
+                conn_id, payload = await self.server.read()
+            except LspError:
+                return
+            if isinstance(payload, Exception):
+                self._on_drop(conn_id)
+                continue
+            try:
+                msg = Message.from_json(payload)
+            except ValueError:
+                continue
+            if msg.type == MsgType.JOIN:
+                self._on_join(conn_id)
+            elif msg.type == MsgType.REQUEST:
+                self._on_request(conn_id, msg)
+            elif msg.type == MsgType.RESULT:
+                self._on_result(conn_id, msg)
+
+    # ---------------------------------------------------------------- events
+
+    def _on_request(self, conn_id: int, msg: Message) -> None:
+        request = Request(conn_id=conn_id, data=msg.data,
+                          lower=msg.lower, upper=msg.upper)
+        if not self.queue and self.current is None and self.miners:
+            self._load_balance(request)
+        else:
+            self.queue.append(request)
+
+    def _on_join(self, conn_id: int) -> None:
+        miner = MinerState(conn_id=conn_id)
+        # A joining miner immediately absorbs one parked chunk, if any
+        # (ref: server.go:222-244).
+        if self.parked:
+            self._assign_chunk(miner, self.parked.pop(0))
+        self.miners.append(miner)
+        if self.current is None and self.queue:
+            self._load_balance(self.queue.pop(0))
+
+    def _on_result(self, conn_id: int, msg: Message) -> None:
+        miner = self._find_miner(conn_id)
+        if miner is None or not miner.pending:
+            return
+        chunk = miner.pending.pop(0)   # the Result answers the oldest Request
+        miner.available = not miner.pending
+        curr = self.current
+        if curr is None or chunk.job_id != curr.job_id:
+            return  # stale Result for a cancelled/finished request
+        if msg.hash < curr.min_hash:
+            curr.min_hash = msg.hash
+            curr.min_nonce = msg.nonce
+        curr.total_responses += 1
+        # A freed miner immediately absorbs one parked chunk
+        # (ref: server.go:285-304).
+        if self.parked and miner.available:
+            self._assign_chunk(miner, self.parked.pop(0))
+        if curr.total_responses == curr.num_chunks:
+            self._write(curr.conn_id,
+                        new_result(curr.min_hash, curr.min_nonce))
+            self.current = None
+            if self.queue:
+                self._load_balance(self.queue.pop(0))
+
+    def _on_drop(self, conn_id: int) -> None:
+        miner = self._find_miner(conn_id)
+        if miner is not None:
+            logger.info("miner %d dropped", conn_id)
+            self.miners.remove(miner)
+            curr = self.current
+            if curr is None:
+                return
+            # Recover every unanswered chunk of the current request
+            # (ref: server.go:326-376, single-chunk version).
+            for chunk in miner.pending:
+                if chunk.job_id != curr.job_id:
+                    continue
+                takeover = next((m for m in self.miners if m.available), None)
+                if takeover is not None:
+                    self._assign_chunk(takeover, chunk)
+                else:
+                    self.parked.append(chunk)
+        else:
+            logger.info("client %d dropped", conn_id)
+            # Purge the dead client's queued requests FIRST so cancelling its
+            # in-flight request can't promote another of its own requests.
+            self.queue = [r for r in self.queue if r.conn_id != conn_id]
+            curr = self.current
+            if curr is not None and curr.conn_id == conn_id:
+                # Cancel immediately (divergence, see module docstring):
+                # free the pool, discard parked chunks, start the next
+                # request; stale Results die on the pending-FIFO pop.
+                for m in self.miners:
+                    m.available = True
+                self.parked.clear()
+                self.current = None
+                if self.queue and self.miners:
+                    self._load_balance(self.queue.pop(0))
+
+    # -------------------------------------------------------------- internal
+
+    def _find_miner(self, conn_id: int) -> Optional[MinerState]:
+        for m in self.miners:
+            if m.conn_id == conn_id:
+                return m
+        return None
+
+    def _load_balance(self, request: Request) -> None:
+        """Split the range over ALL miners (they must all be available)."""
+        self.current = request
+        self._next_job_id += 1
+        request.job_id = self._next_job_id
+        num = len(self.miners)
+        request.upper += 1  # inclusive -> exclusive
+        total = request.upper - request.lower
+        if total <= 0:
+            # Empty/inverted range: answer like an empty scan (the reference
+            # would wrap negative totals through uint64 and wedge the pool).
+            self._write(request.conn_id, new_result(MAX_U64, 0))
+            self.current = None
+            if self.queue:
+                self._load_balance(self.queue.pop(0))
+            return
+        individual = total // num
+        leftover = total - individual * num
+        if individual == 0:  # more miners than nonces
+            individual, leftover, num = 1, 0, total
+        request.num_chunks = num
+        start = request.lower
+        for i in range(num):
+            end = start + individual + (leftover if i == 0 else 0)
+            self._assign_chunk(
+                self.miners[i],
+                Chunk(request.job_id, request.data, start, end))
+            start = end
+
+    def _assign_chunk(self, miner: MinerState, chunk: Chunk) -> None:
+        miner.available = False
+        miner.pending.append(chunk)
+        self._write(miner.conn_id,
+                    new_request(chunk.data, chunk.lower, chunk.upper))
+
+    def _write(self, conn_id: int, msg: Message) -> None:
+        try:
+            self.server.write(conn_id, msg.to_json())
+        except LspError:
+            # The drop event for this connection is already in flight; the
+            # drop handler will repair the assignment.
+            logger.info("write to %d failed; awaiting drop event", conn_id)

@@ -1,0 +1,49 @@
+"""Scheduler server CLI — same contract as the reference binary.
+
+Usage: ``python -m distributed_bitcoinminer_tpu.apps.server <port>``
+(ref: bitcoin/server/server.go:430-472; prints "Server listening on port N").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import sys
+
+from ..lsp.params import Params
+from ..lsp.server import new_async_server
+from .scheduler import Scheduler
+
+
+async def serve(port: int, params: Params | None = None) -> None:
+    server = await new_async_server(port, params or Params())
+    print("Server listening on port", server.port, flush=True)
+    scheduler = Scheduler(server)
+    try:
+        await scheduler.run()
+    finally:
+        await server.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv if argv is None else argv
+    if len(argv) != 2:
+        print(f"Usage: ./{argv[0]} <port>", end="")
+        return 1
+    try:
+        port = int(argv[1])
+    except ValueError as exc:
+        print("Port must be a number:", exc)
+        return 1
+    logging.basicConfig(filename="log.txt",
+                        format="%(asctime)s %(name)s %(message)s")
+    logging.getLogger("dbm").setLevel(logging.INFO)
+    try:
+        asyncio.run(serve(port))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

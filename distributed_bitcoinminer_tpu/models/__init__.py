@@ -1,5 +1,6 @@
 """Model layer: the flagship nonce-search program and its host orchestration."""
 
 from .miner_model import NonceSearcher
+from .sharded import ShardedNonceSearcher
 
-__all__ = ["NonceSearcher"]
+__all__ = ["NonceSearcher", "ShardedNonceSearcher"]

@@ -100,9 +100,11 @@ class NonceSearcher:
 
     def search_block(self, plan: _BlockPlan):
         """Dispatch one block; returns (hi, lo, idx) device scalars."""
-        window = plan.hi_i - plan.lo_i + 1
-        nbatches = _pow2_ceil((window + self.batch - 1) // self.batch)
+        # Coverage must span [i0, hi_i] — i0 is batch-aligned BELOW lo_i, so
+        # sizing from lo_i alone can leave the top lanes unscanned.
         i0 = (plan.lo_i // self.batch) * self.batch
+        span = plan.hi_i - i0 + 1
+        nbatches = _pow2_ceil((span + self.batch - 1) // self.batch)
         return search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),

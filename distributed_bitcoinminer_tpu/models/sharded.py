@@ -1,0 +1,42 @@
+"""Mesh-sharded flagship model: the multi-core TPU miner compute plane.
+
+Extends :class:`NonceSearcher` so each aligned ``10^k`` block is cut into
+``n_devices`` contiguous spans scanned in one ``shard_map`` dispatch with an
+on-device collective merge (see ``parallel/mesh_search.py``). This is the
+"one v4-8 pod joins as one very wide miner" design from the north star:
+the LSP protocol above is unchanged; only the compute plane widens.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.mesh_search import device_spans, make_mesh, sharded_search_span
+from .miner_model import NonceSearcher, _pow2_ceil
+
+
+class ShardedNonceSearcher(NonceSearcher):
+    """Exact arg-min hash search sharded over a 1-D device mesh.
+
+    ``batch`` is the per-device lane count per step; the per-block work is
+    ``n_devices * batch * nbatches`` lanes.
+    """
+
+    def __init__(self, data: str, batch: int = 1 << 20, mesh=None):
+        super().__init__(data, batch)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_devices = self.mesh.devices.size
+
+    def search_block(self, plan):
+        # Coverage must span [i0, hi_i] — i0 is batch-aligned BELOW lo_i, so
+        # sizing from lo_i alone can leave the top lanes unscanned.
+        i0 = (plan.lo_i // self.batch) * self.batch
+        span = plan.hi_i - i0 + 1
+        per_step = self.batch * self.n_devices
+        nbatches = _pow2_ceil((span + per_step - 1) // per_step)
+        i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
+        return sharded_search_span(
+            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+            i0_d, plan.lo_i, plan.hi_i,
+            mesh=self.mesh, rem=plan.rem, k=plan.k,
+            batch=self.batch, nbatches=nbatches)

@@ -16,12 +16,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from .sha256_host import SHA256_K
-from .sha256_jnp import _compress, digit_positions, lex_argmin
+from .sha256_jnp import _compress, digit_positions, ensure_varying, lex_argmin
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 
 
-def _hash_lanes(midstate, template, i, rem: int, k: int):
+def _hash_lanes(midstate, template, i, rem: int, k: int, vary_axes=()):
     """Hash a lane vector of low-digit offsets; returns (hi, lo) uint32."""
     contrib: dict[tuple[int, int], jax.Array] = {}
     for j, (blk, word, shift) in enumerate(digit_positions(rem, k)):
@@ -39,26 +39,26 @@ def _hash_lanes(midstate, template, i, rem: int, k: int):
             if (blk, word) in contrib:
                 base = base | contrib[(blk, word)]
             w16.append(base)
-        state = _compress(state, w16)
+        state = _compress(state, w16, vary_axes=vary_axes)
     return state[0], state[1]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("rem", "k", "batch", "nbatches"))
-def search_span(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
-                batch: int, nbatches: int):
-    """Scan lanes ``i0 + [0, nbatches*batch)`` masked to [lo_i, hi_i].
+def span_scan_body(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
+                   batch: int, nbatches: int, vary_axes=()):
+    """Unjitted span scan: lanes ``i0 + [0, nbatches*batch)`` masked to
+    [lo_i, hi_i]. Shared by the jitted single-device entry point and the
+    shard_map per-device body in ``parallel/`` (which passes its mesh axis
+    as ``vary_axes`` so the loop carry is typed device-varying).
 
     Returns (best_hi, best_lo, best_i) uint32 scalars; all-invalid spans
     return the (0xffffffff, 0xffffffff, 0xffffffff) sentinel.
     """
-    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
-    template = jnp.asarray(template, dtype=jnp.uint32)
     lane = jnp.arange(batch, dtype=jnp.uint32)
 
     def step(j, best):
         i = i0 + j.astype(jnp.uint32) * np.uint32(batch) + lane
-        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k)
+        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
+                                 vary_axes=vary_axes)
         valid = (i >= lo_i) & (i <= hi_i)
         hi_h = jnp.where(valid, hi_h, _MAX_U32)
         lo_h = jnp.where(valid, lo_h, _MAX_U32)
@@ -71,8 +71,21 @@ def search_span(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
                 jnp.where(better, c_lo, b_lo),
                 jnp.where(better, c_i, b_i))
 
-    init = (_MAX_U32, _MAX_U32, _MAX_U32)
+    init = (jnp.uint32(_MAX_U32),) * 3
+    if vary_axes:
+        init = tuple(ensure_varying(x, vary_axes) for x in init)
     if nbatches == 1:
         return step(jnp.uint32(0), init)
     return jax.lax.fori_loop(0, nbatches, step, init,
                              unroll=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rem", "k", "batch", "nbatches"))
+def search_span(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
+                batch: int, nbatches: int):
+    """Jitted single-device span scan (see :func:`span_scan_body`)."""
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+    return span_scan_body(midstate, template, i0, lo_i, hi_i,
+                          rem=rem, k=k, batch=batch, nbatches=nbatches)

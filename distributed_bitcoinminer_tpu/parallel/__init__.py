@@ -1,0 +1,12 @@
+"""Parallelism layer: device meshes, sharded span search, collective merge.
+
+The reference's only compute parallelism is data parallelism over the nonce
+range (ref: bitcoin/server/server.go:165-205). Here that axis is sharded at
+two nested levels: across LSP-registered miners (scheduler, unchanged
+protocol) and across TPU cores inside one miner via ``shard_map`` over a 1-D
+``jax.sharding.Mesh`` with a staged-pmin lexicographic-min merge on ICI.
+"""
+
+from .mesh_search import AXIS, device_spans, make_mesh, sharded_search_span
+
+__all__ = ["AXIS", "device_spans", "make_mesh", "sharded_search_span"]

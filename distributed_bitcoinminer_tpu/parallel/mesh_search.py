@@ -1,0 +1,93 @@
+"""Mesh-sharded nonce search: shard_map over a 1-D device mesh.
+
+This is the on-device half of the reference scheduler's data parallelism
+(ref: bitcoin/server/server.go:165-205 splits a range across LSP miners; here
+the same split happens *inside* one miner, across TPU cores, with the merge as
+an ICI collective instead of host messaging).
+
+Design (TPU-first):
+
+- The "sequence" axis of this framework is the nonce range. A block of
+  ``10^k`` lanes is cut into ``n_devices`` contiguous, disjoint spans; each
+  device scans its span with the shared (replicated) midstate + tail
+  template via the same ``span_scan_body`` used single-device.
+- The merge is an exact lexicographic (hash_hi, hash_lo, index) arg-min over
+  the mesh axis, computed on device as three staged ``pmin`` collectives
+  over scalars riding ICI (bandwidth-free), yielding a replicated triple.
+  Ties resolve to the lowest index, which is the lowest nonce, matching the
+  Go scan's first-seen-wins strict ``<`` (ref: bitcoin/miner/miner.go:54-58).
+- Everything is static-shaped; one compilation per
+  (rem, k, batch, nbatches, mesh) signature.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.search import span_scan_body
+
+_MAX_U32 = np.uint32(0xFFFFFFFF)
+
+AXIS = "d"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "rem", "k", "batch", "nbatches"))
+def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
+                        rem: int, k: int, batch: int, nbatches: int):
+    """Scan ``n`` disjoint spans, one per device, and merge on device.
+
+    midstate: (8,) uint32 — replicated.
+    template: (nblocks, 16) uint32 — replicated.
+    i0_d: (n,) uint32 — per-device span start lane (device d scans
+        ``i0_d[d] + [0, nbatches*batch)``).
+    lo_i, hi_i: uint32 scalars — the block's global valid lane window;
+        lanes outside it contribute the 0xffffffff sentinel.
+
+    Returns replicated (best_hi, best_lo, best_i) uint32 scalars.
+    """
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(AXIS), P(), P()),
+        out_specs=(P(), P(), P()))
+    def body(midstate, template, i0, lo_i, hi_i):
+        hi_h, lo_h, idx = span_scan_body(
+            midstate, template, i0[0], lo_i, hi_i,
+            rem=rem, k=k, batch=batch, nbatches=nbatches, vary_axes=(AXIS,))
+        # Cross-device exact lexicographic argmin as three staged pmin
+        # collectives over scalars (replication-invariant outputs, so the
+        # merged triple is provably identical on every device).
+        min_hi = jax.lax.pmin(hi_h, AXIS)
+        lo_m = jnp.where(hi_h == min_hi, lo_h, _MAX_U32)
+        min_lo = jax.lax.pmin(lo_m, AXIS)
+        idx_m = jnp.where((hi_h == min_hi) & (lo_h == min_lo), idx, _MAX_U32)
+        min_idx = jax.lax.pmin(idx_m, AXIS)
+        return min_hi, min_lo, min_idx
+
+    return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
+                jnp.uint32(lo_i), jnp.uint32(hi_i))
+
+
+def device_spans(i0: int, n_devices: int, batch: int, nbatches: int) -> np.ndarray:
+    """Per-device span starts for a contiguous split from lane ``i0``."""
+    per = batch * nbatches
+    return (np.uint32(i0) +
+            np.arange(n_devices, dtype=np.uint32) * np.uint32(per))

@@ -21,6 +21,11 @@ sys.path.insert(0, _REPO)
 # compiles per (rem, k, nbatches, batch) signature; cache makes re-runs fast.
 import jax
 
+# The image's sitecustomize registers the real TPU backend before this file
+# runs, overriding JAX_PLATFORMS from the environment — force CPU again at
+# the config level so tests always see the 8-device virtual mesh.
+jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

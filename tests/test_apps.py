@@ -1,0 +1,246 @@
+"""Scheduler/miner/client end-to-end tests over real localhost UDP.
+
+The reference repo ships no Part B test sources (only staff binaries
+ctest/mtest, p1/README.md:137-141); these scenarios cover the scheduler state
+machine from SURVEY §3.3-3.4: happy path, FIFO queueing, elastic join,
+miner-failure reassignment, and client-failure cancellation.
+
+Most tests plug a pure-Python oracle searcher into MinerWorker so they
+exercise distributed logic, not device compute; one smoke test runs the real
+JAX searcher end to end.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.client import printable_result, submit
+from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+from distributed_bitcoinminer_tpu.lsp import Params
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+
+
+def fast_params(epoch_ms=50, limit=5, window=5):
+    return Params(epoch_limit=limit, epoch_millis=epoch_ms,
+                  window_size=window, max_backoff_interval=2)
+
+
+class OracleSearcher:
+    """Host-oracle stand-in for the device searcher (optionally slow)."""
+
+    def __init__(self, data: str, delay: float = 0.0):
+        self.data = data
+        self.delay = delay
+
+    def search(self, lower: int, upper: int):
+        if self.delay:
+            time.sleep(self.delay)
+        return scan_min(self.data, lower, upper)
+
+
+def oracle_factory(delay: float = 0.0):
+    return lambda data, batch: OracleSearcher(data, delay)
+
+
+class Cluster:
+    """A scheduler plus helpers to spawn miners against it."""
+
+    def __init__(self, params):
+        self.params = params
+        self.server = None
+        self.tasks = []
+        self.miners = []
+
+    async def __aenter__(self):
+        self.server = await new_async_server(0, self.params)
+        self.scheduler = Scheduler(self.server)
+        self.tasks.append(asyncio.create_task(self.scheduler.run()))
+        return self
+
+    async def __aexit__(self, *exc):
+        for task in self.tasks:
+            task.cancel()
+        for worker in self.miners:
+            await worker.close()
+        await self.server.close()
+
+    @property
+    def hostport(self):
+        return f"127.0.0.1:{self.server.port}"
+
+    async def start_miner(self, factory=None, delay=0.0):
+        worker = MinerWorker(self.hostport, params=self.params,
+                             searcher_factory=factory or oracle_factory(delay))
+        await worker.join()
+        self.tasks.append(asyncio.create_task(worker.run()))
+        self.miners.append(worker)
+        return worker
+
+
+# The system scans [0, maxNonce+1]: the scheduler hands out exclusive upper
+# bounds but miners read them as inclusive (ref quirk, see scheduler.py).
+def expected(data, max_nonce):
+    return scan_min(data, 0, max_nonce + 1)
+
+
+def test_end_to_end_single_miner():
+    async def scenario():
+        async with Cluster(fast_params()) as c:
+            await c.start_miner()
+            result = await asyncio.wait_for(
+                submit(c.hostport, "cmu440", 999, c.params), 10)
+            assert result == expected("cmu440", 999)
+    asyncio.run(scenario())
+
+
+def test_end_to_end_multi_miner_and_fifo_queue():
+    async def scenario():
+        async with Cluster(fast_params()) as c:
+            for _ in range(3):
+                await c.start_miner()
+            results = await asyncio.wait_for(asyncio.gather(
+                submit(c.hostport, "msg one", 500, c.params),
+                submit(c.hostport, "msg two", 700, c.params),
+                submit(c.hostport, "msg three", 900, c.params)), 20)
+            assert results[0] == expected("msg one", 500)
+            assert results[1] == expected("msg two", 700)
+            assert results[2] == expected("msg three", 900)
+    asyncio.run(scenario())
+
+
+def test_request_queued_until_miner_joins():
+    async def scenario():
+        async with Cluster(fast_params()) as c:
+            pending = asyncio.create_task(
+                submit(c.hostport, "late pool", 300, c.params))
+            await asyncio.sleep(0.3)
+            assert not pending.done()
+            await c.start_miner()
+            assert await asyncio.wait_for(pending, 10) == \
+                expected("late pool", 300)
+    asyncio.run(scenario())
+
+
+def test_miner_drop_reassigns_chunk():
+    async def scenario():
+        params = fast_params(epoch_ms=40, limit=3)
+        async with Cluster(params) as c:
+            victim = await c.start_miner(delay=1.5)   # slow: dies mid-chunk
+            await c.start_miner()                     # fast survivor
+            pending = asyncio.create_task(
+                submit(c.hostport, "fault tolerant", 400, params))
+            await asyncio.sleep(0.3)  # both miners now hold chunks
+            # Crash the slow miner without a graceful close: silence makes
+            # the server's epoch timer declare it lost (SURVEY §3.4).
+            victim.client._conn.abort()
+            victim.client._ep.close()
+            assert await asyncio.wait_for(pending, 15) == \
+                expected("fault tolerant", 400)
+    asyncio.run(scenario())
+
+
+def test_miner_drop_with_no_spare_parks_chunk_until_join():
+    async def scenario():
+        params = fast_params(epoch_ms=40, limit=3)
+        async with Cluster(params) as c:
+            victim = await c.start_miner(delay=2.0)
+            pending = asyncio.create_task(
+                submit(c.hostport, "parked chunk", 200, params))
+            await asyncio.sleep(0.3)
+            victim.client._conn.abort()
+            victim.client._ep.close()
+            await asyncio.sleep(0.5)   # chunk parks; pool is empty
+            await c.start_miner()      # joiner absorbs the parked chunk
+            assert await asyncio.wait_for(pending, 15) == \
+                expected("parked chunk", 200)
+    asyncio.run(scenario())
+
+
+def test_client_drop_cancels_and_frees_pool():
+    async def scenario():
+        params = fast_params(epoch_ms=40, limit=3)
+        async with Cluster(params) as c:
+            await c.start_miner(delay=1.0)
+            from distributed_bitcoinminer_tpu.bitcoin.message import new_request
+            from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+            doomed = await new_async_client(c.hostport, params)
+            doomed.write(new_request("abandoned", 0, 300).to_json())
+            await asyncio.sleep(0.3)
+            doomed._conn.abort()   # crash the client mid-request
+            doomed._ep.close()
+            # The pool must recover and serve the next client.
+            result = await asyncio.wait_for(
+                submit(c.hostport, "next in line", 250, params), 15)
+            assert result == expected("next in line", 250)
+    asyncio.run(scenario())
+
+
+def test_client_drop_with_parked_chunk_does_not_deadlock():
+    """Regression: a responsible miner's chunk parks (miner died, no spare),
+    then the client drops. The reference's state machine would wait forever
+    for the parked chunk's Result; the scheduler must instead cancel the
+    request and keep serving (see scheduler.py module docstring)."""
+    async def scenario():
+        params = fast_params(epoch_ms=40, limit=3)
+        async with Cluster(params) as c:
+            survivor = await c.start_miner(delay=1.0)   # busy when B dies
+            victim = await c.start_miner(delay=1.0)
+            from distributed_bitcoinminer_tpu.bitcoin.message import new_request
+            from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+            doomed = await new_async_client(c.hostport, params)
+            doomed.write(new_request("doomed job", 0, 400).to_json())
+            await asyncio.sleep(0.3)    # both miners hold chunks
+            victim.client._conn.abort() # dies; survivor busy -> chunk parks
+            victim.client._ep.close()
+            await asyncio.sleep(0.4)
+            doomed._conn.abort()        # client dies too
+            doomed._ep.close()
+            result = await asyncio.wait_for(
+                submit(c.hostport, "after the storm", 300, params), 15)
+            assert result == expected("after the storm", 300)
+    asyncio.run(scenario())
+
+
+def test_end_to_end_with_real_jax_searcher():
+    from distributed_bitcoinminer_tpu.apps.miner import default_searcher_factory
+
+    async def scenario():
+        async with Cluster(fast_params()) as c:
+            await c.start_miner(
+                factory=lambda data, batch: default_searcher_factory(data, 1 << 10))
+            result = await asyncio.wait_for(
+                submit(c.hostport, "cmu440", 2999, c.params), 120)
+            assert result == expected("cmu440", 2999)
+    asyncio.run(scenario())
+
+
+def test_empty_range_request_does_not_wedge_scheduler():
+    """Regression: Request(0, maxNonce=-1) made num_chunks 0 and left the
+    barrier permanently unreleasable; it must answer with the empty-scan
+    sentinel and keep serving."""
+    from distributed_bitcoinminer_tpu.bitcoin.hash import MAX_U64
+    from distributed_bitcoinminer_tpu.bitcoin.message import Message, MsgType
+
+    async def scenario():
+        async with Cluster(fast_params()) as c:
+            await c.start_miner()
+            bad = Message(type=MsgType.REQUEST, data="void", lower=5, upper=3)
+            from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+            sender = await new_async_client(c.hostport, c.params)
+            sender.write(bad.to_json())
+            reply = Message.from_json(await asyncio.wait_for(sender.read(), 10))
+            assert (reply.hash, reply.nonce) == (MAX_U64, 0)
+            await sender.close()
+            # Scheduler must still serve normal traffic afterwards.
+            result = await asyncio.wait_for(
+                submit(c.hostport, "alive", 200, c.params), 10)
+            assert result == expected("alive", 200)
+    asyncio.run(scenario())
+
+
+def test_printable_result_contract():
+    assert printable_result((123, 45)) == "Result 123 45"
+    assert printable_result(None) == "Disconnected"

@@ -1,0 +1,59 @@
+"""Process-level CLI conformance: server/miner/client as three OS processes.
+
+The analog of BASELINE config 1 (stock harness run): the reference CLI
+contracts are ``server <port>``, ``miner <host:port>``,
+``client <host:port> <message> <maxNonce>`` (ref: p1/README.md:110-135), with
+client stdout ``Result <hash> <nonce>`` or ``Disconnected``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(args, cwd, extra_env=None):
+    env = dict(os.environ, PYTHONPATH=_REPO, DBM_COMPUTE="host")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", *args], cwd=cwd, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_three_process_round_trip(tmp_path):
+    port = _free_port()
+    pkg = "distributed_bitcoinminer_tpu.apps"
+    server = _spawn([f"{pkg}.server", str(port)], tmp_path)
+    miner = client = None
+    try:
+        time.sleep(1.0)  # server bind + listen
+        miner = _spawn([f"{pkg}.miner", f"127.0.0.1:{port}"], tmp_path)
+        time.sleep(1.0)  # miner join
+        client = _spawn(
+            [f"{pkg}.client", f"127.0.0.1:{port}", "cmu440", "999"], tmp_path)
+        out, err = client.communicate(timeout=60)
+        want_hash, want_nonce = scan_min("cmu440", 0, 1000)  # +1 ref quirk
+        assert out.strip() == f"Result {want_hash} {want_nonce}", (out, err)
+    finally:
+        for proc in (client, miner, server):
+            if proc is not None:
+                proc.kill()
+                proc.wait()
+
+
+def test_client_usage_errors(tmp_path):
+    pkg = "distributed_bitcoinminer_tpu.apps"
+    bad = _spawn([f"{pkg}.client", "127.0.0.1:1", "msg", "notanumber"], tmp_path)
+    out, _ = bad.communicate(timeout=30)
+    assert "notanumber is not a number." in out
