@@ -32,6 +32,45 @@ async def submit(hostport: str, message: str, max_nonce: int,
     return msg.hash, msg.nonce
 
 
+async def stream_until(hostport: str, message: str, target: int,
+                       span: int = 1 << 24, start: int = 0,
+                       max_nonce: Optional[int] = None,
+                       params: Optional[Params] = None,
+                       ) -> Optional[Tuple[int, int, int]]:
+    """Difficulty-target mode (BASELINE config 5): stream Requests span by
+    span until a merged Result beats ``target``.
+
+    Pure protocol addition — each span rides a stock Request, the scheduler
+    dynamically rebalances every span over the live miner pool, and miners
+    early-exit in-kernel via their own target heuristics if they implement
+    one. Returns (hash, nonce, spans_scanned) or None on disconnect /
+    exhausted ``max_nonce``.
+    """
+    client = await new_async_client(hostport, params)
+    spans = 0
+    lower = start
+    try:
+        while max_nonce is None or lower <= max_nonce:
+            upper = lower + span - 1
+            if max_nonce is not None:
+                upper = min(upper, max_nonce)
+            client.write(new_request(message, lower, upper).to_json())
+            try:
+                payload = await client.read()
+            except LspError:
+                return None
+            msg = Message.from_json(payload)
+            if msg.type != MsgType.RESULT:
+                return None
+            spans += 1
+            if msg.hash < target:
+                return msg.hash, msg.nonce, spans
+            lower = upper + 1
+        return None
+    finally:
+        await client.close()
+
+
 def printable_result(result: Optional[Tuple[int, int]]) -> str:
     """Exact stdout contract of the reference (client.go:61-68)."""
     if result is None:

@@ -30,14 +30,15 @@ logger = logging.getLogger("dbm.miner")
 
 
 class HostSearcher:
-    """Device-free fallback: the host oracle scan (ref miner semantics)."""
+    """Device-free fallback: the native C++ scan (SHA-NI where the CPU has
+    it), or the pure-Python oracle when no toolchain is present."""
 
     def __init__(self, data: str):
         self.data = data
 
     def search(self, lower: int, upper: int):
-        from ..bitcoin.hash import scan_min
-        return scan_min(self.data, lower, upper)
+        from .. import native
+        return native.scan_min_native(self.data, lower, upper)
 
 
 def default_searcher_factory(data: str, batch: Optional[int] = None):

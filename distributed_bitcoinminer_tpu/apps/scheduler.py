@@ -141,6 +141,11 @@ class Scheduler:
             return
         chunk = miner.pending.pop(0)   # the Result answers the oldest Request
         miner.available = not miner.pending
+        # A freed miner immediately absorbs one parked chunk
+        # (ref: server.go:285-304) — BEFORE the stale-Result return, so a
+        # miner freed by a stale answer still rescues parked work.
+        if self.parked and miner.available:
+            self._assign_chunk(miner, self.parked.pop(0))
         curr = self.current
         if curr is None or chunk.job_id != curr.job_id:
             return  # stale Result for a cancelled/finished request
@@ -148,10 +153,6 @@ class Scheduler:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
         curr.total_responses += 1
-        # A freed miner immediately absorbs one parked chunk
-        # (ref: server.go:285-304).
-        if self.parked and miner.available:
-            self._assign_chunk(miner, self.parked.pop(0))
         if curr.total_responses == curr.num_chunks:
             self._write(curr.conn_id,
                         new_result(curr.min_hash, curr.min_nonce))

@@ -23,7 +23,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..bitcoin.hash import MAX_U64
-from ..ops.search import search_span
+from ..ops.search import search_span, search_span_until
 from ..ops.sha256_host import sha256_midstate
 from ..ops.sha256_jnp import build_tail_template
 
@@ -98,13 +98,23 @@ class NonceSearcher:
                 yield self._plan_block(d, k, base, lo, hi)
                 base += span
 
-    def search_block(self, plan: _BlockPlan):
-        """Dispatch one block; returns (hi, lo, idx) device scalars."""
-        # Coverage must span [i0, hi_i] — i0 is batch-aligned BELOW lo_i, so
-        # sizing from lo_i alone can leave the top lanes unscanned.
+    def _block_geometry(self, plan: _BlockPlan,
+                        per_step: int | None = None) -> tuple[int, int]:
+        """(i0, nbatches) for a block dispatch covering [i0, hi_i].
+
+        i0 is batch-aligned BELOW lo_i, so the step count must be sized from
+        i0 (not lo_i) or the top lanes of the block go unscanned; the pow2
+        rounding keeps the compile-signature set small. One helper shared by
+        every dispatch path so the sizing rule can't drift between them.
+        """
+        per = per_step if per_step is not None else self.batch
         i0 = (plan.lo_i // self.batch) * self.batch
         span = plan.hi_i - i0 + 1
-        nbatches = _pow2_ceil((span + self.batch - 1) // self.batch)
+        return i0, _pow2_ceil((span + per - 1) // per)
+
+    def search_block(self, plan: _BlockPlan):
+        """Dispatch one block; returns (hi, lo, idx) device scalars."""
+        i0, nbatches = self._block_geometry(plan)
         return search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
@@ -130,3 +140,33 @@ class NonceSearcher:
             if not seen or h < best_hash:
                 best_hash, best_nonce, seen = h, base + idx, True
         return best_hash, best_nonce
+
+    def search_until(self, lower: int, upper: int,
+                     target: int) -> tuple[int, int, bool]:
+        """Difficulty-target mode: (hash, nonce, found).
+
+        Scans blocks in ascending nonce order, early-exiting on device at
+        the first batch holding ``hash < target`` and returning the first
+        (lowest-nonce) qualifying hash; when the whole range misses the
+        target, falls back to the exact argmin (found=False).
+        """
+        if lower > upper:
+            raise ValueError("empty range")
+        t_hi, t_lo = target >> 32, target & 0xFFFFFFFF
+        best_hash, best_nonce, seen = MAX_U64, lower, False
+        for plan in self.plan(lower, upper):
+            i0, nbatches = self._block_geometry(plan)
+            found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = search_span_until(
+                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+                np.uint32(t_hi), np.uint32(t_lo),
+                rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
+            if int(found):
+                return ((int(f_hi) << 32) | int(f_lo),
+                        plan.base + int(f_idx), True)
+            hi, lo, idx = int(b_hi), int(b_lo), int(b_idx)
+            if (hi, lo, idx) != (*_SENTINEL, 0xFFFFFFFF):
+                h = (hi << 32) | lo
+                if not seen or h < best_hash:
+                    best_hash, best_nonce, seen = h, plan.base + idx, True
+        return best_hash, best_nonce, False

@@ -12,7 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..parallel.mesh_search import device_spans, make_mesh, sharded_search_span
-from .miner_model import NonceSearcher, _pow2_ceil
+from .miner_model import NonceSearcher
 
 
 class ShardedNonceSearcher(NonceSearcher):
@@ -28,12 +28,8 @@ class ShardedNonceSearcher(NonceSearcher):
         self.n_devices = self.mesh.devices.size
 
     def search_block(self, plan):
-        # Coverage must span [i0, hi_i] — i0 is batch-aligned BELOW lo_i, so
-        # sizing from lo_i alone can leave the top lanes unscanned.
-        i0 = (plan.lo_i // self.batch) * self.batch
-        span = plan.hi_i - i0 + 1
-        per_step = self.batch * self.n_devices
-        nbatches = _pow2_ceil((span + per_step - 1) // per_step)
+        i0, nbatches = self._block_geometry(
+            plan, per_step=self.batch * self.n_devices)
         i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
         return sharded_search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,

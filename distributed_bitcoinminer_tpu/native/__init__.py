@@ -1,0 +1,94 @@
+"""Native (C++) host runtime pieces, loaded via ctypes.
+
+``scan_min_native`` is the fast CPU arg-min scan (see ``sha256_scan.cpp``);
+the library auto-builds with g++ on first use and everything degrades to the
+pure-Python oracle when no toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+logger = logging.getLogger("dbm.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "sha256_scan.cpp")
+_LIB = os.path.join(_DIR, "libdbm_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.info("native build failed (%s); falling back to Python", exc)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as exc:
+            logger.info("native load failed (%s)", exc)
+            _build_failed = True
+            return None
+        lib.dbm_scan_min.restype = ctypes.c_int
+        lib.dbm_scan_min.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.dbm_hash.restype = ctypes.c_uint64
+        lib.dbm_hash.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def scan_min_native(data: str, lower: int, upper: int) -> Tuple[int, int]:
+    """Native arg-min scan over [lower, upper]; falls back to the Python
+    oracle when the toolchain is missing."""
+    lib = load()
+    if lib is None:
+        from ..bitcoin.hash import scan_min
+        return scan_min(data, lower, upper)
+    raw = data.encode("utf-8")
+    out_hash = ctypes.c_uint64()
+    out_nonce = ctypes.c_uint64()
+    rc = lib.dbm_scan_min(raw, len(raw), lower, upper,
+                          ctypes.byref(out_hash), ctypes.byref(out_nonce))
+    if rc != 0:
+        raise ValueError("empty range")
+    return out_hash.value, out_nonce.value
+
+
+def hash_native(data: str, nonce: int) -> int:
+    lib = load()
+    if lib is None:
+        from ..bitcoin.hash import hash_op
+        return hash_op(data, nonce)
+    raw = data.encode("utf-8")
+    return lib.dbm_hash(raw, len(raw), nonce)

@@ -89,3 +89,58 @@ def search_span(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
     template = jnp.asarray(template, dtype=jnp.uint32)
     return span_scan_body(midstate, template, i0, lo_i, hi_i,
                           rem=rem, k=k, batch=batch, nbatches=nbatches)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rem", "k", "batch", "nbatches"))
+def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
+                      target_lo, *, rem: int, k: int, batch: int,
+                      nbatches: int):
+    """Difficulty-target scan: stop at the first batch holding a hash below
+    the 64-bit target (as a (hi, lo) uint32 pair).
+
+    A ``while_loop`` walks the span in ascending lane batches and exits as
+    soon as a batch contains a qualifying hash — the in-kernel early-exit of
+    the difficulty-target mode. Returns uint32 scalars
+    ``(found, f_hi, f_lo, f_idx, best_hi, best_lo, best_idx)``: the FIRST
+    (lowest-nonce) qualifying hash when ``found`` is 1, plus the running
+    argmin over all scanned lanes either way (the fallback result when the
+    whole span misses the target).
+    """
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+    lane = jnp.arange(batch, dtype=jnp.uint32)
+
+    def cond(carry):
+        j, f_idx, _f_hi, _f_lo, _best = carry
+        return (j < nbatches) & (f_idx == _MAX_U32)
+
+    def body(carry):
+        j, f_idx, f_hi, f_lo, best = carry
+        i = i0 + j.astype(jnp.uint32) * np.uint32(batch) + lane
+        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k)
+        valid = (i >= lo_i) & (i <= hi_i)
+        hi_h = jnp.where(valid, hi_h, _MAX_U32)
+        lo_h = jnp.where(valid, lo_h, _MAX_U32)
+        idx = jnp.where(valid, i, _MAX_U32)
+        # Running argmin fallback.
+        c_hi, c_lo, c_i = lex_argmin(hi_h, lo_h, idx)
+        b_hi, b_lo, b_i = best
+        better = (c_hi < b_hi) | ((c_hi == b_hi) & (c_lo < b_lo))
+        best = (jnp.where(better, c_hi, b_hi),
+                jnp.where(better, c_lo, b_lo),
+                jnp.where(better, c_i, b_i))
+        # First qualifying lane in this batch (lowest nonce wins).
+        qual = valid & ((hi_h < target_hi)
+                        | ((hi_h == target_hi) & (lo_h < target_lo)))
+        q_idx = jnp.min(jnp.where(qual, i, _MAX_U32))
+        hit = qual & (i == q_idx)
+        q_hi = jnp.min(jnp.where(hit, hi_h, _MAX_U32))
+        q_lo = jnp.min(jnp.where(hit, lo_h, _MAX_U32))
+        return (j + 1, q_idx, q_hi, q_lo, best)
+
+    init = (jnp.int32(0), _MAX_U32, _MAX_U32, _MAX_U32,
+            (_MAX_U32, _MAX_U32, _MAX_U32))
+    j, f_idx, f_hi, f_lo, best = jax.lax.while_loop(cond, body, init)
+    found = (f_idx != _MAX_U32).astype(jnp.uint32)
+    return found, f_hi, f_lo, f_idx, best[0], best[1], best[2]

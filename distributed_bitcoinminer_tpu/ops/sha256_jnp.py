@@ -91,16 +91,37 @@ def _round(a, b, c, d, e, f, g, h, kw):
     return t1 + s0 + maj, a, b, c, d + t1, e, f, g
 
 
-def _compress(state, w16, vary_axes=()):
+def _schedule_block(st, w, kvec):
+    """16 rounds with the in-place mod-16 message-schedule window:
+    w[t] = w[t-16] + s0(w[t-15]) + w[t-7] + s1(w[t-2]), in-place so later
+    taps see already-updated entries."""
+    for j in range(16):
+        s0 = (_rotr(w[(j + 1) % 16], 7) ^ _rotr(w[(j + 1) % 16], 18)
+              ^ (w[(j + 1) % 16] >> np.uint32(3)))
+        s1 = (_rotr(w[(j + 14) % 16], 17) ^ _rotr(w[(j + 14) % 16], 19)
+              ^ (w[(j + 14) % 16] >> np.uint32(10)))
+        w[j] = w[j] + s0 + w[(j + 9) % 16] + s1
+        st = _round(*st, kvec[j] + w[j])
+    return st, w
+
+
+def _compress(state, w16, vary_axes=(), unroll: bool = False):
     """One vectorized compression. state: 8 arrays; w16: 16 arrays.
 
-    Rolled as a ``fori_loop`` over 16-round blocks with the classic in-place
-    mod-16 message-schedule window, instead of a 64-round unrolled graph:
-    XLA's CPU backend compiles the fully unrolled chain in minutes (the
-    dependence chain blows up a superlinear pass) while this form compiles
-    in seconds on every backend and runs identically on the VPU. Inside
-    ``shard_map`` pass the mesh axes as ``vary_axes`` so the loop carry is
-    uniformly device-varying.
+    Two lowerings of the same bit-exact math:
+
+    - rolled (default on CPU): a ``fori_loop`` over 16-round blocks with the
+      classic in-place mod-16 message-schedule window. XLA:CPU compiles the
+      fully unrolled 64-round chain in minutes (a superlinear pass blows up
+      on the dependence chain); the rolled form compiles in seconds.
+    - unrolled (opt-in): all 64 rounds static. Measured on TPU v5e this is
+      ~300x SLOWER end-to-end at large batch (the live unrolled chain spills
+      through HBM), so the rolled form is the default everywhere; the
+      register-resident unrolled form lives in the Pallas kernel tier
+      (``sha256_pallas``) where Mosaic keeps it on-chip.
+
+    Inside ``shard_map`` pass the mesh axes as ``vary_axes`` so the rolled
+    loop carry is uniformly device-varying.
     """
     if vary_axes:
         state = tuple(ensure_varying(x, vary_axes) for x in state)
@@ -112,24 +133,19 @@ def _compress(state, w16, vary_axes=()):
     for j in range(16):
         st = _round(*st, np.uint32(SHA256_K[j]) + w[j])
 
-    k64 = jnp.asarray(_K64)
+    if unroll:
+        for blk in range(1, 4):
+            st, w = _schedule_block(st, w, _K64[blk * 16:(blk + 1) * 16])
+    else:
+        k64 = jnp.asarray(_K64)
 
-    def block(i, carry):
-        st, w = carry
-        w = list(w)
-        kvec = jax.lax.dynamic_slice(k64, (i * 16,), (16,))
-        for j in range(16):
-            # w[t] = w[t-16] + s0(w[t-15]) + w[t-7] + s1(w[t-2]), mod-16
-            # in-place so later taps see already-updated entries.
-            s0 = (_rotr(w[(j + 1) % 16], 7) ^ _rotr(w[(j + 1) % 16], 18)
-                  ^ (w[(j + 1) % 16] >> np.uint32(3)))
-            s1 = (_rotr(w[(j + 14) % 16], 17) ^ _rotr(w[(j + 14) % 16], 19)
-                  ^ (w[(j + 14) % 16] >> np.uint32(10)))
-            w[j] = w[j] + s0 + w[(j + 9) % 16] + s1
-            st = _round(*st, kvec[j] + w[j])
-        return st, tuple(w)
+        def block(i, carry):
+            st, w = carry
+            kvec = jax.lax.dynamic_slice(k64, (i * 16,), (16,))
+            st, w = _schedule_block(st, list(w), kvec)
+            return st, tuple(w)
 
-    st, _ = jax.lax.fori_loop(1, 4, block, (st, tuple(w)))
+        st, _ = jax.lax.fori_loop(1, 4, block, (st, tuple(w)))
     return tuple(s + v for s, v in zip(state, st))
 
 

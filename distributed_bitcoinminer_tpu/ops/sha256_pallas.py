@@ -1,0 +1,140 @@
+"""Pallas TPU kernel tier: blockwise SHA-256 arg-min search.
+
+The hot op of the framework (ref: bitcoin/hash.go:13-17 driven by
+bitcoin/miner/miner.go:52-59), hand-lowered for the TPU VPU:
+
+- Grid = lane blocks of ``rows x 128`` nonces; each grid step formats the k
+  ASCII digits in registers, runs all 64 compression rounds fully unrolled
+  on (rows, 128) uint32 tiles (schedule window held in registers — no HBM
+  round-trips inside the hash), and reduces its block to one
+  (hash_hi, hash_lo, index) triple written to a per-step output row.
+- All parameters (span start, valid window, midstate, tail template) ride in
+  a single scalar-prefetch uint32 vector; the kernel touches HBM only for
+  the 3-word per-step result.
+- The final cross-step lexicographic argmin is a tiny jnp reduce.
+
+Bit-identical to the host oracle, including ties (lowest nonce wins: within
+a step via the masked lex-argmin, across steps because indices ascend with
+the grid).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sha256_host import SHA256_K
+from .sha256_jnp import digit_positions, lex_argmin
+
+_MAX_U32 = np.uint32(0xFFFFFFFF)
+_LANES = 128
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _kernel(scal_ref, out_ref, *, rem: int, k: int, nblocks: int, rows: int):
+    step = pl.program_id(0)
+    i0 = scal_ref[0]
+    lo = scal_ref[1]
+    hi = scal_ref[2]
+
+    row = jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 0)
+    col = jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 1)
+    lane = row * np.uint32(_LANES) + col
+    i = i0 + step.astype(jnp.uint32) * np.uint32(rows * _LANES) + lane
+
+    # ASCII digit contributions at their static byte positions.
+    contrib = {}
+    for j, (blk, word, shift) in enumerate(digit_positions(rem, k)):
+        div = np.uint32(10 ** (k - 1 - j))
+        digit = (i // div) % np.uint32(10) + np.uint32(48)
+        key = (blk, word)
+        add = digit << np.uint32(shift)
+        contrib[key] = contrib[key] + add if key in contrib else add
+
+    state = tuple(scal_ref[3 + r] for r in range(8))
+    a, b, c, d, e, f, g, h = (jnp.full((rows, _LANES), s, jnp.uint32)
+                              for s in state)
+    for blk in range(nblocks):
+        w = []
+        for word in range(16):
+            base = scal_ref[11 + blk * 16 + word]
+            if (blk, word) in contrib:
+                wv = contrib[(blk, word)] | base
+            else:
+                wv = jnp.full((rows, _LANES), base, jnp.uint32)
+            w.append(wv)
+        sa, sb, sc, sd, se, sf, sg, sh = a, b, c, d, e, f, g, h
+        for t in range(64):
+            if t >= 16:
+                wt = w[t % 16]
+                s0 = _rotr(w[(t + 1) % 16], 7) ^ _rotr(w[(t + 1) % 16], 18) \
+                    ^ (w[(t + 1) % 16] >> np.uint32(3))
+                s1 = _rotr(w[(t + 14) % 16], 17) ^ _rotr(w[(t + 14) % 16], 19) \
+                    ^ (w[(t + 14) % 16] >> np.uint32(10))
+                wt = wt + s0 + w[(t + 9) % 16] + s1
+                w[t % 16] = wt
+            else:
+                wt = w[t]
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = h + s1 + ch + np.uint32(SHA256_K[t]) + wt
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & b) ^ (a & c) ^ (b & c)
+            h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
+        a, b, c, d = sa + a, sb + b, sc + c, sd + d
+        e, f, g, h = se + e, sf + f, sg + g, sh + h
+
+    valid = (i >= lo) & (i <= hi)
+    hi_h = jnp.where(valid, a, _MAX_U32)
+    lo_h = jnp.where(valid, b, _MAX_U32)
+    idx = jnp.where(valid, i, _MAX_U32)
+
+    min_hi = jnp.min(hi_h)
+    on_hi = hi_h == min_hi
+    min_lo = jnp.min(jnp.where(on_hi, lo_h, _MAX_U32))
+    min_idx = jnp.min(jnp.where(on_hi & (lo_h == min_lo), idx, _MAX_U32))
+    out_ref[0, 0] = min_hi
+    out_ref[0, 1] = min_lo
+    out_ref[0, 2] = min_idx
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rem", "k", "rows", "nsteps", "interpret"))
+def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
+                       k: int, rows: int, nsteps: int,
+                       interpret: bool = False):
+    """Scan lanes ``i0 + [0, nsteps*rows*128)`` masked to [lo_i, hi_i].
+
+    Same contract as :func:`ops.search.search_span`; ``rows`` is the sublane
+    count per grid step (lanes per step = rows * 128).
+    """
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32).reshape(8)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+    nblocks = template.shape[0]
+    scal = jnp.concatenate([
+        jnp.asarray([i0, lo_i, hi_i], dtype=jnp.uint32),
+        midstate, template.reshape(-1)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nsteps,),
+        in_specs=[],
+        out_specs=pl.BlockSpec((1, 3), lambda s, scal: (s, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    partials = pl.pallas_call(
+        functools.partial(_kernel, rem=rem, k=k, nblocks=nblocks, rows=rows),
+        out_shape=jax.ShapeDtypeStruct((nsteps, 3), jnp.uint32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scal)
+    return lex_argmin(partials[:, 0], partials[:, 1], partials[:, 2])

@@ -1,0 +1,2 @@
+"""Interactive LSP echo runners, flag-compatible with the reference harness
+(ref: srunner/srunner.go, crunner/crunner.go)."""

@@ -1,0 +1,70 @@
+"""Echo client runner — interactive LSP exerciser.
+
+Flag-compatible with the reference binary (ref: crunner/crunner.go:16-81):
+``--host --port --rdrop --wdrop --elim --ems --wsize --maxbackoff -v``.
+Reads whitespace-separated tokens from stdin, echoes each through the server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+from .. import lspnet
+from ..lsp.client import new_async_client
+from ..lsp.errors import LspError
+from .srunner import build_parser, params_from_args
+
+
+async def run_client(args) -> None:
+    lspnet.set_client_read_drop_percent(args.rdrop)
+    lspnet.set_client_write_drop_percent(args.wdrop)
+    hostport = f"{args.host}:{args.port}"
+    print(f"Connecting to server at '{hostport}'...", flush=True)
+    try:
+        client = await new_async_client(hostport, params_from_args(args))
+    except LspError as exc:
+        print(f"Failed to connect to server at {hostport}: {exc}")
+        return
+    try:
+        loop = asyncio.get_running_loop()
+        while True:
+            print("Client: ", end="", flush=True)
+            line = await loop.run_in_executor(None, sys.stdin.readline)
+            if not line:
+                return
+            for token in line.split():
+                try:
+                    client.write(token.encode("utf-8"))
+                except LspError as exc:
+                    print(f"Client {client.conn_id()} failed to write to "
+                          f"server: {exc}", flush=True)
+                    return
+                try:
+                    payload = await client.read()
+                except LspError as exc:
+                    print(f"Client {client.conn_id()} failed to read from "
+                          f"server: {exc}", flush=True)
+                    return
+                print(f"Server: {payload.decode('utf-8', 'replace')}",
+                      flush=True)
+    finally:
+        print("Exiting...", flush=True)
+
+
+def main(argv=None) -> int:
+    parser = build_parser("crunner")
+    parser.add_argument("--host", type=str, default="127.0.0.1",
+                        help="server host address")
+    args = parser.parse_args(argv)
+    if args.v:
+        lspnet.enable_debug_logs(True)
+    try:
+        asyncio.run(run_client(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

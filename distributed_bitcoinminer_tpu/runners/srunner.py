@@ -1,0 +1,84 @@
+"""Echo server runner — interactive LSP exerciser.
+
+Flag-compatible with the reference binary (ref: srunner/srunner.go:15-72):
+``--port --rdrop --wdrop --elim --ems --wsize --maxbackoff -v``, with the
+same stdout lines so shell drivers written against the stock harness work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from .. import lspnet
+from ..lsp.errors import LspError
+from ..lsp.params import (DEFAULT_EPOCH_LIMIT, DEFAULT_EPOCH_MILLIS,
+                          DEFAULT_MAX_BACKOFF_INTERVAL, DEFAULT_WINDOW_SIZE,
+                          Params)
+from ..lsp.server import new_async_server
+
+
+def build_parser(role: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=role, allow_abbrev=False)
+    p.add_argument("--port", type=int, default=9999, help="port number")
+    p.add_argument("--rdrop", type=int, default=0,
+                   help="network read drop percent")
+    p.add_argument("--wdrop", type=int, default=0,
+                   help="network write drop percent")
+    p.add_argument("--elim", type=int, default=DEFAULT_EPOCH_LIMIT,
+                   help="epoch limit")
+    p.add_argument("--ems", type=int, default=DEFAULT_EPOCH_MILLIS,
+                   help="epoch duration (ms)")
+    p.add_argument("--wsize", type=int, default=DEFAULT_WINDOW_SIZE,
+                   help="window size")
+    p.add_argument("--maxbackoff", type=int,
+                   default=DEFAULT_MAX_BACKOFF_INTERVAL,
+                   help="maximum interval epoch")
+    p.add_argument("-v", action="store_true", help="show runner logs")
+    return p
+
+
+def params_from_args(args) -> Params:
+    return Params(epoch_limit=args.elim, epoch_millis=args.ems,
+                  window_size=args.wsize, max_backoff_interval=args.maxbackoff)
+
+
+async def run_server(args) -> None:
+    lspnet.set_server_read_drop_percent(args.rdrop)
+    lspnet.set_server_write_drop_percent(args.wdrop)
+    print(f"Starting server on port {args.port}...", flush=True)
+    try:
+        server = await new_async_server(args.port, params_from_args(args))
+    except OSError as exc:
+        print(f"Failed to start Server on port {args.port}: {exc}")
+        return
+    print("Server waiting for clients...", flush=True)
+    while True:
+        try:
+            conn_id, item = await server.read()
+        except LspError:
+            return
+        if isinstance(item, Exception):
+            print(f"Client {conn_id} has died: {item}", flush=True)
+            continue
+        try:
+            server.write(conn_id, item)
+        except LspError as exc:
+            print(f"Server failed to write to connection {conn_id}: {exc}",
+                  flush=True)
+
+
+def main(argv=None) -> int:
+    args = build_parser("srunner").parse_args(argv)
+    if args.v:
+        lspnet.enable_debug_logs(True)
+    try:
+        asyncio.run(run_server(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

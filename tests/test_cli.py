@@ -27,7 +27,7 @@ def _spawn(args, cwd, extra_env=None):
     env = dict(os.environ, PYTHONPATH=_REPO, DBM_COMPUTE="host")
     env.update(extra_env or {})
     return subprocess.Popen(
-        [sys.executable, "-m", *args], cwd=cwd, env=env,
+        [sys.executable, "-m", *args], cwd=cwd, env=env, stdin=subprocess.PIPE,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
@@ -47,6 +47,29 @@ def test_three_process_round_trip(tmp_path):
         assert out.strip() == f"Result {want_hash} {want_nonce}", (out, err)
     finally:
         for proc in (client, miner, server):
+            if proc is not None:
+                proc.kill()
+                proc.wait()
+
+
+def test_srunner_crunner_echo(tmp_path):
+    """Echo runners interoperate process-to-process with reference flags
+    (ref: srunner.go:15-24, crunner.go:16-26), including a drop rate."""
+    port = _free_port()
+    pkg = "distributed_bitcoinminer_tpu.runners"
+    srv = _spawn([f"{pkg}.srunner", "--port", str(port), "--ems", "100",
+                  "--wsize", "4"], tmp_path)
+    cli = None
+    try:
+        time.sleep(1.0)
+        cli = _spawn([f"{pkg}.crunner", "--port", str(port), "--ems", "100",
+                      "--wsize", "4", "--wdrop", "15", "--maxbackoff", "2"],
+                     tmp_path)
+        out, err = cli.communicate("hello echo world\n", timeout=45)
+        assert out.count("Server: ") == 3, (out, err)
+        assert "Server: hello" in out and "Server: world" in out
+    finally:
+        for proc in (cli, srv):
             if proc is not None:
                 proc.kill()
                 proc.wait()

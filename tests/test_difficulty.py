@@ -1,0 +1,63 @@
+"""Difficulty-target mode (BASELINE config 5): in-kernel early exit and the
+streaming client, checked against the host oracle."""
+
+import asyncio
+
+from distributed_bitcoinminer_tpu.bitcoin.hash import MAX_U64, hash_op, scan_min
+from distributed_bitcoinminer_tpu.models import NonceSearcher
+
+
+def first_below(data, lower, upper, target):
+    for n in range(lower, upper + 1):
+        h = hash_op(data, n)
+        if h < target:
+            return h, n, True
+    return (*scan_min(data, lower, upper), False)
+
+
+def test_search_until_finds_first_qualifying_nonce():
+    data = "difficulty"
+    s = NonceSearcher(data, batch=128)
+    # A loose target hits quickly; the FIRST qualifying nonce must match a
+    # sequential oracle scan, not the global argmin.
+    target = 1 << 59
+    assert s.search_until(0, 4095, target) == first_below(data, 0, 4095, target)
+
+
+def test_search_until_miss_falls_back_to_argmin():
+    data = "no luck"
+    s = NonceSearcher(data, batch=64)
+    got = s.search_until(100, 1500, 1)  # impossible target
+    assert got == (*scan_min(data, 100, 1500), False)
+
+
+def test_search_until_crosses_blocks():
+    data = "cmu440"
+    s = NonceSearcher(data, batch=64)
+    target = 1 << 56  # ~1/256 per nonce; usually needs a few hundred nonces
+    assert s.search_until(0, 99999, target) == \
+        first_below(data, 0, 99999, target)
+
+
+def test_stream_until_end_to_end():
+    from distributed_bitcoinminer_tpu.apps.client import stream_until
+    from tests.test_apps import Cluster, fast_params
+
+    async def scenario():
+        async with Cluster(fast_params()) as c:
+            await c.start_miner()
+            target = 1 << 57
+            got = await asyncio.wait_for(
+                stream_until(c.hostport, "stream", target, span=500,
+                             params=c.params), 20)
+            assert got is not None
+            g_hash, g_nonce, spans = got
+            assert g_hash < target
+            assert g_hash == hash_op("stream", g_nonce)
+            # The winning nonce lies in the last span streamed; every prior
+            # span's full scan (incl. its +1 quirk nonce) missed the target.
+            lo = (spans - 1) * 500
+            assert lo <= g_nonce <= spans * 500
+            for n in range(0, lo):
+                assert hash_op("stream", n) >= target
+    asyncio.run(scenario())

@@ -1,0 +1,32 @@
+"""Native C++ scan: bit-exact vs the Python oracle, incl. digit rollovers."""
+
+import pytest
+
+from distributed_bitcoinminer_tpu import native
+from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op, scan_min
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+
+@pytest.mark.parametrize("lower,upper", [
+    (0, 500),
+    (95, 105),          # 1->2-digit rollover
+    (9_990, 10_010),    # 4->5-digit rollover
+    (99_999, 100_002),  # 5->6-digit rollover
+    (123_456, 124_000),
+])
+def test_scan_matches_oracle(lower, upper):
+    for data in ("cmu440", "", "x" * 70):  # incl. multi-block prefixes
+        assert native.scan_min_native(data, lower, upper) == \
+            scan_min(data, lower, upper)
+
+
+def test_single_hash_matches():
+    for nonce in (0, 7, 99, 1234, 10**12):
+        assert native.hash_native("msg", nonce) == hash_op("msg", nonce)
+
+
+def test_empty_range_raises():
+    with pytest.raises(ValueError):
+        native.scan_min_native("x", 5, 4)
