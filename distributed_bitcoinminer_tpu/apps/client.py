@@ -94,8 +94,10 @@ def main(argv=None) -> int:
     except ValueError:
         print(f"{argv[3]} is not a number.")
         return 1
+    from ..utils import from_env
     try:
-        result = asyncio.run(submit(argv[1], argv[2], max_nonce))
+        result = asyncio.run(submit(argv[1], argv[2], max_nonce,
+                                    from_env().params))
     except LspError as exc:
         print("Failed to connect to server:", exc)
         return 1

@@ -143,7 +143,12 @@ class MinerWorker:
 
 
 async def _run_miner(hostport: str) -> int:
-    worker = MinerWorker(hostport)
+    from ..utils import from_env
+    cfg = from_env()
+    worker = MinerWorker(hostport, params=cfg.params,
+                         searcher_factory=lambda data, batch: (
+                             cfg.make_searcher(data)),
+                         batch=cfg.batch)
     try:
         await worker.join()
     except LspError as exc:

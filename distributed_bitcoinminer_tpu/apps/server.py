@@ -35,11 +35,10 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as exc:
         print("Port must be a number:", exc)
         return 1
-    logging.basicConfig(filename="log.txt",
-                        format="%(asctime)s %(name)s %(message)s")
-    logging.getLogger("dbm").setLevel(logging.INFO)
+    from ..utils import configure_logging, from_env
+    configure_logging(logging.INFO, logfile="log.txt")
     try:
-        asyncio.run(serve(port))
+        asyncio.run(serve(port, from_env().params))
     except KeyboardInterrupt:
         pass
     return 0

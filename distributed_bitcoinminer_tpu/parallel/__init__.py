@@ -8,5 +8,7 @@ protocol) and across TPU cores inside one miner via ``shard_map`` over a 1-D
 """
 
 from .mesh_search import AXIS, device_spans, make_mesh, sharded_search_span
+from .multihost import global_mesh, initialize_multihost, is_lsp_owner
 
-__all__ = ["AXIS", "device_spans", "make_mesh", "sharded_search_span"]
+__all__ = ["AXIS", "device_spans", "make_mesh", "sharded_search_span",
+           "global_mesh", "initialize_multihost", "is_lsp_owner"]

@@ -1,0 +1,55 @@
+"""Multi-host (pod-scale) wiring: one LSP miner per pod, DCN + ICI split.
+
+Deployment shape per the north star: a whole multi-host TPU pod joins the
+scheduler as ONE miner. Every host runs the same SPMD program (standard JAX
+multi-controller); host 0 additionally owns the LSP client socket. Chunk
+bounds arriving over LSP are host-side Python scalars, broadcast to all
+hosts out-of-band (the per-host sub-span derives deterministically from
+process_index), so the device program never sees DCN — intra-search
+communication is exactly the staged-pmin merge over ICI from
+``mesh_search``, now spanning the global mesh.
+
+The reference's analog is its LSP/UDP stack (SURVEY §2, communication
+backend): host<->host traffic stays on the unchanged wire protocol; the
+NCCL/MPI role is played entirely by XLA collectives.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from .mesh_search import make_mesh
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Join the JAX distributed runtime; returns True in multi-host mode.
+
+    With no arguments, reads ``DBM_COORDINATOR`` / ``DBM_NUM_PROCS`` /
+    ``DBM_PROC_ID`` and stays single-host when unset (the common case on
+    one chip or one host).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "DBM_COORDINATOR")
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("DBM_NUM_PROCS", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("DBM_PROC_ID", "0"))
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    return True
+
+
+def global_mesh():
+    """1-D mesh over every device of every host (ICI+DCN per JAX layout)."""
+    return make_mesh(devices=jax.devices())
+
+
+def is_lsp_owner() -> bool:
+    """True on the one host that speaks LSP for the whole pod (host 0)."""
+    return jax.process_index() == 0

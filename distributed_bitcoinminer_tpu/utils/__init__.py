@@ -1,0 +1,15 @@
+"""Cross-cutting utilities: config, structured logging, profiling.
+
+The reference's auxiliary subsystems (SURVEY §5) map here: its opt-in debug
+logs (ref: lspnet/conn.go:32-42, srunner.go:33-37) become ``configure_logging``
+plus the lspnet per-packet trace switch; its file logger
+(ref: bitcoin/server/server.go:428-445) becomes the standard ``logging``
+setup; profiling adds JAX profiler hooks the reference never had.
+"""
+
+from .config import FrameworkConfig, from_env
+from .logging import configure_logging
+from .profiling import Timer, device_trace
+
+__all__ = ["FrameworkConfig", "from_env", "configure_logging",
+           "Timer", "device_trace"]

@@ -1,0 +1,67 @@
+"""One config object for a whole endpoint (transport + compute plane).
+
+The reference threads ``lsp.Params`` plus ad-hoc CLI flags through every
+binary (ref: lsp/params.go:8-42, srunner.go:15-24, server/server.go:447-457);
+here those knobs live in a single dataclass with environment overrides so
+every process — scheduler, miner, runner — is configured the same way.
+
+Environment variables:
+
+- ``DBM_COMPUTE``: ``auto`` (default; widest JAX plane), ``host`` (native
+  C++/SHA-NI scan, no JAX), ``jax`` (force single-device JAX).
+- ``DBM_BATCH``: per-device lane count per device step.
+- ``DBM_EPOCH_LIMIT`` / ``DBM_EPOCH_MILLIS`` / ``DBM_WINDOW`` /
+  ``DBM_MAX_BACKOFF``: transport parameters (defaults 5/2000/1/0, matching
+  lsp/params.go:29-36).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ..lsp.params import Params
+
+
+@dataclass
+class FrameworkConfig:
+    params: Params = field(default_factory=Params)
+    compute: str = "auto"          # auto | host | jax
+    batch: int | None = None       # None -> platform default
+
+    def make_searcher(self, data: str):
+        """Build the configured searcher for one message string."""
+        if self.compute == "host":
+            from ..apps.miner import HostSearcher
+            return HostSearcher(data)
+        if self.compute == "jax":
+            from ..models import NonceSearcher
+            return NonceSearcher(data, batch=self.batch or (1 << 20))
+        from ..apps.miner import default_searcher_factory
+        return default_searcher_factory(data, self.batch)
+
+
+def _int_env(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def from_env() -> FrameworkConfig:
+    params = Params(
+        epoch_limit=_int_env("DBM_EPOCH_LIMIT", Params().epoch_limit),
+        epoch_millis=_int_env("DBM_EPOCH_MILLIS", Params().epoch_millis),
+        window_size=_int_env("DBM_WINDOW", Params().window_size),
+        max_backoff_interval=_int_env("DBM_MAX_BACKOFF",
+                                      Params().max_backoff_interval),
+    )
+    batch = os.environ.get("DBM_BATCH")
+    return FrameworkConfig(
+        params=params,
+        compute=os.environ.get("DBM_COMPUTE", "auto"),
+        batch=int(batch) if batch else None,
+    )

@@ -1,0 +1,38 @@
+"""Structured logging for every framework process.
+
+Replaces the reference's two logging mechanisms — per-packet stderr debug
+lines behind ``lspnet.EnableDebugLogs`` (ref: lspnet/conn.go:32-42) and the
+scheduler's microsecond file logger (ref: bitcoin/server/server.go:428-445) —
+with one ``logging`` configuration under the ``dbm`` namespace, plus the
+same per-packet trace switch on the simulated transport.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname).1s %(message)s"
+_DATEFMT = "%H:%M:%S"
+
+
+def configure_logging(level: int = logging.INFO,
+                      logfile: Optional[str] = None,
+                      packet_trace: bool = False) -> logging.Logger:
+    """Set up the ``dbm`` logger tree; returns the root framework logger.
+
+    ``packet_trace`` also flips the lspnet per-packet DROP/DELAY trace (the
+    reference's EnableDebugLogs).
+    """
+    logger = logging.getLogger("dbm")
+    logger.setLevel(level)
+    logger.handlers.clear()
+    handler = (logging.FileHandler(logfile) if logfile
+               else logging.StreamHandler(sys.stderr))
+    handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+    logger.addHandler(handler)
+    if packet_trace:
+        from .. import lspnet
+        lspnet.enable_debug_logs(True)
+    return logger

@@ -1,0 +1,43 @@
+"""Profiling hooks: wall-clock meters and the JAX device profiler.
+
+The reference has no profiler (SURVEY §5); the TPU build adds two:
+``Timer`` for host-side rate meters (nonces/sec — the BASELINE metric) and
+``device_trace`` wrapping ``jax.profiler.trace`` so a search can be captured
+for TensorBoard/XProf without touching call sites.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+class Timer:
+    """Wall-clock meter: ``with Timer() as t: ...; t.rate(n)``."""
+
+    def __init__(self) -> None:
+        self.seconds = 0.0
+        self._t0: Optional[float] = None
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.seconds = time.perf_counter() - self._t0
+
+    def rate(self, items: int) -> float:
+        """items/second (0 when nothing was measured)."""
+        return items / self.seconds if self.seconds else 0.0
+
+
+@contextlib.contextmanager
+def device_trace(logdir: Optional[str]) -> Iterator[None]:
+    """Capture a JAX profiler trace into ``logdir`` (no-op when None)."""
+    if not logdir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(logdir):
+        yield

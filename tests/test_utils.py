@@ -1,0 +1,49 @@
+"""Config/logging/profiling utilities."""
+
+import logging
+
+from distributed_bitcoinminer_tpu.lsp.params import Params
+from distributed_bitcoinminer_tpu.utils import (
+    FrameworkConfig, Timer, configure_logging, from_env)
+
+
+def test_from_env_defaults(monkeypatch):
+    for var in ("DBM_COMPUTE", "DBM_BATCH", "DBM_EPOCH_LIMIT",
+                "DBM_EPOCH_MILLIS", "DBM_WINDOW", "DBM_MAX_BACKOFF"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = from_env()
+    assert cfg.params == Params()
+    assert cfg.compute == "auto" and cfg.batch is None
+
+
+def test_from_env_overrides(monkeypatch):
+    monkeypatch.setenv("DBM_COMPUTE", "host")
+    monkeypatch.setenv("DBM_BATCH", "4096")
+    monkeypatch.setenv("DBM_EPOCH_MILLIS", "250")
+    monkeypatch.setenv("DBM_WINDOW", "7")
+    cfg = from_env()
+    assert cfg.compute == "host"
+    assert cfg.batch == 4096
+    assert cfg.params.epoch_millis == 250 and cfg.params.window_size == 7
+
+
+def test_host_searcher_from_config():
+    s = FrameworkConfig(compute="host").make_searcher("cfg test")
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+    assert s.search(0, 300) == scan_min("cfg test", 0, 300)
+
+
+def test_configure_logging_and_timer(tmp_path):
+    logfile = tmp_path / "log.txt"
+    logger = configure_logging(logging.DEBUG, str(logfile))
+    logger.info("hello structured world")
+    logging.getLogger("dbm.scheduler").info("child propagates")
+    for h in logger.handlers:
+        h.flush()
+    text = logfile.read_text()
+    assert "hello structured world" in text and "child propagates" in text
+
+    with Timer() as t:
+        sum(range(1000))
+    assert t.seconds >= 0
+    assert Timer().rate(100) == 0.0
