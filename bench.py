@@ -8,69 +8,179 @@ The reference publishes no numbers (see BASELINE.md); the baseline is the
 structural estimate of the Go miner's single-threaded hot loop
 (ref: bitcoin/miner/miner.go:53-59 — one stdlib sha256 + string format per
 nonce), taken at the generous top of its 10^6-10^7 nonces/s envelope.
+
+Hardening (round-2, per VERDICT):
+
+- The accelerator backend is probed in a *subprocess* with a deadline, so a
+  wedged chip can never hang the bench; on probe failure the bench falls
+  back to CPU and still prints a real (CPU) measurement with the probe
+  error recorded in ``detail``.
+- Any exception still produces the one JSON line (value 0, error recorded)
+  with exit code 0 rather than a bare traceback.
+- The measured range lives in a single digit class with one batch geometry,
+  so exactly ONE XLA compilation signature is warmed before timing, and the
+  persistent compilation cache is configured so re-runs skip even that.
+- Tier selection via ``DBM_COMPUTE`` (auto | jnp | pallas); auto measures
+  both device tiers and reports the faster.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 GO_MINER_BASELINE_NPS = 1.0e7  # upper structural estimate, BASELINE.md
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+_PROBE_CODE = (
+    "import jax, json; d = jax.devices(); "
+    "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
+)
 
 
-def main() -> None:
+def _emit(value: float, detail: dict) -> None:
+    print(json.dumps({
+        "metric": "nonce_search_throughput",
+        "value": round(value, 1),
+        "unit": "nonces/sec",
+        "vs_baseline": round(value / GO_MINER_BASELINE_NPS, 4),
+        "detail": detail,
+    }), flush=True)
+
+
+def _probe_backend(timeout_s: float) -> dict:
+    """Initialize the default JAX backend in a child process with a deadline.
+
+    Returns {"platform", "n"} on success; {"error": ...} when init fails or
+    hangs (round-1 failure mode: the chip held by a timed-out process made
+    bare ``jax.devices()`` hang past the driver budget).
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"backend init exceeded {timeout_s:.0f}s deadline"}
+    if proc.returncode != 0:
+        return {"error": f"backend init failed: {proc.stderr.strip()[-400:]}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable probe output: {proc.stdout[-200:]}"}
+
+
+def _measure(searcher, lower: int, upper: int, min_time_s: float,
+             timer_cls) -> tuple[float, float, int]:
+    """(nonces/sec, seconds, repeats) — repeats the identical search (same
+    compile signature) until the timed window passes ``min_time_s``."""
+    count = upper - lower + 1
+    with timer_cls() as t:
+        searcher.search(lower, upper)
+    secs, reps = t.seconds, 1
+    while secs < min_time_s and reps < 64:
+        more = min(64 - reps, max(1, int(min_time_s / max(secs / reps, 1e-9))
+                                  - reps))
+        with timer_cls() as t:
+            for _ in range(more):
+                searcher.search(lower, upper)
+        secs += t.seconds
+        reps += more
+    return count * reps / secs, secs, reps
+
+
+def main() -> int:
+    init_deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
+    probe = _probe_backend(init_deadline)
+    force_cpu = "error" in probe
+
+    if force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+
+    if force_cpu:
+        # Config-level force: the image's sitecustomize hooks backend
+        # resolution, so the env var alone does not stop jax.devices() from
+        # touching the real backend (VERDICT round-1 root cause).
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
     from distributed_bitcoinminer_tpu.models import (
         NonceSearcher, ShardedNonceSearcher)
     from distributed_bitcoinminer_tpu.parallel import make_mesh
+    from distributed_bitcoinminer_tpu.utils.profiling import Timer
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
     batch = (1 << 20) if on_accel else (1 << 13)
-    upper = ((1 << 26) - 1) if on_accel else ((1 << 18) - 1)
+    # One digit class, one aligned 10^9 block geometry => ONE compile
+    # signature for the whole measurement (VERDICT round-1 weakness 5: the
+    # old [0, 2^26) range spanned 8 digit classes = 8 compilations).
+    lower = 2_000_000_000 if on_accel else 100_000
+    count = (1 << 26) if on_accel else (1 << 17)
+    upper = lower + count - 1
+    min_time_s = 1.0 if on_accel else 0.5
     data = "cmu440"
+    tier_req = os.environ.get("DBM_COMPUTE", "auto").lower()
 
-    if len(devices) > 1:
-        searcher = ShardedNonceSearcher(data, batch=batch,
-                                        mesh=make_mesh(len(devices)))
-    else:
-        searcher = NonceSearcher(data, batch=batch)
+    def build(tier: str):
+        if len(devices) > 1:
+            return ShardedNonceSearcher(
+                data, batch=batch, mesh=make_mesh(len(devices)), tier=tier)
+        return NonceSearcher(data, batch=batch, tier=tier)
 
-    # Correctness gate on a small range before timing.
-    small = searcher.search(0, 9999)
-    oracle = scan_min(data, 0, 9999)
-    assert small == oracle, f"bench correctness gate failed: {small} != {oracle}"
+    tiers = [tier_req] if tier_req in ("jnp", "pallas") else ["jnp", "pallas"]
+    results, errors = {}, {}
+    gate_lo, gate_hi = lower, lower + 9_999
+    want = scan_min(data, gate_lo, gate_hi)
+    for tier in tiers:
+        try:
+            searcher = build(tier)
+            got = searcher.search(gate_lo, gate_hi)
+            assert got == want, f"correctness gate: {got} != {want}"
+            t0 = time.time()
+            searcher.search(lower, upper)  # compile + warm the one signature
+            warm_s = time.time() - t0
+            rate, secs, reps = _measure(searcher, lower, upper, min_time_s,
+                                        Timer)
+            results[tier] = {"rate": rate, "secs": secs, "reps": reps,
+                             "warmup_s": round(warm_s, 3)}
+        except Exception as exc:  # noqa: BLE001 — one tier failing must not
+            errors[tier] = repr(exc)[:300]  # kill the other's number
+    if not results:
+        _emit(0.0, {"error": "all tiers failed", "tiers": errors,
+                    "probe": probe})
+        return 0
 
-    # Warm-up pass compiles every (rem, k, nbatches) signature of the range.
-    t0 = time.time()
-    searcher.search(0, upper)
-    warm_s = time.time() - t0
-
-    t0 = time.time()
-    best_hash, best_nonce = searcher.search(0, upper)
-    dt = time.time() - t0
-    rate = (upper + 1) / dt
-
-    print(json.dumps({
-        "metric": "nonce_search_throughput",
-        "value": round(rate, 1),
-        "unit": "nonces/sec",
-        "vs_baseline": round(rate / GO_MINER_BASELINE_NPS, 3),
-        "detail": {
-            "devices": len(devices),
-            "platform": devices[0].platform,
-            "range": upper + 1,
-            "batch": batch,
-            "search_s": round(dt, 3),
-            "warmup_s": round(warm_s, 3),
-            "min_hash": best_hash,
-            "argmin_nonce": best_nonce,
-        },
-    }))
+    best_tier = max(results, key=lambda t: results[t]["rate"])
+    best = results[best_tier]
+    _emit(best["rate"], {
+        "tier": best_tier,
+        "devices": len(devices),
+        "platform": devices[0].platform,
+        "range": count,
+        "batch": batch,
+        "repeats": best["reps"],
+        "timed_s": round(best["secs"], 3),
+        "warmup_s": best["warmup_s"],
+        "all_tiers": {t: round(r["rate"], 1) for t, r in results.items()},
+        **({"tier_errors": errors} if errors else {}),
+        **({"probe": probe} if force_cpu else {}),
+    })
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except Exception as exc:  # noqa: BLE001 — the one-JSON-line contract
+        _emit(0.0, {"error": repr(exc)[:500]})
+        sys.exit(0)

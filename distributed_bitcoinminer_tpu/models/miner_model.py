@@ -18,6 +18,7 @@ nonce wins everywhere).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -28,6 +29,22 @@ from ..ops.sha256_host import sha256_midstate
 from ..ops.sha256_jnp import build_tail_template
 
 _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
+
+#: Lanes per Pallas grid step: 32 sublanes x 128 lanes keeps the ~26 live
+#: (rows, 128) uint32 tiles of the unrolled compression well under VMEM.
+_PALLAS_ROWS = 32
+_PALLAS_STEP = _PALLAS_ROWS * 128
+
+
+def default_tier() -> str:
+    """Compute-tier choice: ``DBM_COMPUTE`` env (jnp | pallas), default jnp."""
+    return os.environ.get("DBM_COMPUTE", "jnp").lower()
+
+
+def pallas_interpret_mode() -> bool:
+    """Pallas runs in interpret mode off-TPU (tests on the CPU mesh)."""
+    import jax
+    return jax.default_backend() not in ("tpu", "axon")
 
 
 def _digit_classes(lower: int, upper: int):
@@ -62,12 +79,18 @@ class NonceSearcher:
     """Exact arg-min hash search for one message, chunk-schedulable.
 
     ``batch`` is the lane count per device step; on TPU use >= 2**20 to keep
-    the VPU busy, on CPU tests a few thousand.
+    the VPU busy, on CPU tests a few thousand. ``tier`` selects the device
+    kernel: ``jnp`` (rolled fori_loop compression) or ``pallas`` (fully
+    unrolled register-resident Mosaic kernel); None reads ``DBM_COMPUTE``.
     """
 
-    def __init__(self, data: str, batch: int = 1 << 20):
+    def __init__(self, data: str, batch: int = 1 << 20,
+                 tier: str | None = None):
         self.data = data
         self.batch = batch
+        self.tier = tier if tier is not None else default_tier()
+        if self.tier not in ("jnp", "pallas"):
+            raise ValueError(f"unknown compute tier {self.tier!r}")
         self._prefix = data.encode("utf-8") + b" "
         self._midstate_cache: dict[str, tuple] = {}
 
@@ -115,6 +138,26 @@ class NonceSearcher:
     def search_block(self, plan: _BlockPlan):
         """Dispatch one block; returns (hi, lo, idx) device scalars."""
         i0, nbatches = self._block_geometry(plan)
+        total = self.batch * nbatches
+        if self.tier == "pallas" and total % 128 == 0:
+            import contextlib
+
+            import jax
+
+            from ..ops.sha256_pallas import pallas_search_span
+            rows = max(1, min(total, _PALLAS_STEP) // 128)
+            interpret = pallas_interpret_mode()
+            # Off-TPU the kernel runs eagerly through the interpreter:
+            # letting XLA:CPU compile the jitted unrolled 64-round chain
+            # blows up superlinearly (minutes), while eager interpret of
+            # the tiny test shapes takes seconds and stays bit-exact.
+            ctx = jax.disable_jit() if interpret else contextlib.nullcontext()
+            with ctx:
+                return pallas_search_span(
+                    np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                    np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+                    rem=plan.rem, k=plan.k, rows=rows,
+                    nsteps=total // (rows * 128), interpret=interpret)
         return search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),

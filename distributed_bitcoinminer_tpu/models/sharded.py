@@ -22,8 +22,9 @@ class ShardedNonceSearcher(NonceSearcher):
     ``n_devices * batch * nbatches`` lanes.
     """
 
-    def __init__(self, data: str, batch: int = 1 << 20, mesh=None):
-        super().__init__(data, batch)
+    def __init__(self, data: str, batch: int = 1 << 20, mesh=None,
+                 tier: str | None = None):
+        super().__init__(data, batch, tier=tier)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = self.mesh.devices.size
 
@@ -35,4 +36,4 @@ class ShardedNonceSearcher(NonceSearcher):
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             i0_d, plan.lo_i, plan.hi_i,
             mesh=self.mesh, rem=plan.rem, k=plan.k,
-            batch=self.batch, nbatches=nbatches)
+            batch=self.batch, nbatches=nbatches, tier=self.tier)

@@ -31,6 +31,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.search import span_scan_body
+from ..ops.sha256_jnp import ensure_varying
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 
@@ -47,9 +48,11 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "rem", "k", "batch", "nbatches"))
+    jax.jit,
+    static_argnames=("mesh", "rem", "k", "batch", "nbatches", "tier"))
 def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
-                        rem: int, k: int, batch: int, nbatches: int):
+                        rem: int, k: int, batch: int, nbatches: int,
+                        tier: str = "jnp"):
     """Scan ``n`` disjoint spans, one per device, and merge on device.
 
     midstate: (8,) uint32 — replicated.
@@ -58,6 +61,8 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
         ``i0_d[d] + [0, nbatches*batch)``).
     lo_i, hi_i: uint32 scalars — the block's global valid lane window;
         lanes outside it contribute the 0xffffffff sentinel.
+    tier: per-device kernel — ``jnp`` (rolled span scan) or ``pallas``
+        (unrolled Mosaic kernel; the collective merge is identical).
 
     Returns replicated (best_hi, best_lo, best_i) uint32 scalars.
     """
@@ -69,9 +74,27 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
         in_specs=(P(), P(), P(AXIS), P(), P()),
         out_specs=(P(), P(), P()))
     def body(midstate, template, i0, lo_i, hi_i):
-        hi_h, lo_h, idx = span_scan_body(
-            midstate, template, i0[0], lo_i, hi_i,
-            rem=rem, k=k, batch=batch, nbatches=nbatches, vary_axes=(AXIS,))
+        total = batch * nbatches
+        from ..models.miner_model import _PALLAS_STEP, pallas_interpret_mode
+        # The pallas tier is honored only on real TPU: inside this jitted
+        # shard_map body interpret mode cannot run eagerly, and XLA:CPU
+        # compiling the unrolled 64-round chain blows up (minutes). Off-TPU
+        # the body falls back to the bit-identical rolled jnp scan.
+        if (tier == "pallas" and total % 128 == 0
+                and not pallas_interpret_mode()):
+            from ..ops.sha256_pallas import pallas_search_span
+            rows = max(1, min(total, _PALLAS_STEP) // 128)
+            hi_h, lo_h, idx = pallas_search_span(
+                midstate, template, i0[0], lo_i, hi_i,
+                rem=rem, k=k, rows=rows, nsteps=total // (rows * 128),
+                interpret=False)
+            hi_h, lo_h, idx = (ensure_varying(x, (AXIS,))
+                               for x in (hi_h, lo_h, idx))
+        else:
+            hi_h, lo_h, idx = span_scan_body(
+                midstate, template, i0[0], lo_i, hi_i,
+                rem=rem, k=k, batch=batch, nbatches=nbatches,
+                vary_axes=(AXIS,))
         # Cross-device exact lexicographic argmin as three staged pmin
         # collectives over scalars (replication-invariant outputs, so the
         # merged triple is provably identical on every device).

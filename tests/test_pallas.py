@@ -1,0 +1,83 @@
+"""Pallas kernel tier: bit-exactness vs the host oracle.
+
+Off-TPU the fully-unrolled kernel is validated in *eager interpret* mode
+(``jax.disable_jit()`` + ``interpret=True``): letting XLA:CPU compile the
+jitted unrolled 64-round chain blows up superlinearly, while the eager
+interpreter evaluates the same kernel math in seconds. On a real chip the
+same code paths lower through Mosaic (exercised by bench.py / the driver).
+
+Ref parity: the kernel implements bitcoin/hash.go:13-17's op with
+bitcoin/miner/miner.go:54-58's first-seen-wins tie rule.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+from distributed_bitcoinminer_tpu.models import NonceSearcher
+from distributed_bitcoinminer_tpu.models.miner_model import default_tier
+from distributed_bitcoinminer_tpu.ops.sha256_host import sha256_midstate
+from distributed_bitcoinminer_tpu.ops.sha256_jnp import build_tail_template
+from distributed_bitcoinminer_tpu.ops.sha256_pallas import pallas_search_span
+
+
+def _kernel_span(data: str, i0: int, lo: int, hi: int, k: int,
+                 rows: int, nsteps: int, top: str = ""):
+    prefix = data.encode("utf-8") + b" " + top.encode("ascii")
+    midstate, tail = sha256_midstate(prefix)
+    template = build_tail_template(tail, k, len(prefix) + k)
+    with jax.disable_jit():
+        hi_h, lo_h, idx = pallas_search_span(
+            np.asarray(midstate, np.uint32), template.astype(np.uint32),
+            np.uint32(i0), np.uint32(lo), np.uint32(hi),
+            rem=len(tail), k=k, rows=rows, nsteps=nsteps, interpret=True)
+    return (int(hi_h) << 32) | int(lo_h), int(idx)
+
+
+def test_kernel_exact_vs_oracle_single_step():
+    got = _kernel_span("cmu440", i0=0, lo=100, hi=355, k=3, rows=2, nsteps=1)
+    assert got == scan_min("cmu440", 100, 355)
+
+
+def test_kernel_exact_vs_oracle_multi_step():
+    # nsteps > 1 exercises the per-step partial rows + cross-step argmin.
+    got = _kernel_span("pallas", i0=0, lo=0, hi=511, k=3, rows=1, nsteps=4)
+    assert got == scan_min("pallas", 0, 511)
+
+
+def test_kernel_masks_invalid_lanes():
+    # Window strictly inside the lane span: lanes outside [lo, hi] must not
+    # contribute even when their hashes would win.
+    got = _kernel_span("mask", i0=0, lo=130, hi=200, k=3, rows=1, nsteps=2)
+    assert got == scan_min("mask", 130, 200)
+
+
+def test_kernel_two_block_tail():
+    # Long message => 2-block tail template (the nblocks=2 kernel variant).
+    data = "x" * 60
+    got = _kernel_span(data, i0=0, lo=0, hi=255, k=3, rows=1, nsteps=2)
+    assert got == scan_min(data, 0, 255)
+
+
+def test_searcher_pallas_tier_exact():
+    s = NonceSearcher("cmu440", batch=128, tier="pallas")
+    assert s.search(100, 399) == scan_min("cmu440", 100, 399)
+
+
+def test_searcher_pallas_tier_matches_jnp_tier():
+    sp = NonceSearcher("tier", batch=128, tier="pallas")
+    sj = NonceSearcher("tier", batch=128, tier="jnp")
+    assert sp.search(0, 299) == sj.search(0, 299)
+
+
+def test_default_tier_env(monkeypatch):
+    monkeypatch.delenv("DBM_COMPUTE", raising=False)
+    assert default_tier() == "jnp"
+    monkeypatch.setenv("DBM_COMPUTE", "PALLAS")
+    assert default_tier() == "pallas"
+    monkeypatch.setenv("DBM_COMPUTE", "bogus")
+    with pytest.raises(ValueError):
+        NonceSearcher("x", batch=128)
