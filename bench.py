@@ -108,8 +108,16 @@ def main() -> int:
         # resolution, so the env var alone does not stop jax.devices() from
         # touching the real backend (VERDICT round-1 root cause).
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(_REPO, ".jax_cache"))
+    else:
+        # Honor a user-supplied JAX_PLATFORMS even when the accelerator
+        # probe succeeds (same sitecustomize-override mechanism).
+        from distributed_bitcoinminer_tpu.utils.config import (
+            apply_jax_platform_env)
+        apply_jax_platform_env()
+    # Host-keyed cache: artifacts AOT-compiled on another machine hang or
+    # SIGILL when loaded here (see utils/config.host_cache_dir).
+    from distributed_bitcoinminer_tpu.utils.config import host_cache_dir
+    jax.config.update("jax_compilation_cache_dir", host_cache_dir(_REPO))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
@@ -154,7 +162,12 @@ def main() -> int:
             results[tier] = {"rate": rate, "secs": secs, "reps": reps,
                              "warmup_s": round(warm_s, 3)}
         except Exception as exc:  # noqa: BLE001 — one tier failing must not
-            errors[tier] = repr(exc)[:300]  # kill the other's number
+            # kill the other's number; keep the head AND tail of the message
+            # so file:line survives truncation (ADVICE r2: the r02 Mosaic
+            # error was cut mid-path).
+            msg = repr(exc)
+            errors[tier] = (msg if len(msg) <= 600
+                            else msg[:300] + " ... " + msg[-280:])
     if not results:
         _emit(0.0, {"error": "all tiers failed", "tiers": errors,
                     "probe": probe})
@@ -180,7 +193,13 @@ def main() -> int:
 
 if __name__ == "__main__":
     try:
-        sys.exit(main())
+        rc = main()
     except Exception as exc:  # noqa: BLE001 — the one-JSON-line contract
         _emit(0.0, {"error": repr(exc)[:500]})
-        sys.exit(0)
+        rc = 0
+    # Hard exit: the axon/jax stack leaves interpreter-shutdown finalizers
+    # that can hang for minutes after the JSON line is already printed
+    # (round-3 finding; the driver must never see that as a bench timeout).
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

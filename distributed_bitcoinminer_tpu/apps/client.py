@@ -45,15 +45,21 @@ async def stream_until(hostport: str, message: str, target: int,
     early-exit in-kernel via their own target heuristics if they implement
     one. Returns (hash, nonce, spans_scanned) or None on disconnect /
     exhausted ``max_nonce``.
+
+    ``max_nonce=None`` bounds the stream at the end of the nonce space
+    (2^64 - 1) rather than looping forever on an unreachable target
+    (ADVICE r1/r2): the op hashes ``"<data> <nonce>"`` with a uint64 nonce
+    (ref: bitcoin/hash.go:13-17), so the search space is finite.
     """
+    from ..bitcoin.hash import MAX_U64
+    if max_nonce is None:
+        max_nonce = MAX_U64
     client = await new_async_client(hostport, params)
     spans = 0
     lower = start
     try:
-        while max_nonce is None or lower <= max_nonce:
-            upper = lower + span - 1
-            if max_nonce is not None:
-                upper = min(upper, max_nonce)
+        while lower <= max_nonce:
+            upper = min(lower + span - 1, max_nonce)
             client.write(new_request(message, lower, upper).to_json())
             try:
                 payload = await client.read()

@@ -57,7 +57,9 @@ def default_searcher_factory(data: str, batch: Optional[int] = None):
 
     from ..models import NonceSearcher, ShardedNonceSearcher
     from ..parallel import make_mesh
+    from ..utils.config import apply_jax_platform_env
 
+    apply_jax_platform_env()
     devices = jax.devices()
     if batch is None:
         batch = (1 << 20) if devices[0].platform != "cpu" else (1 << 12)

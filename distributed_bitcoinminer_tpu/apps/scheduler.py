@@ -58,14 +58,24 @@ class Chunk:
     data: str
     lower: int
     upper: int              # exclusive end, as sent on the wire
+    # Set when the requesting client drops: the chunk stays in the miner's
+    # pending FIFO (its Result must still pop in order) but no longer
+    # counts against the miner's availability.
+    cancelled: bool = False
 
 
 @dataclass
 class MinerState:
     conn_id: int
-    available: bool = True
     # Every Request written to this miner, in write order (see module doc).
     pending: list = field(default_factory=list)
+
+    @property
+    def available(self) -> bool:
+        """Derived, not stored (ADVICE r2): a miner is available iff it has
+        no LIVE pending chunk. Cancelled chunks still occupy the FIFO (their
+        stale Results pop in order) without blocking new assignments."""
+        return not any(not c.cancelled for c in self.pending)
 
 
 @dataclass
@@ -140,7 +150,6 @@ class Scheduler:
         if miner is None or not miner.pending:
             return
         chunk = miner.pending.pop(0)   # the Result answers the oldest Request
-        miner.available = not miner.pending
         # A freed miner immediately absorbs one parked chunk
         # (ref: server.go:285-304) — BEFORE the stale-Result return, so a
         # miner freed by a stale answer still rescues parked work.
@@ -186,10 +195,14 @@ class Scheduler:
             curr = self.current
             if curr is not None and curr.conn_id == conn_id:
                 # Cancel immediately (divergence, see module docstring):
-                # free the pool, discard parked chunks, start the next
-                # request; stale Results die on the pending-FIFO pop.
+                # mark the dead request's chunks cancelled — the pool frees
+                # (availability is derived) while the FIFO pop discipline
+                # for their stale Results is preserved — discard parked
+                # chunks, start the next request.
                 for m in self.miners:
-                    m.available = True
+                    for c in m.pending:
+                        if c.job_id == curr.job_id:
+                            c.cancelled = True
                 self.parked.clear()
                 self.current = None
                 if self.queue and self.miners:
@@ -233,7 +246,6 @@ class Scheduler:
             start = end
 
     def _assign_chunk(self, miner: MinerState, chunk: Chunk) -> None:
-        miner.available = False
         miner.pending.append(chunk)
         self._write(miner.conn_id,
                     new_request(chunk.data, chunk.lower, chunk.upper))

@@ -139,25 +139,23 @@ class NonceSearcher:
         """Dispatch one block; returns (hi, lo, idx) device scalars."""
         i0, nbatches = self._block_geometry(plan)
         total = self.batch * nbatches
-        if self.tier == "pallas" and total % 128 == 0:
-            import contextlib
-
-            import jax
-
+        if self.tier == "pallas":
             from ..ops.sha256_pallas import pallas_search_span
             rows = max(1, min(total, _PALLAS_STEP) // 128)
-            interpret = pallas_interpret_mode()
-            # Off-TPU the kernel runs eagerly through the interpreter:
-            # letting XLA:CPU compile the jitted unrolled 64-round chain
-            # blows up superlinearly (minutes), while eager interpret of
-            # the tiny test shapes takes seconds and stays bit-exact.
-            ctx = jax.disable_jit() if interpret else contextlib.nullcontext()
-            with ctx:
-                return pallas_search_span(
-                    np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                    np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-                    rem=plan.rem, k=plan.k, rows=rows,
-                    nsteps=total // (rows * 128), interpret=interpret)
+            per_step = rows * 128
+            # Round the step count UP: overscanned lanes past hi_i are
+            # masked to the sentinel inside the kernel, while flooring
+            # silently dropped the top of non-step-aligned blocks
+            # (round-3 review finding).
+            nsteps = -(-total // per_step)
+            # Off-TPU the kernel runs in the Mosaic TPU simulator
+            # (pltpu.InterpretParams — seconds per grid step, bit-exact);
+            # on the chip it lowers through Mosaic.
+            return pallas_search_span(
+                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+                rem=plan.rem, k=plan.k, rows=rows, nsteps=nsteps,
+                interpret=pallas_interpret_mode())
         return search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),

@@ -18,7 +18,18 @@ logger = logging.getLogger("dbm.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "sha256_scan.cpp")
-_LIB = os.path.join(_DIR, "libdbm_native.so")
+
+
+def _lib_path() -> str:
+    """ISA-tagged artifact name: ``-march=native`` code SIGILLs when a
+    cached ``.so`` travels to a host with fewer ISA extensions (ADVICE
+    r1/r2: the mtime-only cache key was a cross-host trap — same failure
+    family as the poisoned JAX persistent cache)."""
+    from ..utils.config import host_fingerprint
+    return os.path.join(_DIR, f"libdbm_native-{host_fingerprint()}.so")
+
+
+_LIB = _lib_path()
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

@@ -4,18 +4,31 @@ The hot op of the framework (ref: bitcoin/hash.go:13-17 driven by
 bitcoin/miner/miner.go:52-59), hand-lowered for the TPU VPU:
 
 - Grid = lane blocks of ``rows x 128`` nonces; each grid step formats the k
-  ASCII digits in registers, runs all 64 compression rounds fully unrolled
-  on (rows, 128) uint32 tiles (schedule window held in registers — no HBM
-  round-trips inside the hash), and reduces its block to one
-  (hash_hi, hash_lo, index) triple written to a per-step output row.
-- All parameters (span start, valid window, midstate, tail template) ride in
-  a single scalar-prefetch uint32 vector; the kernel touches HBM only for
-  the 3-word per-step result.
-- The final cross-step lexicographic argmin is a tiny jnp reduce.
+  ASCII digits in registers and runs the 64-round compression on
+  (rows, 128) uint32 tiles. ALL 64 rounds run as one ``lax.fori_loop``
+  over four 16-round schedule blocks whose window lives in loop-carried
+  registers and whose K constants are dynamic reads from the
+  scalar-prefetch SMEM vector; block 0 skips the schedule update via a
+  cheap ``where`` guard. The rolled form keeps the traced graph ~16x
+  smaller than a full unroll: Mosaic still register-allocates the carries,
+  while XLA:CPU compiles it in seconds — unrolling even ~12 rounds outside
+  the loop sent XLA:CPU's pass pipeline into a superlinear blowup that
+  round 2 misread as "interpret is slow".
+- The result rides in three (rows, 128) accumulator outputs holding the
+  elementwise running lexicographic min across grid steps. Their BlockSpec
+  is the WHOLE array with a constant index map, which is always
+  Mosaic-legal (round 2 shipped a per-step (1, 3) output tile, violating
+  the (8, 128) tiling rule and failing to lower) and keeps the
+  accumulators resident in VMEM for the entire sequential grid.
+- All parameters (span start, valid window, midstate, tail template, K
+  table) ride in a single scalar-prefetch uint32 vector; the kernel never
+  touches HBM after prefetch.
+- The final cross-lane lexicographic argmin over rows*128 entries is a
+  tiny jnp reduce outside the kernel.
 
-Bit-identical to the host oracle, including ties (lowest nonce wins: within
-a step via the masked lex-argmin, across steps because indices ascend with
-the grid).
+Bit-identical to the host oracle, including ties (lowest nonce wins:
+within a lane position across steps because the strict-less merge keeps
+the earlier step; across lane positions via the masked lex-argmin).
 """
 
 from __future__ import annotations
@@ -33,17 +46,31 @@ from .sha256_jnp import digit_positions, lex_argmin
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 _LANES = 128
+#: scal layout: [i0, lo, hi] ++ midstate(8) ++ template(nblocks*16) ++ K(64)
+_TMPL_OFF = 11
 
 
 def _rotr(x, n: int):
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _kernel(scal_ref, out_ref, *, rem: int, k: int, nblocks: int, rows: int):
+def _round(a, b, c, d, e, f, g, h, kw):
+    """One SHA-256 round; ``kw`` is the precombined K[t] + W[t] tile."""
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + s1 + ch + kw
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    return t1 + s0 + maj, a, b, c, d + t1, e, f, g
+
+
+def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *, rem: int, k: int,
+            nblocks: int, rows: int):
     step = pl.program_id(0)
     i0 = scal_ref[0]
     lo = scal_ref[1]
     hi = scal_ref[2]
+    koff = _TMPL_OFF + 16 * nblocks
 
     row = jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 0)
     col = jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANES), 1)
@@ -65,30 +92,42 @@ def _kernel(scal_ref, out_ref, *, rem: int, k: int, nblocks: int, rows: int):
     for blk in range(nblocks):
         w = []
         for word in range(16):
-            base = scal_ref[11 + blk * 16 + word]
+            base = scal_ref[_TMPL_OFF + blk * 16 + word]
             if (blk, word) in contrib:
                 wv = contrib[(blk, word)] | base
             else:
                 wv = jnp.full((rows, _LANES), base, jnp.uint32)
             w.append(wv)
         sa, sb, sc, sd, se, sf, sg, sh = a, b, c, d, e, f, g, h
-        for t in range(64):
-            if t >= 16:
-                wt = w[t % 16]
-                s0 = _rotr(w[(t + 1) % 16], 7) ^ _rotr(w[(t + 1) % 16], 18) \
-                    ^ (w[(t + 1) % 16] >> np.uint32(3))
-                s1 = _rotr(w[(t + 14) % 16], 17) ^ _rotr(w[(t + 14) % 16], 19) \
-                    ^ (w[(t + 14) % 16] >> np.uint32(10))
-                wt = wt + s0 + w[(t + 9) % 16] + s1
-                w[t % 16] = wt
-            else:
-                wt = w[t]
-            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-            ch = (e & f) ^ (~e & g)
-            t1 = h + s1 + ch + np.uint32(SHA256_K[t]) + wt
-            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-            maj = (a & b) ^ (a & c) ^ (b & c)
-            h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
+
+        # All 64 rounds as ONE fori_loop over four 16-round schedule
+        # blocks; block 0 keeps the window untouched via a cheap ``where``
+        # guard (~2 extra VPU ops per round). Keeping every round inside
+        # the loop is deliberate: unrolling even ~12 rounds ahead of the
+        # loop sends XLA:CPU (the interpret test path) into an exponential
+        # optimizer blowup, while Mosaic register-allocates the 24 carried
+        # tiles either way. K rides in SMEM via the scalar-prefetch ref
+        # (dynamic per-round reads).
+        def block16(bi, carry):
+            a, b, c, d, e, f, g, h = carry[:8]
+            w = list(carry[8:])
+            first = bi == 0
+            for j in range(16):
+                s0 = (_rotr(w[(j + 1) % 16], 7) ^ _rotr(w[(j + 1) % 16], 18)
+                      ^ (w[(j + 1) % 16] >> np.uint32(3)))
+                s1 = (_rotr(w[(j + 14) % 16], 17)
+                      ^ _rotr(w[(j + 14) % 16], 19)
+                      ^ (w[(j + 14) % 16] >> np.uint32(10)))
+                w[j] = jnp.where(first, w[j],
+                                 w[j] + s0 + w[(j + 9) % 16] + s1)
+                kj = scal_ref[koff + bi * 16 + j]
+                a, b, c, d, e, f, g, h = _round(
+                    a, b, c, d, e, f, g, h, w[j] + kj)
+            return (a, b, c, d, e, f, g, h, *w)
+
+        carry = jax.lax.fori_loop(0, 4, block16,
+                                  (a, b, c, d, e, f, g, h, *w))
+        a, b, c, d, e, f, g, h = carry[:8]
         a, b, c, d = sa + a, sb + b, sc + c, sd + d
         e, f, g, h = se + e, sf + f, sg + g, sh + h
 
@@ -97,13 +136,24 @@ def _kernel(scal_ref, out_ref, *, rem: int, k: int, nblocks: int, rows: int):
     lo_h = jnp.where(valid, b, _MAX_U32)
     idx = jnp.where(valid, i, _MAX_U32)
 
-    min_hi = jnp.min(hi_h)
-    on_hi = hi_h == min_hi
-    min_lo = jnp.min(jnp.where(on_hi, lo_h, _MAX_U32))
-    min_idx = jnp.min(jnp.where(on_hi & (lo_h == min_lo), idx, _MAX_U32))
-    out_ref[0, 0] = min_hi
-    out_ref[0, 1] = min_lo
-    out_ref[0, 2] = min_idx
+    @pl.when(step == 0)
+    def _init():
+        hi_ref[...] = hi_h
+        lo_ref[...] = lo_h
+        idx_ref[...] = idx
+
+    @pl.when(step != 0)
+    def _merge():
+        p_hi = hi_ref[...]
+        p_lo = lo_ref[...]
+        p_idx = idx_ref[...]
+        # Strict less: at a fixed lane position the nonce index ascends with
+        # the step, so keeping prev on (hi, lo) ties preserves the earliest
+        # nonce (Go first-seen-wins, ref: bitcoin/miner/miner.go:54-58).
+        take = (hi_h < p_hi) | ((hi_h == p_hi) & (lo_h < p_lo))
+        hi_ref[...] = jnp.where(take, hi_h, p_hi)
+        lo_ref[...] = jnp.where(take, lo_h, p_lo)
+        idx_ref[...] = jnp.where(take, idx, p_idx)
 
 
 @functools.partial(
@@ -116,25 +166,38 @@ def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
 
     Same contract as :func:`ops.search.search_span`; ``rows`` is the sublane
     count per grid step (lanes per step = rows * 128).
+
+    ``interpret=True`` selects the Mosaic TPU *simulator*
+    (``pltpu.InterpretParams``), not the generic XLA interpret path: the
+    simulator evaluates the kernel jaxpr op-by-op in seconds, while the
+    generic path hands XLA:CPU the whole grid program whose compile blows
+    up super-linearly on SHA-shaped graphs (round-3 finding; round 2
+    misread the never-finishing forced result as "interpret is slow").
     """
     midstate = jnp.asarray(midstate, dtype=jnp.uint32).reshape(8)
     template = jnp.asarray(template, dtype=jnp.uint32)
     nblocks = template.shape[0]
     scal = jnp.concatenate([
         jnp.asarray([i0, lo_i, hi_i], dtype=jnp.uint32),
-        midstate, template.reshape(-1)])
+        midstate, template.reshape(-1),
+        jnp.asarray(SHA256_K, dtype=jnp.uint32)])
 
+    # Accumulator BlockSpec = the whole (rows, 128) array with a constant
+    # index map: always Mosaic-legal, and the revisited block stays resident
+    # in VMEM across the entire sequential grid.
+    acc_spec = pl.BlockSpec((rows, _LANES), lambda s, scal: (0, 0),
+                            memory_space=pltpu.VMEM)
+    acc_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nsteps,),
         in_specs=[],
-        out_specs=pl.BlockSpec((1, 3), lambda s, scal: (s, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(acc_spec, acc_spec, acc_spec),
     )
-    partials = pl.pallas_call(
+    hi_h, lo_h, idx = pl.pallas_call(
         functools.partial(_kernel, rem=rem, k=k, nblocks=nblocks, rows=rows),
-        out_shape=jax.ShapeDtypeStruct((nsteps, 3), jnp.uint32),
+        out_shape=(acc_shape, acc_shape, acc_shape),
         grid_spec=grid_spec,
-        interpret=interpret,
+        interpret=pltpu.InterpretParams() if interpret else False,
     )(scal)
-    return lex_argmin(partials[:, 0], partials[:, 1], partials[:, 2])
+    return lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())

@@ -80,13 +80,15 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
         # shard_map body interpret mode cannot run eagerly, and XLA:CPU
         # compiling the unrolled 64-round chain blows up (minutes). Off-TPU
         # the body falls back to the bit-identical rolled jnp scan.
-        if (tier == "pallas" and total % 128 == 0
-                and not pallas_interpret_mode()):
+        if tier == "pallas" and not pallas_interpret_mode():
             from ..ops.sha256_pallas import pallas_search_span
             rows = max(1, min(total, _PALLAS_STEP) // 128)
+            per_step = rows * 128
+            # Ceil, not floor: overscan lanes are masked in-kernel
+            # (same round-3 fix as miner_model.search_block).
             hi_h, lo_h, idx = pallas_search_span(
                 midstate, template, i0[0], lo_i, hi_i,
-                rem=rem, k=k, rows=rows, nsteps=total // (rows * 128),
+                rem=rem, k=k, rows=rows, nsteps=-(-total // per_step),
                 interpret=False)
             hi_h, lo_h, idx = (ensure_varying(x, (AXIS,))
                                for x in (hi_h, lo_h, idx))

@@ -17,10 +17,54 @@ Environment variables:
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 from dataclasses import dataclass, field
 
 from ..lsp.params import Params
+
+
+def host_fingerprint() -> str:
+    """12-hex CPU-feature fingerprint of this host.
+
+    Used to key every cross-run build artifact that encodes the build
+    host's ISA (the JAX persistent compile cache, the ``-march=native``
+    C++ library): an artifact written on one machine and loaded on another
+    runs misfeatured code — observed in round 3 as ``cpu_aot_loader.cc``
+    feature-mismatch errors followed by a compute hang (round 2's
+    "test_pallas.py never finishes" root cause: a poisoned ``.jax_cache``
+    carried across driver/judge machines in the working tree).
+    """
+    try:
+        with open("/proc/cpuinfo") as f:
+            sig = next((ln for ln in f if ln.startswith("flags")), "")
+    except OSError:
+        sig = ""
+    sig = sig or platform.processor() or platform.machine()
+    return hashlib.sha256(sig.encode()).hexdigest()[:12]
+
+
+def apply_jax_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative before any device use.
+
+    This image's sitecustomize registers the axon TPU plugin at interpreter
+    start, which overrides the environment variable; only a config-level
+    update actually steers backend selection. Apps call this before their
+    first ``jax.devices()`` so ``JAX_PLATFORMS=cpu`` reliably keeps a
+    process off a (possibly wedged) chip — a bare env var silently did
+    nothing (round-3 finding, same mechanism as the round-1 bench hang).
+    """
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        import jax
+        jax.config.update("jax_platforms", plats)
+
+
+def host_cache_dir(root: str) -> str:
+    """Host-fingerprinted JAX persistent-cache path under ``root`` (see
+    :func:`host_fingerprint` for why the key exists)."""
+    return os.path.join(root, ".jax_cache", host_fingerprint())
 
 
 @dataclass
@@ -36,6 +80,7 @@ class FrameworkConfig:
             return HostSearcher(data)
         if self.compute == "jax":
             from ..models import NonceSearcher
+            apply_jax_platform_env()
             return NonceSearcher(data, batch=self.batch or (1 << 20))
         from ..apps.miner import default_searcher_factory
         return default_searcher_factory(data, self.batch)

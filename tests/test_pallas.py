@@ -1,18 +1,21 @@
 """Pallas kernel tier: bit-exactness vs the host oracle.
 
-Off-TPU the fully-unrolled kernel is validated in *eager interpret* mode
-(``jax.disable_jit()`` + ``interpret=True``): letting XLA:CPU compile the
-jitted unrolled 64-round chain blows up superlinearly, while the eager
-interpreter evaluates the same kernel math in seconds. On a real chip the
-same code paths lower through Mosaic (exercised by bench.py / the driver).
+Off-TPU the kernel is validated in the Mosaic TPU *simulator*
+(``pltpu.InterpretParams``): it evaluates the kernel jaxpr op-by-op in
+~1-2 s per grid step. (The generic ``interpret=True`` XLA path hands
+XLA:CPU the whole grid program, whose compile blows up super-linearly on
+SHA-shaped graphs — the root cause of round 2's "test file never
+finishes".) On a real chip the same kernel lowers through Mosaic
+(exercised by bench.py / the driver).
+
+COST BUDGET (round-3, per VERDICT): every test here is sized in *grid
+steps* and the whole file stays under ~10 steps (~1 min). Add steps only
+with a matching cut elsewhere.
 
 Ref parity: the kernel implements bitcoin/hash.go:13-17's op with
 bitcoin/miner/miner.go:54-58's first-seen-wins tie rule.
 """
 
-import os
-
-import jax
 import numpy as np
 import pytest
 
@@ -26,51 +29,59 @@ from distributed_bitcoinminer_tpu.ops.sha256_pallas import pallas_search_span
 
 def _kernel_span(data: str, i0: int, lo: int, hi: int, k: int,
                  rows: int, nsteps: int, top: str = ""):
+    """Call the kernel the way the searcher does: every VALID nonce in
+    [lo, hi] must have exactly ``k`` decimal digits (the searcher plans one
+    dispatch per digit class — miner_model._digit_classes). Round 2's
+    versions of these tests violated that (e.g. k=3 over [0, 511]) and
+    "passed" only because the mis-formatted sub-width lanes happened not to
+    win the argmin."""
     prefix = data.encode("utf-8") + b" " + top.encode("ascii")
     midstate, tail = sha256_midstate(prefix)
     template = build_tail_template(tail, k, len(prefix) + k)
-    with jax.disable_jit():
-        hi_h, lo_h, idx = pallas_search_span(
-            np.asarray(midstate, np.uint32), template.astype(np.uint32),
-            np.uint32(i0), np.uint32(lo), np.uint32(hi),
-            rem=len(tail), k=k, rows=rows, nsteps=nsteps, interpret=True)
+    hi_h, lo_h, idx = pallas_search_span(
+        np.asarray(midstate, np.uint32), template.astype(np.uint32),
+        np.uint32(i0), np.uint32(lo), np.uint32(hi),
+        rem=len(tail), k=k, rows=rows, nsteps=nsteps, interpret=True)
     return (int(hi_h) << 32) | int(lo_h), int(idx)
 
 
 def test_kernel_exact_vs_oracle_single_step():
-    got = _kernel_span("cmu440", i0=0, lo=100, hi=355, k=3, rows=2, nsteps=1)
-    assert got == scan_min("cmu440", 100, 355)
+    # 256 lanes, window [100, 255]: lanes 0-99 masked low (1 step).
+    got = _kernel_span("cmu440", i0=0, lo=100, hi=255, k=3, rows=2, nsteps=1)
+    assert got == scan_min("cmu440", 100, 255)
 
 
 def test_kernel_exact_vs_oracle_multi_step():
-    # nsteps > 1 exercises the per-step partial rows + cross-step argmin.
-    got = _kernel_span("pallas", i0=0, lo=0, hi=511, k=3, rows=1, nsteps=4)
-    assert got == scan_min("pallas", 0, 511)
+    # nsteps > 1 exercises the cross-step accumulator merge (2 steps).
+    got = _kernel_span("pallas", i0=0, lo=100, hi=255, k=3, rows=1, nsteps=2)
+    assert got == scan_min("pallas", 100, 255)
 
 
 def test_kernel_masks_invalid_lanes():
-    # Window strictly inside the lane span: lanes outside [lo, hi] must not
-    # contribute even when their hashes would win.
-    got = _kernel_span("mask", i0=0, lo=130, hi=200, k=3, rows=1, nsteps=2)
+    # Lanes run [128, 255]; window [130, 200] masks both ends (1 step).
+    got = _kernel_span("mask", i0=128, lo=130, hi=200, k=3, rows=1, nsteps=1)
     assert got == scan_min("mask", 130, 200)
 
 
 def test_kernel_two_block_tail():
-    # Long message => 2-block tail template (the nblocks=2 kernel variant).
+    # Long message => 2-block tail template (the nblocks=2 kernel variant;
+    # 1 step at double compression cost). Lanes [100, 227], all valid.
     data = "x" * 60
-    got = _kernel_span(data, i0=0, lo=0, hi=255, k=3, rows=1, nsteps=2)
-    assert got == scan_min(data, 0, 255)
+    got = _kernel_span(data, i0=100, lo=100, hi=227, k=3, rows=1, nsteps=1)
+    assert got == scan_min(data, 100, 227)
 
 
 def test_searcher_pallas_tier_exact():
+    # One d=3 block, 512 lanes => a single grid step through the searcher.
     s = NonceSearcher("cmu440", batch=128, tier="pallas")
     assert s.search(100, 399) == scan_min("cmu440", 100, 399)
 
 
 def test_searcher_pallas_tier_matches_jnp_tier():
+    # Range confined to the d=3 digit class => one pallas step + jnp ref.
     sp = NonceSearcher("tier", batch=128, tier="pallas")
     sj = NonceSearcher("tier", batch=128, tier="jnp")
-    assert sp.search(0, 299) == sj.search(0, 299)
+    assert sp.search(100, 299) == sj.search(100, 299)
 
 
 def test_default_tier_env(monkeypatch):
