@@ -146,11 +146,29 @@ class MinerWorker:
 
 async def _run_miner(hostport: str) -> int:
     from ..utils import from_env
+    from ..utils.config import apply_jax_platform_env
     cfg = from_env()
+
+    # Pod mode (north star: a whole multi-host pod joins as ONE miner).
+    # DBM_COORDINATOR et al. select it; unset means plain single-host.
+    from ..parallel.multihost import (PodSearcher, broadcast_stop,
+                                      initialize_multihost, is_lsp_owner,
+                                      run_follower)
+    apply_jax_platform_env()
+    multihost = initialize_multihost()
+    if multihost and not is_lsp_owner():
+        # Follower hosts never touch LSP: they execute broadcast jobs in
+        # lockstep with the owner until it releases them.
+        jobs = await asyncio.to_thread(run_follower, cfg.batch)
+        logger.info("follower done after %d jobs", jobs)
+        return 0
+
+    if multihost:
+        factory = lambda data, batch: PodSearcher(data, batch)  # noqa: E731
+    else:
+        factory = lambda data, batch: cfg.make_searcher(data)   # noqa: E731
     worker = MinerWorker(hostport, params=cfg.params,
-                         searcher_factory=lambda data, batch: (
-                             cfg.make_searcher(data)),
-                         batch=cfg.batch)
+                         searcher_factory=factory, batch=cfg.batch)
     try:
         await worker.join()
     except LspError as exc:
@@ -159,7 +177,13 @@ async def _run_miner(hostport: str) -> int:
     try:
         await worker.run()
     finally:
-        await worker.close()
+        # Release the followers even if the LSP teardown raises: a stuck
+        # broadcast partner is worse than an unflushed socket (review r3).
+        try:
+            await worker.close()
+        finally:
+            if multihost:
+                broadcast_stop()
     return 0
 
 

@@ -30,11 +30,6 @@ from ..ops.sha256_jnp import build_tail_template
 
 _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
 
-#: Lanes per Pallas grid step: 32 sublanes x 128 lanes keeps the ~26 live
-#: (rows, 128) uint32 tiles of the unrolled compression well under VMEM.
-_PALLAS_ROWS = 32
-_PALLAS_STEP = _PALLAS_ROWS * 128
-
 
 def default_tier() -> str:
     """Compute-tier choice: ``DBM_COMPUTE`` env (jnp | pallas), default jnp."""
@@ -140,14 +135,8 @@ class NonceSearcher:
         i0, nbatches = self._block_geometry(plan)
         total = self.batch * nbatches
         if self.tier == "pallas":
-            from ..ops.sha256_pallas import pallas_search_span
-            rows = max(1, min(total, _PALLAS_STEP) // 128)
-            per_step = rows * 128
-            # Round the step count UP: overscanned lanes past hi_i are
-            # masked to the sentinel inside the kernel, while flooring
-            # silently dropped the top of non-step-aligned blocks
-            # (round-3 review finding).
-            nsteps = -(-total // per_step)
+            from ..ops.sha256_pallas import pallas_geometry, pallas_search_span
+            rows, nsteps = pallas_geometry(total)
             # Off-TPU the kernel runs in the Mosaic TPU simulator
             # (pltpu.InterpretParams — seconds per grid step, bit-exact);
             # on the chip it lowers through Mosaic.
@@ -182,6 +171,17 @@ class NonceSearcher:
                 best_hash, best_nonce, seen = h, base + idx, True
         return best_hash, best_nonce
 
+    def _until_block(self, plan: _BlockPlan, t_hi: int, t_lo: int):
+        """Difficulty-target dispatch for one block; overridden by the
+        mesh-sharded model. Returns the 7-tuple of
+        :func:`ops.search.search_span_until`."""
+        i0, nbatches = self._block_geometry(plan)
+        return search_span_until(
+            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+            np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+            np.uint32(t_hi), np.uint32(t_lo),
+            rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
+
     def search_until(self, lower: int, upper: int,
                      target: int) -> tuple[int, int, bool]:
         """Difficulty-target mode: (hash, nonce, found).
@@ -196,12 +196,8 @@ class NonceSearcher:
         t_hi, t_lo = target >> 32, target & 0xFFFFFFFF
         best_hash, best_nonce, seen = MAX_U64, lower, False
         for plan in self.plan(lower, upper):
-            i0, nbatches = self._block_geometry(plan)
-            found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = search_span_until(
-                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-                np.uint32(t_hi), np.uint32(t_lo),
-                rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
+            found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = \
+                self._until_block(plan, t_hi, t_lo)
             if int(found):
                 return ((int(f_hi) << 32) | int(f_lo),
                         plan.base + int(f_idx), True)

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..parallel.mesh_search import device_spans, make_mesh, sharded_search_span
+from ..parallel.mesh_search import (device_spans, make_mesh,
+                                    sharded_search_span,
+                                    sharded_search_span_until)
 from .miner_model import NonceSearcher
 
 
@@ -37,3 +39,17 @@ class ShardedNonceSearcher(NonceSearcher):
             i0_d, plan.lo_i, plan.hi_i,
             mesh=self.mesh, rem=plan.rem, k=plan.k,
             batch=self.batch, nbatches=nbatches, tier=self.tier)
+
+    def _until_block(self, plan, t_hi, t_lo):
+        """Sharded difficulty-target dispatch (VERDICT r2 task 6): each
+        device early-exits on its own contiguous span; the collective merge
+        preserves the global first-qualifying-nonce rule (see
+        ``parallel.mesh_search.sharded_search_span_until``)."""
+        i0, nbatches = self._block_geometry(
+            plan, per_step=self.batch * self.n_devices)
+        i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
+        return sharded_search_span_until(
+            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+            i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo,
+            mesh=self.mesh, rem=plan.rem, k=plan.k,
+            batch=self.batch, nbatches=nbatches)

@@ -91,13 +91,11 @@ def search_span(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
                           rem=rem, k=k, batch=batch, nbatches=nbatches)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("rem", "k", "batch", "nbatches"))
-def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
-                      target_lo, *, rem: int, k: int, batch: int,
-                      nbatches: int):
-    """Difficulty-target scan: stop at the first batch holding a hash below
-    the 64-bit target (as a (hi, lo) uint32 pair).
+def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
+                    target_lo, *, rem: int, k: int, batch: int,
+                    nbatches: int, vary_axes=()):
+    """Unjitted difficulty-target span scan: stop at the first batch holding
+    a hash below the 64-bit target (as a (hi, lo) uint32 pair).
 
     A ``while_loop`` walks the span in ascending lane batches and exits as
     soon as a batch contains a qualifying hash — the in-kernel early-exit of
@@ -106,9 +104,12 @@ def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
     (lowest-nonce) qualifying hash when ``found`` is 1, plus the running
     argmin over all scanned lanes either way (the fallback result when the
     whole span misses the target).
+
+    Shared by the jitted single-device entry point and the shard_map
+    per-device body (``parallel/mesh_search.py``), which passes its mesh
+    axis as ``vary_axes``; the loop predicate is then device-varying, so
+    each device early-exits independently (no collectives in the loop).
     """
-    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
-    template = jnp.asarray(template, dtype=jnp.uint32)
     lane = jnp.arange(batch, dtype=jnp.uint32)
 
     def cond(carry):
@@ -118,7 +119,8 @@ def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
     def body(carry):
         j, f_idx, f_hi, f_lo, best = carry
         i = i0 + j.astype(jnp.uint32) * np.uint32(batch) + lane
-        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k)
+        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
+                                 vary_axes=vary_axes)
         valid = (i >= lo_i) & (i <= hi_i)
         hi_h = jnp.where(valid, hi_h, _MAX_U32)
         lo_h = jnp.where(valid, lo_h, _MAX_U32)
@@ -139,8 +141,25 @@ def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
         q_lo = jnp.min(jnp.where(hit, lo_h, _MAX_U32))
         return (j + 1, q_idx, q_hi, q_lo, best)
 
-    init = (jnp.int32(0), _MAX_U32, _MAX_U32, _MAX_U32,
-            (_MAX_U32, _MAX_U32, _MAX_U32))
+    init = (jnp.int32(0), jnp.uint32(_MAX_U32), jnp.uint32(_MAX_U32),
+            jnp.uint32(_MAX_U32),
+            (jnp.uint32(_MAX_U32),) * 3)
+    if vary_axes:
+        init = jax.tree.map(lambda x: ensure_varying(x, vary_axes), init)
     j, f_idx, f_hi, f_lo, best = jax.lax.while_loop(cond, body, init)
     found = (f_idx != _MAX_U32).astype(jnp.uint32)
     return found, f_hi, f_lo, f_idx, best[0], best[1], best[2]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rem", "k", "batch", "nbatches"))
+def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
+                      target_lo, *, rem: int, k: int, batch: int,
+                      nbatches: int):
+    """Jitted single-device difficulty-target scan
+    (see :func:`span_until_body`)."""
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+    return span_until_body(midstate, template, i0, lo_i, hi_i,
+                           target_hi, target_lo,
+                           rem=rem, k=k, batch=batch, nbatches=nbatches)

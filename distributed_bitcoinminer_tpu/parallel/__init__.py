@@ -7,8 +7,14 @@ protocol) and across TPU cores inside one miner via ``shard_map`` over a 1-D
 ``jax.sharding.Mesh`` with a staged-pmin lexicographic-min merge on ICI.
 """
 
-from .mesh_search import AXIS, device_spans, make_mesh, sharded_search_span
-from .multihost import global_mesh, initialize_multihost, is_lsp_owner
+from .mesh_search import (AXIS, device_spans, make_mesh, sharded_search_span,
+                          sharded_search_span_until)
+from .multihost import (PodSearcher, broadcast_job, broadcast_stop,
+                        global_mesh, initialize_multihost, is_lsp_owner,
+                        run_follower)
 
 __all__ = ["AXIS", "device_spans", "make_mesh", "sharded_search_span",
-           "global_mesh", "initialize_multihost", "is_lsp_owner"]
+           "sharded_search_span_until",
+           "PodSearcher", "broadcast_job", "broadcast_stop",
+           "global_mesh", "initialize_multihost", "is_lsp_owner",
+           "run_follower"]

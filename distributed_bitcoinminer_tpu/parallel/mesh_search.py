@@ -30,8 +30,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.search import span_scan_body
-from ..ops.sha256_jnp import ensure_varying
+from ..ops.search import span_scan_body, span_until_body
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 
@@ -75,23 +74,19 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
         out_specs=(P(), P(), P()))
     def body(midstate, template, i0, lo_i, hi_i):
         total = batch * nbatches
-        from ..models.miner_model import _PALLAS_STEP, pallas_interpret_mode
-        # The pallas tier is honored only on real TPU: inside this jitted
-        # shard_map body interpret mode cannot run eagerly, and XLA:CPU
-        # compiling the unrolled 64-round chain blows up (minutes). Off-TPU
-        # the body falls back to the bit-identical rolled jnp scan.
-        if tier == "pallas" and not pallas_interpret_mode():
-            from ..ops.sha256_pallas import pallas_search_span
-            rows = max(1, min(total, _PALLAS_STEP) // 128)
-            per_step = rows * 128
-            # Ceil, not floor: overscan lanes are masked in-kernel
-            # (same round-3 fix as miner_model.search_block).
+        from ..models.miner_model import pallas_interpret_mode
+        # The pallas tier runs everywhere since round 3: through Mosaic on
+        # the chip, through the Mosaic TPU simulator (InterpretParams) on
+        # the CPU test mesh. The out ShapeDtypeStructs carry vma=(AXIS,) so
+        # shard_map's varying-axis checker accepts the varying span starts.
+        if tier == "pallas":
+            from ..ops.sha256_pallas import (pallas_geometry,
+                                             pallas_search_span)
+            rows, nsteps = pallas_geometry(total)
             hi_h, lo_h, idx = pallas_search_span(
                 midstate, template, i0[0], lo_i, hi_i,
-                rem=rem, k=k, rows=rows, nsteps=-(-total // per_step),
-                interpret=False)
-            hi_h, lo_h, idx = (ensure_varying(x, (AXIS,))
-                               for x in (hi_h, lo_h, idx))
+                rem=rem, k=k, rows=rows, nsteps=nsteps,
+                interpret=pallas_interpret_mode(), vma=(AXIS,))
         else:
             hi_h, lo_h, idx = span_scan_body(
                 midstate, template, i0[0], lo_i, hi_i,
@@ -109,6 +104,65 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
 
     return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
                 jnp.uint32(lo_i), jnp.uint32(hi_i))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "rem", "k", "batch", "nbatches"))
+def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
+                              target_hi, target_lo, *, mesh: Mesh, rem: int,
+                              k: int, batch: int, nbatches: int):
+    """Difficulty-target scan over ``n`` disjoint per-device spans.
+
+    Each device runs the early-exiting :func:`span_until_body` on its own
+    contiguous span (the ``while_loop`` predicate is device-varying, so a
+    device stops at ITS first qualifying batch independently — no
+    collectives ride inside the loop). The merge preserves the
+    first-qualifying-nonce rule globally: spans are contiguous and
+    disjoint and each device's hit is the minimal qualifying nonce of its
+    span, so the global first hit is the ``pmin`` of the per-device hit
+    indices; the fallback argmin merges exactly like
+    :func:`sharded_search_span`.
+
+    Returns replicated uint32 scalars
+    ``(found, f_hi, f_lo, f_idx, best_hi, best_lo, best_idx)`` with the
+    same contract as :func:`ops.search.search_span_until`.
+    """
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(AXIS), P(), P(), P(), P()),
+        out_specs=(P(),) * 7)
+    def body(midstate, template, i0, lo_i, hi_i, t_hi, t_lo):
+        found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = span_until_body(
+            midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
+            rem=rem, k=k, batch=batch, nbatches=nbatches,
+            vary_axes=(AXIS,))
+        # First qualifying nonce globally = min of per-device first hits
+        # (disjoint ascending spans; non-hit devices carry the MAX
+        # sentinel). Its (hi, lo) pair is selected with the same staged
+        # pmin trick as the argmin merge.
+        g_idx = jax.lax.pmin(f_idx, AXIS)
+        g_hi = jax.lax.pmin(jnp.where(f_idx == g_idx, f_hi, _MAX_U32), AXIS)
+        g_lo = jax.lax.pmin(
+            jnp.where((f_idx == g_idx) & (f_hi == g_hi), f_lo, _MAX_U32),
+            AXIS)
+        g_found = jax.lax.pmax(found, AXIS)
+        # Fallback exact argmin across devices (used only when no device
+        # hit, in which case every device scanned its full span).
+        min_hi = jax.lax.pmin(b_hi, AXIS)
+        lo_m = jnp.where(b_hi == min_hi, b_lo, _MAX_U32)
+        min_lo = jax.lax.pmin(lo_m, AXIS)
+        idx_m = jnp.where((b_hi == min_hi) & (b_lo == min_lo), b_idx,
+                          _MAX_U32)
+        min_idx = jax.lax.pmin(idx_m, AXIS)
+        return g_found, g_hi, g_lo, g_idx, min_hi, min_lo, min_idx
+
+    return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
+                jnp.uint32(lo_i), jnp.uint32(hi_i),
+                jnp.uint32(target_hi), jnp.uint32(target_lo))
 
 
 def device_spans(i0: int, n_devices: int, batch: int, nbatches: int) -> np.ndarray:

@@ -3,25 +3,44 @@
 Deployment shape per the north star: a whole multi-host TPU pod joins the
 scheduler as ONE miner. Every host runs the same SPMD program (standard JAX
 multi-controller); host 0 additionally owns the LSP client socket. Chunk
-bounds arriving over LSP are host-side Python scalars, broadcast to all
-hosts out-of-band (the per-host sub-span derives deterministically from
-process_index), so the device program never sees DCN — intra-search
-communication is exactly the staged-pmin merge over ICI from
-``mesh_search``, now spanning the global mesh.
+bounds arriving over LSP are host-side Python scalars; host 0 broadcasts
+them to the other hosts (one tiny ``broadcast_one_to_all`` per Request),
+after which every host enters the same jitted ``shard_map`` search over the
+GLOBAL mesh — intra-search communication is exactly the staged-pmin merge
+over ICI from ``mesh_search``, now spanning all hosts.
 
 The reference's analog is its LSP/UDP stack (SURVEY §2, communication
 backend): host<->host traffic stays on the unchanged wire protocol; the
 NCCL/MPI role is played entirely by XLA collectives.
+
+Wire-in points (VERDICT r2 task 7):
+
+- ``apps.miner._run_miner`` calls :func:`initialize_multihost` at startup;
+  non-owner hosts enter :func:`run_follower` and never touch LSP.
+- The owner's searcher factory builds :class:`PodSearcher`, which
+  broadcasts the job then runs the shared sharded search.
+- ``tests/test_multihost.py`` drives the whole shape as 2 local CPU
+  processes against a live scheduler.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+from collections import OrderedDict
 from typing import Optional
 
 import jax
+import numpy as np
 
 from .mesh_search import make_mesh
+
+logger = logging.getLogger("dbm.multihost")
+
+#: broadcast frame layout (uint32): [opcode, data_len, lo_hi, lo_lo,
+#: up_hi, up_lo, data_bytes...]; opcode 0 = stop, 1 = search.
+_MAX_DATA = 992
+_FRAME = 6 + _MAX_DATA
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
@@ -42,6 +61,9 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     process_id = process_id if process_id is not None else int(
         os.environ.get("DBM_PROC_ID", "0"))
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    logger.info("multihost: process %d/%d, %d global devices",
+                jax.process_index(), jax.process_count(),
+                len(jax.devices()))
     return True
 
 
@@ -53,3 +75,98 @@ def global_mesh():
 def is_lsp_owner() -> bool:
     """True on the one host that speaks LSP for the whole pod (host 0)."""
     return jax.process_index() == 0
+
+
+def _broadcast_frame(frame: Optional[np.ndarray]) -> np.ndarray:
+    """One pod-wide control broadcast; host 0 supplies the frame."""
+    from jax.experimental import multihost_utils
+    if frame is None:
+        frame = np.zeros(_FRAME, dtype=np.uint32)
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(frame), dtype=np.uint32)
+
+
+def broadcast_job(data: str, lower: int, upper: int) -> None:
+    """Host 0: announce one search job to every follower host."""
+    raw = data.encode("utf-8")
+    if len(raw) > _MAX_DATA:
+        raise ValueError(f"message too long for pod broadcast: {len(raw)}")
+    frame = np.zeros(_FRAME, dtype=np.uint32)
+    frame[0] = 1
+    frame[1] = len(raw)
+    frame[2], frame[3] = lower >> 32, lower & 0xFFFFFFFF
+    frame[4], frame[5] = upper >> 32, upper & 0xFFFFFFFF
+    frame[6:6 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    _broadcast_frame(frame)
+
+
+def broadcast_stop() -> None:
+    """Host 0: release every follower host (pod shutdown)."""
+    _broadcast_frame(np.zeros(_FRAME, dtype=np.uint32))
+
+
+def _receive_job():
+    """Follower: block for the next control frame; None means stop."""
+    frame = _broadcast_frame(None)
+    if int(frame[0]) == 0:
+        return None
+    n = int(frame[1])
+    data = bytes(frame[6:6 + n].astype(np.uint8)).decode("utf-8")
+    lower = (int(frame[2]) << 32) | int(frame[3])
+    upper = (int(frame[4]) << 32) | int(frame[5])
+    return data, lower, upper
+
+
+class PodSearcher:
+    """Owner-side searcher: broadcast the job, then run the global-mesh
+    sharded search that every host executes in lockstep."""
+
+    def __init__(self, data: str, batch: Optional[int] = None):
+        from ..models import ShardedNonceSearcher
+        self.data = data
+        self.inner = ShardedNonceSearcher(
+            data, batch=batch or (1 << 20), mesh=global_mesh())
+
+    def search(self, lower: int, upper: int):
+        broadcast_job(self.data, lower, upper)
+        return self.inner.search(lower, upper)
+
+
+def run_follower(batch: Optional[int] = None,
+                 cache_size: int = 4) -> int:
+    """Follower-host main loop: execute broadcast jobs until stop.
+
+    Mirrors the owner's per-message searcher cache so both sides reuse the
+    same compiled signatures; returns the number of jobs executed.
+    """
+    from ..models import ShardedNonceSearcher
+    searchers: OrderedDict[str, ShardedNonceSearcher] = OrderedDict()
+    mesh = global_mesh()
+    jobs = 0
+    while True:
+        job = _receive_job()
+        if job is None:
+            return jobs
+        data, lower, upper = job
+        s = searchers.get(data)
+        if s is None:
+            s = ShardedNonceSearcher(data, batch=batch or (1 << 20),
+                                     mesh=mesh)
+            searchers[data] = s
+            while len(searchers) > cache_size:
+                searchers.popitem(last=False)
+        else:
+            searchers.move_to_end(data)
+        try:
+            s.search(lower, upper)   # result replicated; owner reports it
+        except Exception:
+            # Failure symmetry (round-3 review): a deterministic compute
+            # error raises on EVERY host (same program); the owner's
+            # MinerWorker catches it and answers the sentinel, so the
+            # follower must survive and rejoin the next broadcast rather
+            # than die and deadlock the owner. (A host-asymmetric failure
+            # mid-collective is not recoverable at this layer — that is
+            # the distributed runtime's fault domain.)
+            logger.exception("follower search failed for %r [%d, %d]",
+                             data, lower, upper)
+        jobs += 1

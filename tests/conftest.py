@@ -55,6 +55,16 @@ def pytest_sessionfinish(session, exitstatus):
 
 @atexit.register
 def _fast_exit():
+    # os._exit skips later-registered atexit handlers; the only one that
+    # matters for tooling is coverage's data save — do it explicitly.
+    try:
+        import coverage
+        cov = coverage.Coverage.current()
+        if cov is not None:
+            cov.stop()
+            cov.save()
+    except Exception:
+        pass
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(_exit_status[0])
@@ -68,3 +78,43 @@ def reset_network_faults():
     yield
     lspnet.reset_all_faults()
     lspnet.stop_sniff()
+
+
+@pytest.fixture(autouse=True)
+def no_task_leaks(monkeypatch):
+    """The ``-race`` analog (VERDICT r1 task 6 / r2 task 8): no asyncio task
+    may outlive its test scenario, mirroring the spec rule that no goroutine
+    may outlive Close (p1.pdf §2.2.3-2.2.4; the reference grades 40/44 tests
+    under the Go race detector).
+
+    ``asyncio.run`` is wrapped so that after the scenario coroutine returns,
+    still-pending tasks get a short settle window (in-flight cancellations
+    complete in a tick) and anything still alive is reported as a leak.
+    Endpoint engines must therefore be torn down by Close, not by the
+    loop-shutdown cancellation that ``asyncio.run`` would otherwise hide.
+    """
+    import asyncio
+
+    leaks: list[str] = []
+    orig_run = asyncio.run
+
+    def checked_run(coro, **kw):
+        async def wrapper():
+            try:
+                return await coro
+            finally:
+                cur = asyncio.current_task()
+                for _ in range(40):
+                    pending = [t for t in asyncio.all_tasks()
+                               if t is not cur and not t.done()]
+                    if not pending:
+                        break
+                    await asyncio.sleep(0.01)
+                else:
+                    leaks.extend(repr(t) for t in pending)
+
+        return orig_run(wrapper(), **kw)
+
+    monkeypatch.setattr(asyncio, "run", checked_run)
+    yield
+    assert not leaks, f"asyncio tasks outlived the scenario: {leaks}"

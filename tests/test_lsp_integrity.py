@@ -62,6 +62,9 @@ class TestVariableLength:
             except asyncio.TimeoutError:
                 pass
             lspnet.set_msg_shortening_percent(0)
+            # Close the CLIENT too: its engine tasks must not outlive the
+            # scenario (the no_task_leaks fixture caught exactly this).
+            await client.close()
             await server.close()
         asyncio.run(scenario())
 

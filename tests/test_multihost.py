@@ -1,0 +1,102 @@
+"""Pod-as-one-miner (multi-host) end-to-end: 2 local CPU processes.
+
+VERDICT r2 task 7: the north-star deployment shape is a whole multi-host
+pod joining the scheduler as ONE miner — host 0 owns the LSP client, every
+host executes the same sharded search over the GLOBAL mesh, chunk bounds
+ride one tiny pod broadcast per Request (parallel/multihost.py).
+
+Here the "pod" is 2 local processes x 2 virtual CPU devices each (4 global
+devices) glued by ``jax.distributed`` over localhost; the scheduler +
+server run as a third OS process and a stock CLI client submits the job.
+Exactly one miner must Join (host 0), and the Result must be bit-identical
+to the oracle.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_udp_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _free_tcp_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(args, extra_env, log_path=None):
+    """Long-lived children write to a file, not a PIPE nobody drains (a
+    full 64K pipe buffer would block the child mid-write)."""
+    env = dict(os.environ, PYTHONPATH=_REPO)
+    env.update(extra_env)
+    if log_path is not None:
+        log = open(log_path, "w")
+        return subprocess.Popen(
+            [sys.executable, "-m", *args], cwd=_REPO, env=env,
+            stdout=log, stderr=subprocess.STDOUT, text=True)
+    return subprocess.Popen(
+        [sys.executable, "-m", *args], cwd=_REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_pod_joins_as_one_miner_and_matches_oracle(tmp_path):
+    lsp_port = _free_udp_port()
+    coord_port = _free_tcp_port()
+    pkg = "distributed_bitcoinminer_tpu.apps"
+    pod_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "DBM_COORDINATOR": f"127.0.0.1:{coord_port}",
+        "DBM_NUM_PROCS": "2",
+        "DBM_BATCH": "64",
+        # Fast transport so the pod's compile pauses can't trip epochs.
+        "DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
+        "DBM_WINDOW": "5",
+    }
+    server = _spawn([f"{pkg}.server", str(lsp_port)],
+                    {"DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
+                     "DBM_WINDOW": "5", "JAX_PLATFORMS": "cpu"},
+                    log_path=tmp_path / "server.log")
+    owner = follower = client = None
+    try:
+        time.sleep(1.0)
+        owner = _spawn([f"{pkg}.miner", f"127.0.0.1:{lsp_port}"],
+                       {**pod_env, "DBM_PROC_ID": "0"},
+                       log_path=tmp_path / "owner.log")
+        follower = _spawn([f"{pkg}.miner", f"127.0.0.1:{lsp_port}"],
+                          {**pod_env, "DBM_PROC_ID": "1"},
+                          log_path=tmp_path / "follower.log")
+        time.sleep(2.0)  # distributed init + LSP join
+        client = _spawn(
+            [f"{pkg}.client", f"127.0.0.1:{lsp_port}", "podjob", "20000"],
+            {"DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
+             "DBM_WINDOW": "5", "JAX_PLATFORMS": "cpu"})
+        out, err = client.communicate(timeout=180)
+        want_hash, want_nonce = scan_min("podjob", 0, 20001)  # +1 ref quirk
+        assert out.strip() == f"Result {want_hash} {want_nonce}", (out, err)
+
+        # The pod joined as ONE miner: kill the server; the owner's LSP
+        # connection dies, it broadcasts stop, and BOTH pod processes exit
+        # cleanly on their own.
+        server.kill()
+        server.wait()
+        assert owner.wait(timeout=60) == 0, \
+            (tmp_path / "owner.log").read_text()[-800:]
+        assert follower.wait(timeout=60) == 0, \
+            (tmp_path / "follower.log").read_text()[-800:]
+    finally:
+        for proc in (client, follower, owner, server):
+            if proc is not None:
+                proc.kill()
+                proc.wait()

@@ -58,6 +58,29 @@ def test_sharded_searcher_matches_cpu_oracle(mesh):
     assert sharded.search(50, 2049) == scan_min(data, 50, 2049)
 
 
+def test_sharded_pallas_tier_matches_jnp_tier(mesh):
+    """VERDICT r2 task 4: the sharded pallas path must actually execute.
+    On the CPU mesh the kernel rides the Mosaic TPU simulator inside the
+    shard_map body (vma-typed outputs); the collective merge semantics are
+    pinned by equality with the jnp tier and the oracle. One small block
+    keeps the simulator cost down (~1 grid step per device)."""
+    data = "cmu440"
+    prefix = data.encode() + b" "
+    midstate, tail = sha256_midstate(prefix)
+    k = 4
+    template = build_tail_template(tail, k, len(prefix) + k)
+    batch, nbatches = 128, 1
+    i0_d = device_spans(1000, 8, batch, nbatches)
+    args = (np.asarray(midstate, np.uint32), template, i0_d,
+            np.uint32(1100), np.uint32(1987))
+    kw = dict(mesh=mesh, rem=len(tail), k=k, batch=batch, nbatches=nbatches)
+    got_p = [int(x) for x in sharded_search_span(*args, tier="pallas", **kw)]
+    got_j = [int(x) for x in sharded_search_span(*args, tier="jnp", **kw)]
+    assert got_p == got_j
+    want_hash, want_nonce = scan_min(data, 1100, 1987)
+    assert ((got_p[0] << 32) | got_p[1], got_p[2]) == (want_hash, want_nonce)
+
+
 def test_unaligned_window_top_lanes_covered(mesh):
     """Regression: nbatches sized from lo_i (not the aligned scan start i0)
     left up to batch-1 top lanes unscanned when the window filled a whole
