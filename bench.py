@@ -22,6 +22,8 @@ Hardening (round-2, per VERDICT):
   persistent compilation cache is configured so re-runs skip even that.
 - Tier selection via ``DBM_COMPUTE`` (auto | jnp | pallas); auto measures
   both device tiers and reports the faster.
+- ``DBM_TRACE=<dir>`` captures a JAX profiler trace of one timed search
+  per tier into ``<dir>/<tier>`` for TensorBoard/XProf (the A2 hook).
 """
 
 from __future__ import annotations
@@ -124,7 +126,8 @@ def main() -> int:
     from distributed_bitcoinminer_tpu.models import (
         NonceSearcher, ShardedNonceSearcher)
     from distributed_bitcoinminer_tpu.parallel import make_mesh
-    from distributed_bitcoinminer_tpu.utils.profiling import Timer
+    from distributed_bitcoinminer_tpu.utils.profiling import (Timer,
+                                                              device_trace)
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
@@ -157,6 +160,10 @@ def main() -> int:
             t0 = time.time()
             searcher.search(lower, upper)  # compile + warm the one signature
             warm_s = time.time() - t0
+            trace_dir = os.environ.get("DBM_TRACE")
+            if trace_dir:
+                with device_trace(os.path.join(trace_dir, tier)):
+                    searcher.search(lower, upper)
             rate, secs, reps = _measure(searcher, lower, upper, min_time_s,
                                         Timer)
             results[tier] = {"rate": rate, "secs": secs, "reps": reps,

@@ -170,21 +170,22 @@ async def _run_miner(hostport: str) -> int:
     worker = MinerWorker(hostport, params=cfg.params,
                          searcher_factory=factory, batch=cfg.batch)
     try:
-        await worker.join()
-    except LspError as exc:
-        print("Failed to join with server:", exc)
-        return 1
-    try:
+        try:
+            await worker.join()
+        except LspError as exc:
+            print("Failed to join with server:", exc)
+            return 1
         await worker.run()
+        return 0
     finally:
-        # Release the followers even if the LSP teardown raises: a stuck
-        # broadcast partner is worse than an unflushed socket (review r3).
+        # Release the followers on EVERY exit path — including a failed
+        # join — and even if the LSP teardown raises: a stuck broadcast
+        # partner is worse than an unflushed socket (review r3).
         try:
             await worker.close()
         finally:
             if multihost:
                 broadcast_stop()
-    return 0
 
 
 def main(argv=None) -> int:

@@ -133,13 +133,17 @@ class PodSearcher:
 
 
 def run_follower(batch: Optional[int] = None,
-                 cache_size: int = 4) -> int:
+                 cache_size: Optional[int] = None) -> int:
     """Follower-host main loop: execute broadcast jobs until stop.
 
-    Mirrors the owner's per-message searcher cache so both sides reuse the
-    same compiled signatures; returns the number of jobs executed.
+    Mirrors the owner's per-message searcher cache (same bound, shared
+    constant) so both sides keep the same compiled signatures warm;
+    returns the number of jobs executed.
     """
+    from ..apps.miner import MinerWorker
     from ..models import ShardedNonceSearcher
+    if cache_size is None:
+        cache_size = MinerWorker.SEARCHER_CACHE_SIZE
     searchers: OrderedDict[str, ShardedNonceSearcher] = OrderedDict()
     mesh = global_mesh()
     jobs = 0

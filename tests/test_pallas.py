@@ -84,6 +84,25 @@ def test_searcher_pallas_tier_matches_jnp_tier():
     assert sp.search(100, 299) == sj.search(100, 299)
 
 
+def test_kernel_lowers_for_tpu_platform():
+    """Pin TPU lowerability from the CPU suite: jax.export with
+    platforms=['tpu'] runs the pallas->Mosaic lowering pass (where round
+    2's illegal (1,3) output BlockSpec failed) without needing a chip, at
+    the exact bench geometry. A regression here is the difference between
+    a real BENCH pallas number and a silent jnp fallback."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    f = functools.partial(pallas_search_span, rem=8, k=9, rows=8,
+                          nsteps=16384)
+    args = (jnp.zeros(8, jnp.uint32), jnp.zeros((1, 16), jnp.uint32),
+            jnp.uint32(0), jnp.uint32(0), jnp.uint32(0))
+    exported = jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+    assert len(exported.mlir_module()) > 0
+
+
 def test_default_tier_env(monkeypatch):
     monkeypatch.delenv("DBM_COMPUTE", raising=False)
     assert default_tier() == "jnp"

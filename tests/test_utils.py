@@ -47,3 +47,18 @@ def test_configure_logging_and_timer(tmp_path):
         sum(range(1000))
     assert t.seconds >= 0
     assert Timer().rate(100) == 0.0
+
+
+def test_device_trace_writes_profile(tmp_path):
+    """The A2 profiler hook (bench's DBM_TRACE path) captures real trace
+    artifacts; the None path is a no-op."""
+    import jax.numpy as jnp
+
+    from distributed_bitcoinminer_tpu.utils.profiling import device_trace
+    with device_trace(None):
+        pass
+    logdir = tmp_path / "trace"
+    with device_trace(str(logdir)):
+        jnp.arange(16).sum().block_until_ready()
+    dumped = list(logdir.rglob("*"))
+    assert dumped, "profiler trace produced no files"
