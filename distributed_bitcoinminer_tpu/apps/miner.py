@@ -50,7 +50,7 @@ def default_searcher_factory(data: str, batch: Optional[int] = None):
     """
     import os
 
-    if os.environ.get("DBM_COMPUTE") == "host":
+    if os.environ.get("DBM_COMPUTE", "").lower() == "host":
         return HostSearcher(data)
 
     import jax

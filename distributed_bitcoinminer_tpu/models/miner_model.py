@@ -32,8 +32,16 @@ _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
 
 
 def default_tier() -> str:
-    """Compute-tier choice: ``DBM_COMPUTE`` env (jnp | pallas), default jnp."""
-    return os.environ.get("DBM_COMPUTE", "jnp").lower()
+    """Device-kernel tier from ``DBM_COMPUTE``: ``pallas`` selects the
+    Mosaic kernel; the *searcher-level* values that config.make_searcher
+    also reads from the same variable (``auto``/``jax``/``host``) mean
+    "not a tier request" and map to the jnp default — round 3 fix:
+    ``DBM_COMPUTE=jax`` used to leak through as an unknown tier and crash
+    the miner's first search."""
+    value = os.environ.get("DBM_COMPUTE", "jnp").lower()
+    if value in ("", "jnp", "auto", "jax", "host"):
+        return "jnp"
+    return value  # 'pallas', or unknown -> NonceSearcher raises
 
 
 def pallas_interpret_mode() -> bool:

@@ -107,6 +107,8 @@ def from_env() -> FrameworkConfig:
     batch = os.environ.get("DBM_BATCH")
     return FrameworkConfig(
         params=params,
-        compute=os.environ.get("DBM_COMPUTE", "auto"),
+        # Normalized once here so every downstream comparison (make_searcher,
+        # default_searcher_factory, models.default_tier) sees one casing.
+        compute=os.environ.get("DBM_COMPUTE", "auto").lower(),
         batch=int(batch) if batch else None,
     )

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""On-chip kernel tuning harness (run manually on a real TPU).
+
+Three measurements, each printed as one line:
+
+1. VPU u32 ceiling — a synthetic Pallas kernel issuing a pure
+   rotate-xor-add chain (SHA-round-shaped ops, no memory traffic) to
+   estimate attainable uint32 ops/s. Divides into the ~3.2k ops/nonce of
+   one SHA-256 compression to bound the nonce-rate ceiling on this chip.
+2. rows sweep — the real kernel at a fixed span with varying sublane
+   counts (grid-step size), per-call blocked timing.
+3. tier waterfall — jnp vs pallas at the bench geometry.
+
+Usage: python scripts/tpu_tune.py [span_log2]   (default 24)
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    span_log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    total = 1 << span_log2
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from distributed_bitcoinminer_tpu.ops.search import search_span
+    from distributed_bitcoinminer_tpu.ops.sha256_host import sha256_midstate
+    from distributed_bitcoinminer_tpu.ops.sha256_jnp import build_tail_template
+    from distributed_bitcoinminer_tpu.ops.sha256_pallas import (
+        pallas_geometry, pallas_search_span)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} platform={dev.platform}", flush=True)
+
+    # --- 1. VPU u32 ceiling ------------------------------------------------
+    OPS_PER_ITER = 6 * 8   # 8 chains x (2 shifts + or + xor + 2 adds)
+    ITERS = 2000
+
+    def vpu_kernel(o_ref):
+        xs = [jax.lax.broadcasted_iota(jnp.uint32, (8, 128), 1)
+              + np.uint32(i) for i in range(8)]
+
+        def body(i, xs):
+            out = []
+            for x in xs:
+                r = (x >> np.uint32(7)) | (x << np.uint32(25))
+                out.append((r ^ x) + (i.astype(jnp.uint32) + x))
+            return tuple(out)
+
+        xs = jax.lax.fori_loop(0, ITERS, body, tuple(xs))
+        acc = xs[0]
+        for x in xs[1:]:
+            acc = acc ^ x
+        o_ref[...] = acc
+
+    grid_steps = 256
+    f = pl.pallas_call(
+        vpu_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+        grid=(grid_steps,),
+        out_specs=pl.BlockSpec((8, 128), lambda s: (0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    jf = jax.jit(f)
+    jax.block_until_ready(jf())
+    best = min(_timed(jf) for _ in range(3))
+    ops = 8 * 128 * OPS_PER_ITER * ITERS * grid_steps
+    print(f"vpu_u32_ceiling: {ops / best / 1e12:.2f} Tops/s "
+          f"(=> ~{ops / best / 3.2e3 / 1e6:.0f} Mnonce/s SHA bound)",
+          flush=True)
+
+    # --- 2/3. real kernel -------------------------------------------------
+    data = "cmu440"
+    prefix = data.encode() + b" 2"
+    midstate, tail = sha256_midstate(prefix)
+    k = 9
+    template = build_tail_template(tail, k, len(prefix) + k)
+    ms = np.asarray(midstate, np.uint32)
+    tp = template.astype(np.uint32)
+
+    for rows in (8, 16, 32, 64):
+        nsteps = -(-total // (rows * 128))
+        call = functools.partial(
+            pallas_search_span, ms, tp, np.uint32(0), np.uint32(0),
+            np.uint32(total - 1), rem=len(tail), k=k, rows=rows,
+            nsteps=nsteps)
+        jax.block_until_ready(call())
+        best = min(_timed(call) for _ in range(3))
+        print(f"pallas rows={rows:3d}: {total / best / 1e6:8.1f} Mnonce/s",
+              flush=True)
+
+    batch = 1 << 20
+    nb = -(-total // batch)
+    jcall = functools.partial(
+        search_span, ms, tp, np.uint32(0), np.uint32(0),
+        np.uint32(total - 1), rem=len(tail), k=k, batch=batch, nbatches=nb)
+    jax.block_until_ready(jcall())
+    best = min(_timed(jcall) for _ in range(3))
+    print(f"jnp batch=2^20 : {total / best / 1e6:8.1f} Mnonce/s", flush=True)
+
+    rows, nsteps = pallas_geometry(total)
+    print(f"default geometry: rows={rows} nsteps={nsteps}", flush=True)
+    return 0
+
+
+def _timed(fn) -> float:
+    import jax
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn())
+    return time.perf_counter() - t0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

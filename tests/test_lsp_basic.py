@@ -78,6 +78,16 @@ class TestBasic:
         # Analog of TestBasic6: 2 clients x 250 msgs, window 20, bounded time.
         asyncio.run(run_echo(2, 250, fast_params(window=20, epoch_ms=100), timeout=10))
 
+    def test_sendreceive_no_epochs(self):
+        # Ref TestSendReceive1-3 (lsp1_test.go:269-288): delivery must not
+        # lean on epoch ticks — epochs are ~never (5 s) and the whole
+        # 2x6-message exchange must finish before the first could fire.
+        import time
+        t0 = time.monotonic()
+        asyncio.run(run_echo(2, 6, fast_params(window=1, epoch_ms=5000,
+                                               limit=3)))
+        assert time.monotonic() - t0 < 4.0
+
     def test_conn_ids_unique(self):
         async def scenario():
             params = fast_params()

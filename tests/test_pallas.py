@@ -108,6 +108,12 @@ def test_default_tier_env(monkeypatch):
     assert default_tier() == "jnp"
     monkeypatch.setenv("DBM_COMPUTE", "PALLAS")
     assert default_tier() == "pallas"
+    # Searcher-level values of the shared env var are NOT tier requests:
+    # they must map to the jnp default, not crash the searcher (r3 fix).
+    for v in ("auto", "jax", "host"):
+        monkeypatch.setenv("DBM_COMPUTE", v)
+        assert default_tier() == "jnp"
+        NonceSearcher("x", batch=128)   # constructs fine
     monkeypatch.setenv("DBM_COMPUTE", "bogus")
     with pytest.raises(ValueError):
         NonceSearcher("x", batch=128)
