@@ -41,12 +41,14 @@ class HostSearcher:
         return native.scan_min_native(self.data, lower, upper)
 
 
-def default_searcher_factory(data: str, batch: Optional[int] = None):
+def default_searcher_factory(data: str, batch: Optional[int] = None,
+                             tier: Optional[str] = None):
     """Pick the widest available compute plane for ``data``.
 
     Multi-device -> mesh-sharded search; single device -> plain chunked scan;
     ``DBM_COMPUTE=host`` -> pure-host scan (no JAX), for boxes without
-    accelerators and for process-level tests.
+    accelerators and for process-level tests. ``tier`` pins the device
+    kernel (jnp | pallas); None reads the environment default.
     """
     import os
 
@@ -64,8 +66,9 @@ def default_searcher_factory(data: str, batch: Optional[int] = None):
     if batch is None:
         batch = (1 << 20) if devices[0].platform != "cpu" else (1 << 12)
     if len(devices) > 1:
-        return ShardedNonceSearcher(data, batch=batch, mesh=make_mesh())
-    return NonceSearcher(data, batch=batch)
+        return ShardedNonceSearcher(data, batch=batch, mesh=make_mesh(),
+                                    tier=tier)
+    return NonceSearcher(data, batch=batch, tier=tier)
 
 
 class MinerWorker:

@@ -8,7 +8,9 @@ every process — scheduler, miner, runner — is configured the same way.
 Environment variables:
 
 - ``DBM_COMPUTE``: ``auto`` (default; widest JAX plane), ``host`` (native
-  C++/SHA-NI scan, no JAX), ``jax`` (force single-device JAX).
+  C++/SHA-NI scan, no JAX), ``jax`` (force single-device JAX), or a
+  device-kernel tier — ``jnp`` / ``pallas`` — which keeps auto searcher
+  selection but pins the kernel (models.miner_model.default_tier).
 - ``DBM_BATCH``: per-device lane count per device step.
 - ``DBM_EPOCH_LIMIT`` / ``DBM_EPOCH_MILLIS`` / ``DBM_WINDOW`` /
   ``DBM_MAX_BACKOFF``: transport parameters (defaults 5/2000/1/0, matching
@@ -74,7 +76,13 @@ class FrameworkConfig:
     batch: int | None = None       # None -> platform default
 
     def make_searcher(self, data: str):
-        """Build the configured searcher for one message string."""
+        """Build the configured searcher for one message string.
+
+        Tier-valued settings (``jnp``/``pallas``) are threaded through
+        explicitly rather than re-read from the environment downstream
+        (review r3: a programmatic ``FrameworkConfig(compute="pallas")``
+        silently fell back to jnp unless the env var happened to be set).
+        """
         if self.compute == "host":
             from ..apps.miner import HostSearcher
             return HostSearcher(data)
@@ -83,7 +91,8 @@ class FrameworkConfig:
             apply_jax_platform_env()
             return NonceSearcher(data, batch=self.batch or (1 << 20))
         from ..apps.miner import default_searcher_factory
-        return default_searcher_factory(data, self.batch)
+        tier = self.compute if self.compute in ("jnp", "pallas") else None
+        return default_searcher_factory(data, self.batch, tier=tier)
 
 
 def _int_env(name: str, default: int) -> int:

@@ -17,6 +17,7 @@ Usage: python scripts/tpu_tune.py [span_log2]   (default 24)
 from __future__ import annotations
 
 import functools
+import os
 import sys
 import time
 
@@ -32,7 +33,8 @@ def main() -> int:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from distributed_bitcoinminer_tpu.ops.search import search_span
     from distributed_bitcoinminer_tpu.ops.sha256_host import sha256_midstate
     from distributed_bitcoinminer_tpu.ops.sha256_jnp import build_tail_template

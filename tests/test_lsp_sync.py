@@ -34,3 +34,66 @@ def test_sync_echo_roundtrip():
     server.close()
     thread.join(timeout=5)
     assert not thread.is_alive()
+
+
+def test_sync_facade_under_threaded_load():
+    """Round-1 backlog item "sync-facade load": the Go deployment shape is
+    one OS thread per blocking client over ONE shared background loop; 5
+    client threads x 100 in-order round-trips must hold w=10 discipline
+    with no cross-talk, and every thread (incl. the server's) must be
+    joinable afterwards — the no-goroutine-outlives-Close rule for the
+    facade layer."""
+    params = Params(epoch_limit=20, epoch_millis=100, window_size=10,
+                    max_backoff_interval=1)
+    server = new_server(0, params)
+
+    def echo():
+        while True:
+            try:
+                conn_id, item = server.read()
+            except Exception:  # noqa: BLE001 — server closed
+                return
+            if isinstance(item, bytes):
+                try:
+                    server.write(conn_id, item)
+                except Exception:  # noqa: BLE001
+                    return
+
+    echo_thread = threading.Thread(target=echo, daemon=True)
+    echo_thread.start()
+
+    errors: list[str] = []
+
+    def one_client(idx: int):
+        try:
+            c = new_client(f"127.0.0.1:{server.port}", params)
+            for i in range(100):
+                payload = f"t{idx}m{i:03d}".encode()
+                c.write(payload)
+                got = c.read()
+                if got != payload:
+                    errors.append(f"thread {idx} msg {i}: {got!r}")
+                    return
+            c.close()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(f"thread {idx}: {exc!r}")
+
+    threads = [threading.Thread(target=one_client, args=(i,))
+               for i in range(5)]
+    try:
+        for t in threads:
+            t.start()
+        wedged = []
+        for t in threads:
+            t.join(timeout=30)
+            if t.is_alive():
+                wedged.append(t.name)
+    finally:
+        # Close BEFORE asserting: a failed assertion must not leak the
+        # bound socket + a thread parked in server.read() into the rest
+        # of the pytest session (review r3).
+        server.close()
+        echo_thread.join(timeout=5)
+    assert not wedged, f"client threads wedged: {wedged}"
+    assert not errors, errors
+    assert not echo_thread.is_alive()

@@ -108,6 +108,41 @@ class TestExpBackOff:
             await server.close()
         asyncio.run(scenario())
 
+    def test_retransmit_law_ten_clients(self):
+        """TestExpBackOff2 analog (ref lsp2_test.go:542-547): the sniffer-
+        counted 4-6 sends-per-window law must hold aggregated over 10
+        concurrent clients each streaming into blackholed acks."""
+        async def scenario():
+            window, nclients = 5, 10
+            epochs, epoch_ms = 14, 60
+            params = params_with(window=window, backoff=1000,
+                                 epoch_ms=epoch_ms, limit=epochs + 10)
+            server = await new_async_server(0, params)
+            clients = [await new_async_client(f"127.0.0.1:{server.port}",
+                                              params)
+                       for _ in range(nclients)]
+            lspnet.set_server_write_drop_percent(100)
+            lspnet.start_sniff()
+            try:
+                for c in clients:
+                    for i in range(15):  # > window: only 5 reach the wire
+                        c.write(f"m{i}".encode())
+                await asyncio.sleep(epochs * epoch_ms / 1000.0)
+                result = lspnet.stop_sniff()
+                lspnet.set_server_write_drop_percent(0)
+                total = result.num_sent_data
+                low, high = 4 * window * nclients, 6 * window * nclients
+                assert low <= total <= high, \
+                    f"sent {total} data packets; expected [{low}, {high}]"
+            finally:
+                # Close before a failed assertion can leak 11 endpoints
+                # mid-retransmit into the loop teardown (review r3).
+                lspnet.set_server_write_drop_percent(0)
+                for c in clients:
+                    await c.close()
+                await server.close()
+        asyncio.run(scenario())
+
     def test_capped_backoff_resends_regularly(self):
         """max_backoff=1 => a resend at least every 2 epochs."""
         async def scenario():
