@@ -95,6 +95,20 @@ def _measure(searcher, lower: int, upper: int, min_time_s: float,
     return count * reps / secs, secs, reps
 
 
+def _measure_overlapped(searcher, lower: int, upper: int, reps: int,
+                        timer_cls) -> float:
+    """nonces/sec with dispatch/finalize pipelined: every repetition is
+    enqueued before the first result is forced, so device compute overlaps
+    host readback + merge (SURVEY §7's double-buffering; only searchers
+    exposing dispatch/finalize support it)."""
+    count = upper - lower + 1
+    with timer_cls() as t:
+        batches = [searcher.dispatch(lower, upper) for _ in range(reps)]
+        for b in batches:
+            searcher.finalize(b, lower)
+    return count * reps / t.seconds
+
+
 def main() -> int:
     init_deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
     probe = _probe_backend(init_deadline)
@@ -168,6 +182,15 @@ def main() -> int:
                                         Timer)
             results[tier] = {"rate": rate, "secs": secs, "reps": reps,
                              "warmup_s": round(warm_s, 3)}
+            if hasattr(searcher, "dispatch"):
+                # Isolated: a failed overlap measurement must not mark a
+                # tier whose sequential number already succeeded as failed.
+                try:
+                    results[tier]["overlapped_rate"] = round(
+                        _measure_overlapped(searcher, lower, upper,
+                                            max(2, reps), Timer), 1)
+                except Exception as exc:  # noqa: BLE001
+                    results[tier]["overlapped_error"] = repr(exc)[:200]
         except Exception as exc:  # noqa: BLE001 — one tier failing must not
             # kill the other's number; keep the head AND tail of the message
             # so file:line survives truncation (ADVICE r2: the r02 Mosaic
@@ -192,6 +215,9 @@ def main() -> int:
         "timed_s": round(best["secs"], 3),
         "warmup_s": best["warmup_s"],
         "all_tiers": {t: round(r["rate"], 1) for t, r in results.items()},
+        # The SURVEY §7 waterfall: sequential vs dispatch-pipelined rates.
+        "overlapped": {t: r["overlapped_rate"] for t, r in results.items()
+                       if "overlapped_rate" in r},
         **({"tier_errors": errors} if errors else {}),
         **({"probe": probe} if force_cpu else {}),
     })

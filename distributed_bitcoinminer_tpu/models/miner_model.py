@@ -158,16 +158,24 @@ class NonceSearcher:
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
             rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
 
-    def search(self, lower: int, upper: int) -> tuple[int, int]:
-        """Exact (min_hash, argmin_nonce) over the inclusive range.
+    def dispatch(self, lower: int, upper: int) -> list:
+        """Dispatch every block of the range WITHOUT forcing results.
 
-        Dispatches every block asynchronously, then merges on host in
-        ascending order (strict less keeps the earliest nonce on ties).
+        Returns an opaque list of (base, device-triple) pairs for
+        :meth:`finalize`. JAX dispatch is asynchronous, so a caller can
+        enqueue several ranges back-to-back and keep the device busy while
+        earlier results transfer — the host<->device overlap knob
+        (SURVEY §7 "double-buffer chunks"; bench measures it automatically
+        whenever a searcher exposes dispatch/finalize).
         """
         if lower > upper:
             raise ValueError("empty range")
-        results = [(plan.base, self.search_block(plan))
-                   for plan in self.plan(lower, upper)]
+        return [(plan.base, self.search_block(plan))
+                for plan in self.plan(lower, upper)]
+
+    def finalize(self, results: list, lower: int) -> tuple[int, int]:
+        """Force dispatched block results and merge on host in ascending
+        order (strict less keeps the earliest nonce on ties)."""
         best_hash, best_nonce = MAX_U64, lower
         seen = False
         for base, (hi, lo, idx) in results:
@@ -178,6 +186,10 @@ class NonceSearcher:
             if not seen or h < best_hash:
                 best_hash, best_nonce, seen = h, base + idx, True
         return best_hash, best_nonce
+
+    def search(self, lower: int, upper: int) -> tuple[int, int]:
+        """Exact (min_hash, argmin_nonce) over the inclusive range."""
+        return self.finalize(self.dispatch(lower, upper), lower)
 
     def _until_block(self, plan: _BlockPlan, t_hi: int, t_lo: int):
         """Difficulty-target dispatch for one block; overridden by the
