@@ -157,12 +157,26 @@ def main() -> int:
     tier_req = os.environ.get("DBM_COMPUTE", "auto").lower()
 
     def build(tier: str):
+        if tier == "host":
+            from distributed_bitcoinminer_tpu.apps.miner import HostSearcher
+            return HostSearcher(data)
         if len(devices) > 1:
             return ShardedNonceSearcher(
                 data, batch=batch, mesh=make_mesh(len(devices)), tier=tier)
         return NonceSearcher(data, batch=batch, tier=tier)
 
-    tiers = [tier_req] if tier_req in ("jnp", "pallas") else ["jnp", "pallas"]
+    if tier_req in ("jnp", "pallas", "host"):
+        tiers = [tier_req]
+    else:
+        tiers = ["jnp", "pallas"]
+        if not on_accel:
+            # CPU fallback: the native SHA-NI scan is the strongest
+            # host-side tier — measure it so a wedged-chip bench still
+            # records the best available number. (Skipped without a
+            # toolchain: the Python-oracle fallback can never win.)
+            from distributed_bitcoinminer_tpu import native
+            if native.available():
+                tiers.append("host")
     results, errors = {}, {}
     gate_lo, gate_hi = lower, lower + 9_999
     want = scan_min(data, gate_lo, gate_hi)

@@ -31,14 +31,18 @@ logger = logging.getLogger("dbm.miner")
 
 class HostSearcher:
     """Device-free fallback: the native C++ scan (SHA-NI where the CPU has
-    it), or the pure-Python oracle when no toolchain is present."""
+    it, all cores for large ranges), or the pure-Python oracle when no
+    toolchain is present. ``threads``: 0 = auto, 1 = single-threaded,
+    N = pinned worker count."""
 
-    def __init__(self, data: str):
+    def __init__(self, data: str, threads: int = 0):
         self.data = data
+        self.threads = threads
 
     def search(self, lower: int, upper: int):
         from .. import native
-        return native.scan_min_native(self.data, lower, upper)
+        return native.scan_min_native(self.data, lower, upper,
+                                      threads=self.threads)
 
 
 def default_searcher_factory(data: str, batch: Optional[int] = None,

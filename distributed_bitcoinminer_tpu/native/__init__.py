@@ -37,7 +37,8 @@ _build_failed = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
@@ -59,6 +60,17 @@ def load() -> Optional[ctypes.CDLL]:
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
+            if not hasattr(lib, "dbm_scan_min_mt"):
+                # Stale cached .so from before the MT scan existed (mtime
+                # can lie after a checkout restore): rebuild once. dlclose
+                # first — dlopen caches by path, so reloading without it
+                # would hand back the stale handle.
+                import _ctypes
+                _ctypes.dlclose(lib._handle)
+                if not _build():
+                    _build_failed = True
+                    return None
+                lib = ctypes.CDLL(_LIB)
         except OSError as exc:
             logger.info("native load failed (%s)", exc)
             _build_failed = True
@@ -71,6 +83,11 @@ def load() -> Optional[ctypes.CDLL]:
         lib.dbm_hash.restype = ctypes.c_uint64
         lib.dbm_hash.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                  ctypes.c_uint64]
+        lib.dbm_scan_min_mt.restype = ctypes.c_int
+        lib.dbm_scan_min_mt.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
 
@@ -79,9 +96,21 @@ def available() -> bool:
     return load() is not None
 
 
-def scan_min_native(data: str, lower: int, upper: int) -> Tuple[int, int]:
+#: Ranges at least this long fan out over all cores (a 2^17 scan takes
+#: ~10 ms single-threaded; spawn cost is noise well below that).
+_MT_THRESHOLD = 1 << 17
+
+
+def scan_min_native(data: str, lower: int, upper: int,
+                    threads: int = 0) -> Tuple[int, int]:
     """Native arg-min scan over [lower, upper]; falls back to the Python
-    oracle when the toolchain is missing."""
+    oracle when the toolchain is missing.
+
+    ``threads``: 0 = auto (all cores for ranges >= 2^17, else one);
+    1 forces single-threaded; N pins the worker count. The tie rule is
+    identical either way (contiguous ascending sub-ranges, first-seen
+    wins).
+    """
     lib = load()
     if lib is None:
         from ..bitcoin.hash import scan_min
@@ -89,8 +118,16 @@ def scan_min_native(data: str, lower: int, upper: int) -> Tuple[int, int]:
     raw = data.encode("utf-8")
     out_hash = ctypes.c_uint64()
     out_nonce = ctypes.c_uint64()
-    rc = lib.dbm_scan_min(raw, len(raw), lower, upper,
-                          ctypes.byref(out_hash), ctypes.byref(out_nonce))
+    if threads == 0 and upper - lower + 1 < _MT_THRESHOLD:
+        threads = 1
+    if threads == 1:
+        rc = lib.dbm_scan_min(raw, len(raw), lower, upper,
+                              ctypes.byref(out_hash),
+                              ctypes.byref(out_nonce))
+    else:
+        rc = lib.dbm_scan_min_mt(raw, len(raw), lower, upper, threads,
+                                 ctypes.byref(out_hash),
+                                 ctypes.byref(out_nonce))
     if rc != 0:
         raise ValueError("empty range")
     return out_hash.value, out_nonce.value

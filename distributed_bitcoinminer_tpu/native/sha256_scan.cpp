@@ -16,6 +16,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #if defined(__SHA__) && defined(__SSE4_1__)
 #include <immintrin.h>
@@ -236,6 +238,66 @@ uint64_t dbm_hash(const char* data, uint64_t data_len, uint64_t nonce) {
   uint64_t h, n;
   if (dbm_scan_min(data, data_len, nonce, nonce, &h, &n) != 0) return 0;
   return h;
+}
+
+// Multi-threaded scan: contiguous sub-ranges, one per thread, merged with
+// the same strict-'<' / earliest-nonce tie rule (sub-ranges ascend with the
+// thread index, so merging in index order preserves first-seen-wins).
+// nthreads <= 0 means hardware_concurrency.
+int dbm_scan_min_mt(const char* data, uint64_t data_len, uint64_t lower,
+                    uint64_t upper, int nthreads, uint64_t* out_hash,
+                    uint64_t* out_nonce) {
+  if (lower > upper) return -1;
+  uint64_t total = upper - lower + 1;
+  unsigned hw = std::thread::hardware_concurrency();
+  uint64_t want = nthreads > 0 ? uint64_t(nthreads) : (hw ? hw : 1);
+  if (want > total) want = total;
+  if (want <= 1) return dbm_scan_min(data, data_len, lower, upper,
+                                     out_hash, out_nonce);
+
+  std::vector<uint64_t> los(want), his(want);
+  uint64_t per = total / want, extra = total % want, start = lower;
+  for (uint64_t t = 0; t < want; ++t) {
+    uint64_t len = per + (t < extra ? 1 : 0);
+    los[t] = start;
+    his[t] = start + len - 1;
+    start += len;
+  }
+  std::vector<uint64_t> hashes(want), nonces(want);
+  std::vector<std::thread> threads;
+  threads.reserve(want);
+  uint64_t spawned = 0;
+  try {
+    for (uint64_t t = 0; t < want; ++t) {
+      uint64_t lo = los[t], hi = his[t];
+      threads.emplace_back([=, &hashes, &nonces] {
+        dbm_scan_min(data, data_len, lo, hi, &hashes[t], &nonces[t]);
+      });
+      ++spawned;
+    }
+  } catch (...) {
+    // Thread spawn failed (e.g. EAGAIN under a pid limit). Letting the
+    // vector destroy joinable threads would std::terminate the whole
+    // process; instead join what started and scan the uncovered tail on
+    // this thread (sub-ranges stay ascending, so the merge rule holds).
+  }
+  for (auto& th : threads) th.join();
+  uint64_t covered = spawned;
+  if (covered < want) {
+    dbm_scan_min(data, data_len, los[covered], upper,
+                 &hashes[covered], &nonces[covered]);
+    ++covered;
+  }
+  uint64_t best_hash = hashes[0], best_nonce = nonces[0];
+  for (uint64_t t = 1; t < covered; ++t) {
+    if (hashes[t] < best_hash) {
+      best_hash = hashes[t];
+      best_nonce = nonces[t];
+    }
+  }
+  *out_hash = best_hash;
+  *out_nonce = best_nonce;
+  return 0;
 }
 
 }  // extern "C"

@@ -30,3 +30,18 @@ def test_single_hash_matches():
 def test_empty_range_raises():
     with pytest.raises(ValueError):
         native.scan_min_native("x", 5, 4)
+
+
+def test_mt_scan_matches_single_threaded():
+    """The threaded fan-out (contiguous ascending sub-ranges, merged in
+    index order) must preserve the strict-'<' earliest-nonce tie rule
+    bit-for-bit — including ranges that straddle digit rollovers and
+    ranges shorter than the thread count."""
+    for lo, hi in ((0, 70_000), (99_990, 163_000)):
+        st = native.scan_min_native("mt", lo, hi, threads=1)
+        for threads in (2, 3, 8):
+            assert native.scan_min_native("mt", lo, hi,
+                                          threads=threads) == st
+    # More threads than nonces degrades to one nonce per thread.
+    assert native.scan_min_native("mt", 7, 9, threads=8) == \
+        scan_min("mt", 7, 9)
