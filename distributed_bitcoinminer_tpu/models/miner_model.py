@@ -35,19 +35,36 @@ def default_tier() -> str:
     """Device-kernel tier from ``DBM_COMPUTE``: ``pallas`` selects the
     Mosaic kernel; the *searcher-level* values that config.make_searcher
     also reads from the same variable (``auto``/``jax``/``host``) mean
-    "not a tier request" and map to the jnp default — round 3 fix:
-    ``DBM_COMPUTE=jax`` used to leak through as an unknown tier and crash
-    the miner's first search."""
-    value = os.environ.get("DBM_COMPUTE", "jnp").lower()
-    if value in ("", "jnp", "auto", "jax", "host"):
-        return "jnp"
-    return value  # 'pallas', or unknown -> NonceSearcher raises
+    "not a tier request" and resolve by platform — the Mosaic kernel on a
+    real chip (it benches ~20% above the jnp tier there, round 3:
+    265M vs 222M nonces/s), the XLA tier anywhere else (off-chip pallas
+    would run in the Mosaic simulator at interpreter speed). ``jnp`` pins
+    the XLA tier explicitly. (Round-3 fix lineage: ``DBM_COMPUTE=jax``
+    used to leak through as an unknown tier and crash the miner's first
+    search.)"""
+    value = os.environ.get("DBM_COMPUTE", "auto").lower()
+    if value in ("", "auto", "jax", "host"):
+        import jax
+        on_chip = jax.devices()[0].platform in ("tpu", "axon")
+        return "pallas" if on_chip else "jnp"
+    return value  # 'jnp'/'pallas', or unknown -> NonceSearcher raises
 
 
-def pallas_interpret_mode() -> bool:
-    """Pallas runs in interpret mode off-TPU (tests on the CPU mesh)."""
-    import jax
-    return jax.default_backend() not in ("tpu", "axon")
+def pallas_interpret_mode(platform: str | None = None) -> bool:
+    """Pallas runs in interpret mode off-TPU (tests on the CPU mesh).
+
+    ``platform`` should be the platform of the devices the kernel will
+    actually run on (e.g. ``mesh.devices.flat[0].platform``) whenever the
+    caller knows it: ``jax.default_backend()`` is only a fallback, and a
+    wrong one under this image's sitecustomize — with ``JAX_PLATFORMS=cpu``
+    set purely as an env var the default backend still resolves to the
+    axon TPU plugin while the devices in play are CPU, which round 3
+    caught as a real-lowering attempt on the CPU mesh ("Only interpret
+    mode is supported on CPU backend")."""
+    if platform is None:
+        import jax
+        platform = jax.default_backend()
+    return platform not in ("tpu", "axon")
 
 
 def _digit_classes(lower: int, upper: int):
@@ -143,16 +160,23 @@ class NonceSearcher:
         i0, nbatches = self._block_geometry(plan)
         total = self.batch * nbatches
         if self.tier == "pallas":
+            import jax
+
             from ..ops.sha256_pallas import pallas_geometry, pallas_search_span
             rows, nsteps = pallas_geometry(total)
             # Off-TPU the kernel runs in the Mosaic TPU simulator
             # (pltpu.InterpretParams — seconds per grid step, bit-exact);
-            # on the chip it lowers through Mosaic.
+            # on the chip it lowers through Mosaic. devices()[0] is the
+            # default device — exactly where this un-sharded call will be
+            # placed — so its platform (not the backend NAME, which the
+            # axon plugin reports differently) is the right interpret
+            # signal here; the mesh path derives it from the mesh instead.
             return pallas_search_span(
                 np.asarray(plan.midstate, dtype=np.uint32), plan.template,
                 np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
                 rem=plan.rem, k=plan.k, rows=rows, nsteps=nsteps,
-                interpret=pallas_interpret_mode())
+                interpret=pallas_interpret_mode(
+                    jax.devices()[0].platform))
         return search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),

@@ -64,12 +64,14 @@ def load() -> Optional[ctypes.CDLL]:
                 # Stale cached .so from before the MT scan existed (mtime
                 # can lie after a checkout restore): rebuild once. dlclose
                 # first — dlopen caches by path, so reloading without it
-                # would hand back the stale handle.
+                # would hand back the stale handle. If the rebuild fails
+                # (toolchain gone), reload the stale lib and serve the
+                # single-threaded scan from it rather than dropping to the
+                # Python oracle (code-review r3): scan_min_native routes
+                # threads->1 when the MT symbol is absent.
                 import _ctypes
                 _ctypes.dlclose(lib._handle)
-                if not _build():
-                    _build_failed = True
-                    return None
+                _build()
                 lib = ctypes.CDLL(_LIB)
         except OSError as exc:
             logger.info("native load failed (%s)", exc)
@@ -83,11 +85,13 @@ def load() -> Optional[ctypes.CDLL]:
         lib.dbm_hash.restype = ctypes.c_uint64
         lib.dbm_hash.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                  ctypes.c_uint64]
-        lib.dbm_scan_min_mt.restype = ctypes.c_int
-        lib.dbm_scan_min_mt.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-            ctypes.c_uint64, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        if hasattr(lib, "dbm_scan_min_mt"):
+            lib.dbm_scan_min_mt.restype = ctypes.c_int
+            lib.dbm_scan_min_mt.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
 
@@ -120,6 +124,8 @@ def scan_min_native(data: str, lower: int, upper: int,
     out_nonce = ctypes.c_uint64()
     if threads == 0 and upper - lower + 1 < _MT_THRESHOLD:
         threads = 1
+    if not hasattr(lib, "dbm_scan_min_mt"):
+        threads = 1  # stale pre-MT lib kept alive without a toolchain
     if threads == 1:
         rc = lib.dbm_scan_min(raw, len(raw), lower, upper,
                               ctypes.byref(out_hash),

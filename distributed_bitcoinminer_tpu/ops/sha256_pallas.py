@@ -10,10 +10,13 @@ bitcoin/miner/miner.go:52-59), hand-lowered for the TPU VPU:
   registers and whose K constants are dynamic reads from the
   scalar-prefetch SMEM vector; block 0 skips the schedule update via a
   cheap ``where`` guard. The rolled form keeps the traced graph ~16x
-  smaller than a full unroll: Mosaic still register-allocates the carries,
-  while XLA:CPU compiles it in seconds — unrolling even ~12 rounds outside
-  the loop sent XLA:CPU's pass pipeline into a superlinear blowup that
-  round 2 misread as "interpret is slow".
+  smaller than a full unroll, which both Mosaic and — critically — the
+  XLA:CPU interpret path need (an unrolled SHA graph sends XLA:CPU's pass
+  pipeline into a superlinear blowup; reconfirmed on-box in round 3).
+  Mosaic layout inference needs one extra nudge: every value carried into
+  the loop is de-replicated first (see the ``nz`` comment in the kernel),
+  because a replicated-layout carry init meeting the body's plain vector
+  yield is an illegal back-edge relayout.
 - The result rides in three (rows, 128) accumulator outputs holding the
   elementwise running lexicographic min across grid steps. Their BlockSpec
   is the WHOLE array with a constant index map, which is always
@@ -116,14 +119,28 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *, rem: int, k: int,
             w.append(wv)
         sa, sb, sc, sd, se, sf, sg, sh = a, b, c, d, e, f, g, h
 
+        # Every carry entering the fori_loop must already have the plain
+        # {0,0} vector register layout: jnp.full broadcasts of SMEM scalars
+        # get the *replicated* {*,*} layout, the loop body yields {0,0}
+        # vectors, and Mosaic rejects the back-edge relayout ("Invalid
+        # relayout: Non-singleton logical dimension is replicated in
+        # destination but not in source" — the round-3 on-chip failure).
+        # ``nz`` is an iota-derived zero (lane < 2^31 always) that layout
+        # inference cannot fold away, de-replicating each init for one
+        # shift + add per carried tile per grid step.
+        nz = lane >> np.uint32(31)
+        w = [wv + nz for wv in w]
+        a, b, c, d = a + nz, b + nz, c + nz, d + nz
+        e, f, g, h = e + nz, f + nz, g + nz, h + nz
+
         # All 64 rounds as ONE fori_loop over four 16-round schedule
         # blocks; block 0 keeps the window untouched via a cheap ``where``
-        # guard (~2 extra VPU ops per round). Keeping every round inside
-        # the loop is deliberate: unrolling even ~12 rounds ahead of the
-        # loop sends XLA:CPU (the interpret test path) into an exponential
-        # optimizer blowup, while Mosaic register-allocates the 24 carried
-        # tiles either way. K rides in SMEM via the scalar-prefetch ref
-        # (dynamic per-round reads).
+        # guard. The rolled form keeps the traced graph ~16x smaller than
+        # a full unroll, which is what keeps the interpret/test path
+        # viable: XLA:CPU's pass pipeline blows up super-linearly on an
+        # unrolled SHA graph (round-2 finding, reconfirmed in round 3 —
+        # one unrolled interpret step exceeded 240 s). K rides in SMEM via
+        # the scalar-prefetch ref (dynamic per-round reads).
         def block16(bi, carry):
             a, b, c, d, e, f, g, h = carry[:8]
             w = list(carry[8:])

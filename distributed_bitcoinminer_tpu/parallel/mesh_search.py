@@ -75,6 +75,10 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
     def body(midstate, template, i0, lo_i, hi_i):
         total = batch * nbatches
         from ..models.miner_model import pallas_interpret_mode
+        # Interpret iff the MESH devices are CPU — not the default backend,
+        # which this image's sitecustomize can pin to the axon TPU plugin
+        # even when the mesh in play is the virtual CPU one.
+        mesh_platform = mesh.devices.flat[0].platform
         # The pallas tier runs everywhere since round 3: through Mosaic on
         # the chip, through the Mosaic TPU simulator (InterpretParams) on
         # the CPU test mesh. The out ShapeDtypeStructs carry vma=(AXIS,) so
@@ -86,7 +90,7 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
             hi_h, lo_h, idx = pallas_search_span(
                 midstate, template, i0[0], lo_i, hi_i,
                 rem=rem, k=k, rows=rows, nsteps=nsteps,
-                interpret=pallas_interpret_mode(), vma=(AXIS,))
+                interpret=pallas_interpret_mode(mesh_platform), vma=(AXIS,))
         else:
             hi_h, lo_h, idx = span_scan_body(
                 midstate, template, i0[0], lo_i, hi_i,

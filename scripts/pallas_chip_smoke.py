@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""On-chip smoke test for the Pallas tier (VERDICT r2: never ship an
+untried kernel again).
+
+Runs one tiny pallas_search_span on the default backend, checks the
+result against the host oracle, and prints rate for a medium block.
+Exit 0 = kernel lowers + bit-exact; nonzero = failure (error printed).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    import jax
+
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+    from distributed_bitcoinminer_tpu.models import NonceSearcher
+
+    print(f"platform={jax.devices()[0].platform}", flush=True)
+    data = "cmu440"
+    s = NonceSearcher(data, batch=1 << 20, tier="pallas")
+
+    lo, hi = 2_000_000_000, 2_000_009_999
+    t0 = time.time()
+    got = s.search(lo, hi)
+    print(f"tiny search: {time.time() - t0:.1f}s", flush=True)
+    want = scan_min(data, lo, hi)
+    if got != want:
+        print(f"MISMATCH: {got} != {want}")
+        return 1
+    print("bit-exact vs oracle", flush=True)
+
+    lo, hi = 2_000_000_000, 2_000_000_000 + (1 << 26) - 1
+    s.search(lo, hi)  # warm the big signature
+    t0 = time.time()
+    s.search(lo, hi)
+    dt = time.time() - t0
+    print(f"rate={(hi - lo + 1) / dt / 1e6:.1f}M nonces/s ({dt:.2f}s)",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    os._exit(rc)

@@ -104,12 +104,16 @@ def test_kernel_lowers_for_tpu_platform():
 
 
 def test_default_tier_env(monkeypatch):
+    # On this CPU test backend the platform-resolved default is jnp (the
+    # pallas tier only wins — and only runs at speed — on a real chip).
     monkeypatch.delenv("DBM_COMPUTE", raising=False)
     assert default_tier() == "jnp"
     monkeypatch.setenv("DBM_COMPUTE", "PALLAS")
     assert default_tier() == "pallas"
+    monkeypatch.setenv("DBM_COMPUTE", "JNP")
+    assert default_tier() == "jnp"
     # Searcher-level values of the shared env var are NOT tier requests:
-    # they must map to the jnp default, not crash the searcher (r3 fix).
+    # they resolve by platform (jnp off-chip), not crash the searcher.
     for v in ("auto", "jax", "host"):
         monkeypatch.setenv("DBM_COMPUTE", v)
         assert default_tier() == "jnp"
