@@ -76,10 +76,12 @@ def _rotr(x, n: int):
 def _round(a, b, c, d, e, f, g, h, kw):
     """One SHA-256 round; ``kw`` is the precombined K[t] + W[t] tile."""
     s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-    ch = (e & f) ^ (~e & g)
+    # ch/maj in their 3-op / 4-op forms (vs the definitional 4/5): the
+    # kernel is VPU-ALU-bound, so every op/round is ~0.5% end-to-end.
+    ch = g ^ (e & (f ^ g))
     t1 = h + s1 + ch + kw
     s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-    maj = (a & b) ^ (a & c) ^ (b & c)
+    maj = (a & (b ^ c)) ^ (b & c)
     return t1 + s0 + maj, a, b, c, d + t1, e, f, g
 
 
