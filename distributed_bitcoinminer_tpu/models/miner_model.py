@@ -79,10 +79,6 @@ def _digit_classes(lower: int, upper: int):
             yield d, lo, hi
 
 
-def _pow2_ceil(n: int) -> int:
-    return 1 << (n - 1).bit_length() if n > 1 else 1
-
-
 @dataclass
 class _BlockPlan:
     """One aligned 10^k block of the search, ready for device dispatch."""
@@ -141,29 +137,52 @@ class NonceSearcher:
                 yield self._plan_block(d, k, base, lo, hi)
                 base += span
 
-    def _block_geometry(self, plan: _BlockPlan,
-                        per_step: int | None = None) -> tuple[int, int]:
-        """(i0, nbatches) for a block dispatch covering [i0, hi_i].
+    def _sub_dispatches(self, plan: _BlockPlan,
+                        per_step: int | None = None) -> list[tuple[int, int]]:
+        """Descending-pow2 decomposition of one block's dispatch.
 
-        i0 is batch-aligned BELOW lo_i, so the step count must be sized from
-        i0 (not lo_i) or the top lanes of the block go unscanned; the pow2
-        rounding keeps the compile-signature set small. One helper shared by
-        every dispatch path so the sizing rule can't drift between them.
+        Returns contiguous ``(i0, nbatches)`` sub-dispatches covering
+        exactly ``ceil(span / per)`` steps, every ``nbatches`` a power of
+        two. The first ``i0`` is batch-aligned BELOW lo_i, so the step
+        count must be sized from it (not lo_i) or the top lanes of the
+        block go unscanned.
+
+        Why a decomposition instead of one rounded-up dispatch: ``nbatches``
+        is a static jit argument, so it must stay within a small value set
+        or every odd-sized range pays a fresh ~20-40 s XLA compile — but
+        rounding the count UP to one power of two (rounds 1-2) made the
+        device scan up to 2x the requested range in masked-overscan lanes.
+        The bench geometry (65 steps -> 128) ran at 222-265M nonces/s
+        while the raw kernel measured 560-630M/s (round-3 finding).
+        Splitting 65 into 64+1 keeps the pow2 signature set AND the exact
+        lane count; the <= log2(n) extra dispatches pipeline behind each
+        other, and sub-results merge in :meth:`finalize` exactly like
+        blocks do (ascending, strict-less, earliest nonce on ties).
+
+        One helper shared by every dispatch path (single-device + mesh,
+        argmin + difficulty) so the sizing rule can't drift between them.
         """
         per = per_step if per_step is not None else self.batch
         i0 = (plan.lo_i // self.batch) * self.batch
         span = plan.hi_i - i0 + 1
-        return i0, _pow2_ceil((span + per - 1) // per)
+        n = (span + per - 1) // per
+        subs = []
+        start = i0
+        while n > 0:
+            p = 1 << (n.bit_length() - 1)  # largest pow2 <= n
+            subs.append((start, p))
+            start += p * per
+            n -= p
+        return subs
 
-    def search_block(self, plan: _BlockPlan):
-        """Dispatch one block; returns (hi, lo, idx) device scalars."""
-        i0, nbatches = self._block_geometry(plan)
-        total = self.batch * nbatches
+    def search_block(self, plan: _BlockPlan) -> list:
+        """Dispatch one block as pow2 sub-dispatches; returns a list of
+        (hi, lo, idx) device-scalar triples, ascending by span."""
         if self.tier == "pallas":
             import jax
 
             from ..ops.sha256_pallas import pallas_geometry, pallas_search_span
-            rows, nsteps = pallas_geometry(total)
+
             # Off-TPU the kernel runs in the Mosaic TPU simulator
             # (pltpu.InterpretParams — seconds per grid step, bit-exact);
             # on the chip it lowers through Mosaic. devices()[0] is the
@@ -171,16 +190,21 @@ class NonceSearcher:
             # placed — so its platform (not the backend NAME, which the
             # axon plugin reports differently) is the right interpret
             # signal here; the mesh path derives it from the mesh instead.
-            return pallas_search_span(
-                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-                rem=plan.rem, k=plan.k, rows=rows, nsteps=nsteps,
-                interpret=pallas_interpret_mode(
-                    jax.devices()[0].platform))
-        return search_span(
+            interpret = pallas_interpret_mode(jax.devices()[0].platform)
+            out = []
+            for i0, nbatches in self._sub_dispatches(plan):
+                rows, nsteps = pallas_geometry(self.batch * nbatches)
+                out.append(pallas_search_span(
+                    np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                    np.uint32(i0), np.uint32(plan.lo_i),
+                    np.uint32(plan.hi_i), rem=plan.rem, k=plan.k, rows=rows,
+                    nsteps=nsteps, interpret=interpret))
+            return out
+        return [search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
             rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
+            for i0, nbatches in self._sub_dispatches(plan)]
 
     def dispatch(self, lower: int, upper: int) -> list:
         """Dispatch every block of the range WITHOUT forcing results.
@@ -194,15 +218,26 @@ class NonceSearcher:
         """
         if lower > upper:
             raise ValueError("empty range")
-        return [(plan.base, self.search_block(plan))
-                for plan in self.plan(lower, upper)]
+        return [(plan.base, triple)
+                for plan in self.plan(lower, upper)
+                for triple in self.search_block(plan)]
 
     def finalize(self, results: list, lower: int) -> tuple[int, int]:
         """Force dispatched block results and merge on host in ascending
-        order (strict less keeps the earliest nonce on ties)."""
+        order (strict less keeps the earliest nonce on ties).
+
+        ONE batched ``device_get`` fetches every triple: scalar-by-scalar
+        ``int()`` conversion cost a full device round-trip per scalar —
+        ~65 ms each over this image's axon tunnel, which capped the bench
+        at 229M nonces/s while the identical dispatch measured 420M
+        (round-3 finding).
+        """
+        import jax
+
+        fetched = jax.device_get([triple for _, triple in results])
         best_hash, best_nonce = MAX_U64, lower
         seen = False
-        for base, (hi, lo, idx) in results:
+        for (base, _), (hi, lo, idx) in zip(results, fetched):
             hi, lo, idx = int(hi), int(lo), int(idx)
             if (hi, lo) == _SENTINEL and idx == 0xFFFFFFFF:
                 continue
@@ -215,16 +250,42 @@ class NonceSearcher:
         """Exact (min_hash, argmin_nonce) over the inclusive range."""
         return self.finalize(self.dispatch(lower, upper), lower)
 
-    def _until_block(self, plan: _BlockPlan, t_hi: int, t_lo: int):
-        """Difficulty-target dispatch for one block; overridden by the
+    def _until_sub(self, plan: _BlockPlan, i0: int, nbatches: int,
+                   t_hi: int, t_lo: int):
+        """One difficulty-target sub-dispatch; overridden by the
         mesh-sharded model. Returns the 7-tuple of
         :func:`ops.search.search_span_until`."""
-        i0, nbatches = self._block_geometry(plan)
         return search_span_until(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
             np.uint32(t_hi), np.uint32(t_lo),
             rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
+
+    def _until_block(self, plan: _BlockPlan, t_hi: int, t_lo: int):
+        """Difficulty-target scan of one block: the pow2 sub-dispatches run
+        IN ORDER, forced one at a time, so the device early-exit composes
+        with a host early-exit between subs and the first qualifying nonce
+        globally is the first sub's first hit. Returns the same 7-tuple
+        shape as :func:`ops.search.search_span_until` (host ints)."""
+        import jax
+
+        sent = (*_SENTINEL, 0xFFFFFFFF)
+        best, seen = sent, False
+        for i0, nbatches in self._sub_dispatches(plan):
+            # One batched fetch per sub (see finalize: per-scalar int()
+            # costs a tunnel round-trip each).
+            found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = jax.device_get(
+                self._until_sub(plan, i0, nbatches, t_hi, t_lo))
+            trip = (int(b_hi), int(b_lo), int(b_idx))
+            # Strict lex-less on (hi, lo): subs ascend, so ties keep the
+            # earlier (lower-nonce) sub, matching finalize's rule. The
+            # ``seen`` flag (not a sentinel compare) admits a real
+            # all-ones hash, same as finalize.
+            if trip != sent and (not seen or trip[:2] < best[:2]):
+                best, seen = trip, True
+            if int(found):
+                return (1, int(f_hi), int(f_lo), int(f_idx), *best)
+        return (0, 0, 0, 0, *best)
 
     def search_until(self, lower: int, upper: int,
                      target: int) -> tuple[int, int, bool]:

@@ -31,22 +31,32 @@ class ShardedNonceSearcher(NonceSearcher):
         self.n_devices = self.mesh.devices.size
 
     def search_block(self, plan):
-        i0, nbatches = self._block_geometry(
-            plan, per_step=self.batch * self.n_devices)
-        i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
-        return sharded_search_span(
-            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-            i0_d, plan.lo_i, plan.hi_i,
-            mesh=self.mesh, rem=plan.rem, k=plan.k,
-            batch=self.batch, nbatches=nbatches, tier=self.tier)
+        """Pow2 sub-dispatches (see ``NonceSearcher._sub_dispatches``), each
+        a ``shard_map`` over the whole mesh with per-device contiguous
+        spans; returns a list of replicated (hi, lo, idx) triples."""
+        out = []
+        for i0, nbatches in self._sub_dispatches(plan):
+            i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
+            out.append(sharded_search_span(
+                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                i0_d, plan.lo_i, plan.hi_i,
+                mesh=self.mesh, rem=plan.rem, k=plan.k,
+                batch=self.batch, nbatches=nbatches, tier=self.tier))
+        return out
 
-    def _until_block(self, plan, t_hi, t_lo):
-        """Sharded difficulty-target dispatch (VERDICT r2 task 6): each
+    def _sub_dispatches(self, plan, per_step=None):
+        """Default ``per_step`` covers the whole mesh (one step = one lane
+        batch on EVERY device) — the ONE site fixing mesh granularity for
+        both the argmin and difficulty decompositions."""
+        if per_step is None:
+            per_step = self.batch * self.n_devices
+        return super()._sub_dispatches(plan, per_step=per_step)
+
+    def _until_sub(self, plan, i0, nbatches, t_hi, t_lo):
+        """Sharded difficulty-target sub-dispatch (VERDICT r2 task 6): each
         device early-exits on its own contiguous span; the collective merge
         preserves the global first-qualifying-nonce rule (see
         ``parallel.mesh_search.sharded_search_span_until``)."""
-        i0, nbatches = self._block_geometry(
-            plan, per_step=self.batch * self.n_devices)
         i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
         return sharded_search_span_until(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,

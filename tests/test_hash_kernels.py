@@ -115,3 +115,51 @@ class TestDeviceSearch:
         got = searcher.search(0, 999)
         want = scan_min("héllo wörld", 0, 999)
         assert got == want
+
+
+class TestSubDispatchDecomposition:
+    """The pow2 sub-dispatch decomposition (round 3): exact step counts —
+    the round-1/2 single rounded-up dispatch overscanned up to 2x (the
+    bench's 65-step range ran as 128 steps at half the measured rate)."""
+
+    def test_exact_pow2_descending_contiguous(self):
+        # [100, 999] is one 3-digit block; batch 64 -> i0 = 64 (aligned
+        # below lo), span 936 -> 15 steps = 8+4+2+1 exactly.
+        s = NonceSearcher("x", batch=64)
+        plan = next(s.plan(100, 999))
+        subs = s._sub_dispatches(plan)
+        sizes = [n for _, n in subs]
+        assert all(n & (n - 1) == 0 for n in sizes), sizes
+        assert sizes == [8, 4, 2, 1]
+        # contiguous: each sub starts where the previous ended
+        assert subs[0][0] == 64
+        for (i0a, na), (i0b, _) in zip(subs, subs[1:]):
+            assert i0b == i0a + na * s.batch
+
+    def test_odd_step_count_is_not_rounded_up(self):
+        # span of 5 batches decomposes 4+1, not a rounded-up 8.
+        s = NonceSearcher("x", batch=100)
+        plan = next(s.plan(100, 599))
+        assert [n for _, n in s._sub_dispatches(plan)] == [4, 1]
+
+    def test_decomposed_search_exact_vs_oracle(self):
+        # 5-batch + misaligned lo: exercises the multi-sub merge and the
+        # below-lo masked head in the same search.
+        s = NonceSearcher("decomp", batch=100)
+        assert s.search(37, 480) == scan_min("decomp", 37, 480)
+
+    def test_difficulty_mode_across_subs(self):
+        # Target reachable only in the LAST sub of a 2+1 decomposition:
+        # the host early-exit between subs must still return the globally
+        # first qualifying nonce.
+        from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op
+        s = NonceSearcher("untilsub", batch=128)
+        lo, hi = 128, 511  # one 3-digit block, 3 batches -> subs [2, 1]
+        assert [n for _, n in s._sub_dispatches(next(s.plan(lo, hi)))] == \
+            [2, 1]
+        hashes = {n: hash_op("untilsub", n) for n in range(lo, hi + 1)}
+        # pick a target hit only inside the last sub's lanes [384, 511]
+        target = min(h for n, h in hashes.items() if n >= 384) + 1
+        first = next(n for n in range(lo, hi + 1) if hashes[n] < target)
+        h, n, found = s.search_until(lo, hi, target)
+        assert (found, n, h) == (True, first, hashes[first])
