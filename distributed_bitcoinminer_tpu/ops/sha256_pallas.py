@@ -51,9 +51,11 @@ _MAX_U32 = np.uint32(0xFFFFFFFF)
 _LANES = 128
 #: scal layout: [i0, lo, hi] ++ midstate(8) ++ template(nblocks*16) ++ K(64)
 _TMPL_OFF = 11
-#: Sublane cap per grid step: 32 x 128-lane tiles keeps the ~26 live
-#: (rows, 128) uint32 carries of the compression loop well under VMEM.
-_ROWS_MAX = 32
+#: Sublane cap per grid step. Swept on-chip through the searcher at 2^26
+#: lanes (round 3): 8 -> 544, 16 -> 576, 32 -> 562, 64 -> 544 M nonces/s;
+#: 16 rows (2 vregs per carried tile, ~54 live vregs) balances register
+#: pressure against per-step overhead best.
+_ROWS_MAX = 16
 
 
 def pallas_geometry(total: int) -> tuple[int, int]:
