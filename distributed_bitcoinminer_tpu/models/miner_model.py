@@ -53,18 +53,20 @@ def default_tier() -> str:
 def pallas_interpret_mode(platform: str | None = None) -> bool:
     """Pallas runs in interpret mode off-TPU (tests on the CPU mesh).
 
-    ``platform`` should be the platform of the devices the kernel will
-    actually run on (e.g. ``mesh.devices.flat[0].platform``) whenever the
-    caller knows it: ``jax.default_backend()`` is only a fallback, and a
-    wrong one under this image's sitecustomize — with ``JAX_PLATFORMS=cpu``
-    set purely as an env var the default backend still resolves to the
-    axon TPU plugin while the devices in play are CPU, which round 3
-    caught as a real-lowering attempt on the CPU mesh ("Only interpret
-    mode is supported on CPU backend")."""
+    Thin shim over :func:`ops.sha256_pallas.interpret_on` (the one
+    authoritative platform rule) adding a ``jax.default_backend()``
+    fallback for callers with no better signal. Prefer passing the
+    platform of the devices the kernel will actually run on — the
+    fallback is wrong under this image's sitecustomize: with
+    ``JAX_PLATFORMS=cpu`` set purely as an env var the default backend
+    still resolves to the axon TPU plugin while the devices in play are
+    CPU, which round 3 caught as a real-lowering attempt on the CPU mesh
+    ("Only interpret mode is supported on CPU backend")."""
+    from ..ops.sha256_pallas import interpret_on
     if platform is None:
         import jax
         platform = jax.default_backend()
-    return platform not in ("tpu", "axon")
+    return interpret_on(platform)
 
 
 def _digit_classes(lower: int, upper: int):
@@ -179,27 +181,19 @@ class NonceSearcher:
         """Dispatch one block as pow2 sub-dispatches; returns a list of
         (hi, lo, idx) device-scalar triples, ascending by span."""
         if self.tier == "pallas":
-            import jax
+            from ..ops.sha256_pallas import pallas_argmin
 
-            from ..ops.sha256_pallas import pallas_geometry, pallas_search_span
-
-            # Off-TPU the kernel runs in the Mosaic TPU simulator
-            # (pltpu.InterpretParams — seconds per grid step, bit-exact);
-            # on the chip it lowers through Mosaic. devices()[0] is the
-            # default device — exactly where this un-sharded call will be
-            # placed — so its platform (not the backend NAME, which the
-            # axon plugin reports differently) is the right interpret
-            # signal here; the mesh path derives it from the mesh instead.
-            interpret = pallas_interpret_mode(jax.devices()[0].platform)
-            out = []
-            for i0, nbatches in self._sub_dispatches(plan):
-                rows, nsteps = pallas_geometry(self.batch * nbatches)
-                out.append(pallas_search_span(
-                    np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                    np.uint32(i0), np.uint32(plan.lo_i),
-                    np.uint32(plan.hi_i), rem=plan.rem, k=plan.k, rows=rows,
-                    nsteps=nsteps, interpret=interpret))
-            return out
+            # devices()[0] is the default device — exactly where this
+            # un-sharded call will be placed — so its platform is the
+            # right interpret signal here (the mesh path derives it from
+            # the mesh instead); off-TPU the kernel runs in the Mosaic
+            # TPU simulator, on the chip it lowers through Mosaic.
+            return [pallas_argmin(
+                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+                rem=plan.rem, k=plan.k, total=self.batch * nbatches,
+                platform=self._platform())
+                for i0, nbatches in self._sub_dispatches(plan)]
         return [search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
@@ -253,8 +247,20 @@ class NonceSearcher:
     def _until_sub(self, plan: _BlockPlan, i0: int, nbatches: int,
                    t_hi: int, t_lo: int):
         """One difficulty-target sub-dispatch; overridden by the
-        mesh-sharded model. Returns the 7-tuple of
-        :func:`ops.search.search_span_until`."""
+        mesh-sharded model. Returns the 5-tuple
+        ``(found, f_idx, best_hi, best_lo, best_idx)`` of
+        :func:`ops.search.search_span_until` (the qualifying HASH is
+        recomputed by ``_until_block`` with the host oracle — one shared
+        contract for both tiers)."""
+        if self.tier == "pallas":
+            from ..ops.sha256_pallas import pallas_until
+
+            return pallas_until(
+                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+                np.uint32(t_hi), np.uint32(t_lo),
+                rem=plan.rem, k=plan.k, total=self.batch * nbatches,
+                platform=self._platform())
         return search_span_until(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
@@ -265,8 +271,12 @@ class NonceSearcher:
         """Difficulty-target scan of one block: the pow2 sub-dispatches run
         IN ORDER, forced one at a time, so the device early-exit composes
         with a host early-exit between subs and the first qualifying nonce
-        globally is the first sub's first hit. Returns the same 7-tuple
-        shape as :func:`ops.search.search_span_until` (host ints)."""
+        globally is the first sub's first hit. Returns host ints
+        ``(found, f_hash, f_idx, best_hi, best_lo, best_idx)`` — f_hash is
+        recomputed from the host oracle (the device tiers report only the
+        qualifying INDEX: a pallas grid has no per-batch early exit, so
+        carrying hash accumulators buys nothing, and one host sha256 is
+        exact and free at this frequency)."""
         import jax
 
         sent = (*_SENTINEL, 0xFFFFFFFF)
@@ -274,7 +284,7 @@ class NonceSearcher:
         for i0, nbatches in self._sub_dispatches(plan):
             # One batched fetch per sub (see finalize: per-scalar int()
             # costs a tunnel round-trip each).
-            found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = jax.device_get(
+            found, f_idx, b_hi, b_lo, b_idx = jax.device_get(
                 self._until_sub(plan, i0, nbatches, t_hi, t_lo))
             trip = (int(b_hi), int(b_lo), int(b_idx))
             # Strict lex-less on (hi, lo): subs ascend, so ties keep the
@@ -284,8 +294,16 @@ class NonceSearcher:
             if trip != sent and (not seen or trip[:2] < best[:2]):
                 best, seen = trip, True
             if int(found):
-                return (1, int(f_hi), int(f_lo), int(f_idx), *best)
-        return (0, 0, 0, 0, *best)
+                from ..bitcoin.hash import hash_op
+                h = hash_op(self.data, plan.base + int(f_idx))
+                return (1, h, int(f_idx), *best)
+        return (0, 0, 0, *best)
+
+    def _platform(self) -> str:
+        """Platform of the default device — where un-sharded dispatches
+        are placed (the mesh model reads its mesh instead)."""
+        import jax
+        return jax.devices()[0].platform
 
     def search_until(self, lower: int, upper: int,
                      target: int) -> tuple[int, int, bool]:
@@ -301,11 +319,10 @@ class NonceSearcher:
         t_hi, t_lo = target >> 32, target & 0xFFFFFFFF
         best_hash, best_nonce, seen = MAX_U64, lower, False
         for plan in self.plan(lower, upper):
-            found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = \
+            found, f_hash, f_idx, b_hi, b_lo, b_idx = \
                 self._until_block(plan, t_hi, t_lo)
             if int(found):
-                return ((int(f_hi) << 32) | int(f_lo),
-                        plan.base + int(f_idx), True)
+                return (f_hash, plan.base + int(f_idx), True)
             hi, lo, idx = int(b_hi), int(b_lo), int(b_idx)
             if (hi, lo, idx) != (*_SENTINEL, 0xFFFFFFFF):
                 h = (hi << 32) | lo

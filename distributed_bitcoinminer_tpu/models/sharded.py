@@ -62,4 +62,4 @@ class ShardedNonceSearcher(NonceSearcher):
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo,
             mesh=self.mesh, rem=plan.rem, k=plan.k,
-            batch=self.batch, nbatches=nbatches)
+            batch=self.batch, nbatches=nbatches, tier=self.tier)

@@ -100,10 +100,14 @@ def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
     A ``while_loop`` walks the span in ascending lane batches and exits as
     soon as a batch contains a qualifying hash — the in-kernel early-exit of
     the difficulty-target mode. Returns uint32 scalars
-    ``(found, f_hi, f_lo, f_idx, best_hi, best_lo, best_idx)``: the FIRST
-    (lowest-nonce) qualifying hash when ``found`` is 1, plus the running
-    argmin over all scanned lanes either way (the fallback result when the
-    whole span misses the target).
+    ``(found, f_idx, best_hi, best_lo, best_idx)``: the FIRST (lowest)
+    qualifying nonce index when ``found`` is 1, plus the running argmin
+    over all scanned lanes either way (the fallback result when the whole
+    span misses the target). The qualifying HASH is deliberately not
+    returned — the model layer recomputes that one value with the host
+    oracle (models.miner_model._until_block), which keeps this contract
+    identical to the pallas tier's and drops two per-batch reductions
+    from the loop.
 
     Shared by the jitted single-device entry point and the shard_map
     per-device body (``parallel/mesh_search.py``), which passes its mesh
@@ -113,11 +117,11 @@ def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
     lane = jnp.arange(batch, dtype=jnp.uint32)
 
     def cond(carry):
-        j, f_idx, _f_hi, _f_lo, _best = carry
+        j, f_idx, _best = carry
         return (j < nbatches) & (f_idx == _MAX_U32)
 
     def body(carry):
-        j, f_idx, f_hi, f_lo, best = carry
+        j, f_idx, best = carry
         i = i0 + j.astype(jnp.uint32) * np.uint32(batch) + lane
         hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
                                  vary_axes=vary_axes)
@@ -136,19 +140,15 @@ def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
         qual = valid & ((hi_h < target_hi)
                         | ((hi_h == target_hi) & (lo_h < target_lo)))
         q_idx = jnp.min(jnp.where(qual, i, _MAX_U32))
-        hit = qual & (i == q_idx)
-        q_hi = jnp.min(jnp.where(hit, hi_h, _MAX_U32))
-        q_lo = jnp.min(jnp.where(hit, lo_h, _MAX_U32))
-        return (j + 1, q_idx, q_hi, q_lo, best)
+        return (j + 1, q_idx, best)
 
-    init = (jnp.int32(0), jnp.uint32(_MAX_U32), jnp.uint32(_MAX_U32),
-            jnp.uint32(_MAX_U32),
+    init = (jnp.int32(0), jnp.uint32(_MAX_U32),
             (jnp.uint32(_MAX_U32),) * 3)
     if vary_axes:
         init = jax.tree.map(lambda x: ensure_varying(x, vary_axes), init)
-    j, f_idx, f_hi, f_lo, best = jax.lax.while_loop(cond, body, init)
+    j, f_idx, best = jax.lax.while_loop(cond, body, init)
     found = (f_idx != _MAX_U32).astype(jnp.uint32)
-    return found, f_hi, f_lo, f_idx, best[0], best[1], best[2]
+    return found, f_idx, best[0], best[1], best[2]
 
 
 @functools.partial(jax.jit,

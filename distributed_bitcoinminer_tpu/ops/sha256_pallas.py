@@ -58,6 +58,37 @@ _TMPL_OFF = 11
 _ROWS_MAX = 16
 
 
+def interpret_on(platform: str) -> bool:
+    """Interpret (Mosaic TPU simulator) iff ``platform`` is not a real
+    chip. ``platform`` must describe the devices the kernel actually runs
+    on (``mesh.devices.flat[0].platform`` / ``jax.devices()[0].platform``)
+    — NOT ``jax.default_backend()``, which this image's sitecustomize can
+    pin to the axon plugin while the devices in play are CPU."""
+    return platform not in ("tpu", "axon")
+
+
+def pallas_argmin(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
+                  total: int, platform: str, vma: tuple = ()):
+    """THE dispatch wrapper for the argmin kernel: geometry + interpret
+    flag derived in one place for every call site (single-device and mesh
+    — the two drifted once in round 2)."""
+    rows, nsteps = pallas_geometry(total)
+    return pallas_search_span(
+        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        nsteps=nsteps, interpret=interpret_on(platform), vma=vma)
+
+
+def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
+                 rem: int, k: int, total: int, platform: str,
+                 vma: tuple = ()):
+    """Dispatch wrapper for the difficulty-target kernel (see
+    :func:`pallas_argmin`)."""
+    rows, nsteps = pallas_geometry(total)
+    return pallas_search_span_until(
+        midstate, template, i0, lo_i, hi_i, t_hi, t_lo, rem=rem, k=k,
+        rows=rows, nsteps=nsteps, interpret=interpret_on(platform), vma=vma)
+
+
 def pallas_geometry(total: int) -> tuple[int, int]:
     """(rows, nsteps) for a dispatch covering ``total`` lanes.
 
@@ -87,8 +118,8 @@ def _round(a, b, c, d, e, f, g, h, kw):
     return t1 + s0 + maj, a, b, c, d + t1, e, f, g
 
 
-def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *, rem: int, k: int,
-            nblocks: int, rows: int):
+def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
+            nblocks: int, rows: int, until: bool = False):
     step = pl.program_id(0)
     i0 = scal_ref[0]
     lo = scal_ref[1]
@@ -172,12 +203,25 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *, rem: int, k: int,
     hi_h = jnp.where(valid, a, _MAX_U32)
     lo_h = jnp.where(valid, b, _MAX_U32)
     idx = jnp.where(valid, i, _MAX_U32)
+    if until:
+        # Difficulty-target accumulator: per lane position, the minimum
+        # (= first, since idx ascends with step) index whose hash beats
+        # the 64-bit target (appended after the K table in scal).
+        # Sentinel-masked lanes carry (MAX, MAX) which never qualifies
+        # under strict lex-less.
+        f_ref, = extra_refs
+        t_hi = scal_ref[koff + 64]
+        t_lo = scal_ref[koff + 65]
+        qual = (hi_h < t_hi) | ((hi_h == t_hi) & (lo_h < t_lo))
+        f_q = jnp.where(qual, idx, _MAX_U32)
 
     @pl.when(step == 0)
     def _init():
         hi_ref[...] = hi_h
         lo_ref[...] = lo_h
         idx_ref[...] = idx
+        if until:
+            f_ref[...] = f_q
 
     @pl.when(step != 0)
     def _merge():
@@ -191,6 +235,11 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *, rem: int, k: int,
         hi_ref[...] = jnp.where(take, hi_h, p_hi)
         lo_ref[...] = jnp.where(take, lo_h, p_lo)
         idx_ref[...] = jnp.where(take, idx, p_idx)
+        if until:
+            # compare+select, not jnp.minimum: Mosaic has no legalization
+            # for vector arith.minui (round-3 on-chip failure).
+            p_f = f_ref[...]
+            f_ref[...] = jnp.where(f_q < p_f, f_q, p_f)
 
 
 @functools.partial(
@@ -215,13 +264,56 @@ def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
     inputs, shard_map's vma checker requires the pallas outputs to declare
     which mesh axes they vary over.
     """
+    outs = _run_kernel(midstate, template, i0, lo_i, hi_i, rem=rem, k=k,
+                       rows=rows, nsteps=nsteps, interpret=interpret,
+                       vma=vma)
+    hi_h, lo_h, idx = outs
+    return lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rem", "k", "rows", "nsteps", "interpret", "vma"))
+def pallas_search_span_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
+                             *, rem: int, k: int, rows: int, nsteps: int,
+                             interpret: bool = False, vma: tuple = ()):
+    """Difficulty-target span scan on the Mosaic kernel.
+
+    Same lane coverage as :func:`pallas_search_span` plus a 4th in-VMEM
+    accumulator holding, per lane position, the first (minimum) index
+    whose hash is lex-less than the 64-bit target ``(t_hi, t_lo)``.
+
+    Returns uint32 scalars ``(found, f_idx, best_hi, best_lo, best_idx)``
+    — no qualifying HASH: a grid has no early exit, so the caller scans
+    whole sub-dispatches anyway and recomputes the one qualifying hash
+    with the host oracle (one sha256). Device early-exit granularity is
+    the sub-dispatch, vs the jnp tier's per-batch ``while_loop``; the
+    first-qualifying-nonce CONTRACT is identical because sub-dispatches
+    are forced in ascending order (models.miner_model._until_block).
+    """
+    hi_h, lo_h, idx, f = _run_kernel(
+        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        nsteps=nsteps, interpret=interpret, vma=vma, target=(t_hi, t_lo))
+    f_idx = jnp.min(f.ravel())
+    found = (f_idx != _MAX_U32).astype(jnp.uint32)
+    b_hi, b_lo, b_idx = lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
+    return found, f_idx, b_hi, b_lo, b_idx
+
+
+def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
+                interpret, vma, target=None):
+    """Shared pallas_call builder for the argmin and difficulty variants."""
     midstate = jnp.asarray(midstate, dtype=jnp.uint32).reshape(8)
     template = jnp.asarray(template, dtype=jnp.uint32)
     nblocks = template.shape[0]
-    scal = jnp.concatenate([
+    parts = [
         jnp.asarray([i0, lo_i, hi_i], dtype=jnp.uint32),
         midstate, template.reshape(-1),
-        jnp.asarray(SHA256_K, dtype=jnp.uint32)])
+        jnp.asarray(SHA256_K, dtype=jnp.uint32)]
+    if target is not None:
+        parts.append(jnp.stack([jnp.asarray(t, dtype=jnp.uint32)
+                                for t in target]))
+    scal = jnp.concatenate(parts)
 
     # Accumulator BlockSpec = the whole (rows, 128) array with a constant
     # index map: always Mosaic-legal, and the revisited block stays resident
@@ -231,16 +323,17 @@ def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
     acc_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32,
                                      **({"vma": frozenset(vma)} if vma
                                         else {}))
+    n_out = 3 if target is None else 4
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nsteps,),
         in_specs=[],
-        out_specs=(acc_spec, acc_spec, acc_spec),
+        out_specs=(acc_spec,) * n_out,
     )
-    hi_h, lo_h, idx = pl.pallas_call(
-        functools.partial(_kernel, rem=rem, k=k, nblocks=nblocks, rows=rows),
-        out_shape=(acc_shape, acc_shape, acc_shape),
+    return pl.pallas_call(
+        functools.partial(_kernel, rem=rem, k=k, nblocks=nblocks, rows=rows,
+                          until=target is not None),
+        out_shape=(acc_shape,) * n_out,
         grid_spec=grid_spec,
         interpret=pltpu.InterpretParams() if interpret else False,
     )(scal)
-    return lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())

@@ -73,24 +73,20 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
         in_specs=(P(), P(), P(AXIS), P(), P()),
         out_specs=(P(), P(), P()))
     def body(midstate, template, i0, lo_i, hi_i):
-        total = batch * nbatches
-        from ..models.miner_model import pallas_interpret_mode
-        # Interpret iff the MESH devices are CPU — not the default backend,
-        # which this image's sitecustomize can pin to the axon TPU plugin
-        # even when the mesh in play is the virtual CPU one.
-        mesh_platform = mesh.devices.flat[0].platform
         # The pallas tier runs everywhere since round 3: through Mosaic on
         # the chip, through the Mosaic TPU simulator (InterpretParams) on
-        # the CPU test mesh. The out ShapeDtypeStructs carry vma=(AXIS,) so
-        # shard_map's varying-axis checker accepts the varying span starts.
+        # the CPU test mesh — the wrapper derives interpret mode from the
+        # MESH devices' platform, not the default backend (which this
+        # image's sitecustomize can pin to the axon TPU plugin even when
+        # the mesh in play is the virtual CPU one). The out
+        # ShapeDtypeStructs carry vma=(AXIS,) so shard_map's varying-axis
+        # checker accepts the varying span starts.
         if tier == "pallas":
-            from ..ops.sha256_pallas import (pallas_geometry,
-                                             pallas_search_span)
-            rows, nsteps = pallas_geometry(total)
-            hi_h, lo_h, idx = pallas_search_span(
+            from ..ops.sha256_pallas import pallas_argmin
+            hi_h, lo_h, idx = pallas_argmin(
                 midstate, template, i0[0], lo_i, hi_i,
-                rem=rem, k=k, rows=rows, nsteps=nsteps,
-                interpret=pallas_interpret_mode(mesh_platform), vma=(AXIS,))
+                rem=rem, k=k, total=batch * nbatches,
+                platform=mesh.devices.flat[0].platform, vma=(AXIS,))
         else:
             hi_h, lo_h, idx = span_scan_body(
                 midstate, template, i0[0], lo_i, hi_i,
@@ -112,25 +108,30 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "rem", "k", "batch", "nbatches"))
+    static_argnames=("mesh", "rem", "k", "batch", "nbatches", "tier"))
 def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
                               target_hi, target_lo, *, mesh: Mesh, rem: int,
-                              k: int, batch: int, nbatches: int):
+                              k: int, batch: int, nbatches: int,
+                              tier: str = "jnp"):
     """Difficulty-target scan over ``n`` disjoint per-device spans.
 
-    Each device runs the early-exiting :func:`span_until_body` on its own
-    contiguous span (the ``while_loop`` predicate is device-varying, so a
-    device stops at ITS first qualifying batch independently — no
-    collectives ride inside the loop). The merge preserves the
-    first-qualifying-nonce rule globally: spans are contiguous and
-    disjoint and each device's hit is the minimal qualifying nonce of its
-    span, so the global first hit is the ``pmin`` of the per-device hit
-    indices; the fallback argmin merges exactly like
-    :func:`sharded_search_span`.
+    Each device scans its own contiguous span — the jnp tier with the
+    early-exiting :func:`span_until_body` (the ``while_loop`` predicate is
+    device-varying, so a device stops at ITS first qualifying batch
+    independently; no collectives ride inside the loop), the pallas tier
+    with the Mosaic kernel's qualifying-index accumulator (whole-span
+    scan; callers early-exit between sub-dispatches instead). The merge
+    preserves the first-qualifying-nonce rule globally: spans are
+    contiguous and disjoint and each device's hit is the minimal
+    qualifying nonce of its span, so the global first hit is the ``pmin``
+    of the per-device hit indices; the fallback argmin merges exactly
+    like :func:`sharded_search_span`.
 
     Returns replicated uint32 scalars
-    ``(found, f_hi, f_lo, f_idx, best_hi, best_lo, best_idx)`` with the
-    same contract as :func:`ops.search.search_span_until`.
+    ``(found, f_idx, best_hi, best_lo, best_idx)`` with the same contract
+    as :func:`ops.search.search_span_until` (the qualifying HASH is
+    recomputed by the model layer from the host oracle when ``found`` —
+    models.miner_model._until_block).
     """
     midstate = jnp.asarray(midstate, dtype=jnp.uint32)
     template = jnp.asarray(template, dtype=jnp.uint32)
@@ -138,21 +139,23 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(), P(), P(AXIS), P(), P(), P(), P()),
-        out_specs=(P(),) * 7)
+        out_specs=(P(),) * 5)
     def body(midstate, template, i0, lo_i, hi_i, t_hi, t_lo):
-        found, f_hi, f_lo, f_idx, b_hi, b_lo, b_idx = span_until_body(
-            midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
-            rem=rem, k=k, batch=batch, nbatches=nbatches,
-            vary_axes=(AXIS,))
+        if tier == "pallas":
+            from ..ops.sha256_pallas import pallas_until
+            found, f_idx, b_hi, b_lo, b_idx = pallas_until(
+                midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
+                rem=rem, k=k, total=batch * nbatches,
+                platform=mesh.devices.flat[0].platform, vma=(AXIS,))
+        else:
+            found, f_idx, b_hi, b_lo, b_idx = span_until_body(
+                midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
+                rem=rem, k=k, batch=batch, nbatches=nbatches,
+                vary_axes=(AXIS,))
         # First qualifying nonce globally = min of per-device first hits
         # (disjoint ascending spans; non-hit devices carry the MAX
-        # sentinel). Its (hi, lo) pair is selected with the same staged
-        # pmin trick as the argmin merge.
+        # sentinel).
         g_idx = jax.lax.pmin(f_idx, AXIS)
-        g_hi = jax.lax.pmin(jnp.where(f_idx == g_idx, f_hi, _MAX_U32), AXIS)
-        g_lo = jax.lax.pmin(
-            jnp.where((f_idx == g_idx) & (f_hi == g_hi), f_lo, _MAX_U32),
-            AXIS)
         g_found = jax.lax.pmax(found, AXIS)
         # Fallback exact argmin across devices (used only when no device
         # hit, in which case every device scanned its full span).
@@ -162,7 +165,7 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
         idx_m = jnp.where((b_hi == min_hi) & (b_lo == min_lo), b_idx,
                           _MAX_U32)
         min_idx = jax.lax.pmin(idx_m, AXIS)
-        return g_found, g_hi, g_lo, g_idx, min_hi, min_lo, min_idx
+        return g_found, g_idx, min_hi, min_lo, min_idx
 
     return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
                 jnp.uint32(lo_i), jnp.uint32(hi_i),

@@ -121,3 +121,21 @@ def test_default_tier_env(monkeypatch):
     monkeypatch.setenv("DBM_COMPUTE", "bogus")
     with pytest.raises(ValueError):
         NonceSearcher("x", batch=128)
+
+
+def test_until_kernel_first_qualifying_vs_oracle():
+    """Difficulty mode on the Mosaic kernel (interpret): the 4th
+    accumulator must yield the FIRST qualifying nonce, not the argmin,
+    across a multi-step grid; the fallback argmin must match the plain
+    kernel when nothing qualifies."""
+    from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op, scan_min
+    data, lo, hi = "untilpal", 128, 511   # one 3-digit block, 3 batches
+    s = NonceSearcher(data, batch=128, tier="pallas")
+    hashes = {n: hash_op(data, n) for n in range(lo, hi + 1)}
+    # target reachable only in the last sub-dispatch's lanes
+    target = min(h for n, h in hashes.items() if n >= 384) + 1
+    first = next(n for n in range(lo, hi + 1) if hashes[n] < target)
+    assert s.search_until(lo, hi, target) == (hashes[first], first, True)
+    # unreachable target -> exact argmin fallback, found=False
+    wh, wn = scan_min(data, lo, hi)
+    assert s.search_until(lo, hi, min(hashes.values())) == (wh, wn, False)

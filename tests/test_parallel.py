@@ -90,3 +90,25 @@ def test_unaligned_window_top_lanes_covered(mesh):
     assert sharded.search(1357, 1868) == scan_min(data, 1357, 1868)
     single = NonceSearcher(data, batch=64)
     assert single.search(1001, 1064) == scan_min(data, 1001, 1064)
+
+
+def test_sharded_until_pallas_tier_matches_oracle():
+    """Sharded difficulty mode through the Mosaic kernel (simulator on the
+    CPU mesh): first-qualifying merge = pmin of per-device hit indices."""
+    import jax
+
+    from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op, scan_min
+    from distributed_bitcoinminer_tpu.models import ShardedNonceSearcher
+    from distributed_bitcoinminer_tpu.parallel import make_mesh
+
+    mesh = make_mesh(4, jax.devices("cpu"))
+    data = "shardun"
+    s = ShardedNonceSearcher(data, batch=128, mesh=mesh, tier="pallas")
+    lo, hi = 1000, 1000 + 128 * 4 - 1
+    hashes = {n: hash_op(data, n) for n in range(lo, hi + 1)}
+    # hit only on the LAST device's span
+    target = min(h for n, h in hashes.items() if n >= lo + 128 * 3) + 1
+    first = next(n for n in range(lo, hi + 1) if hashes[n] < target)
+    assert s.search_until(lo, hi, target) == (hashes[first], first, True)
+    wh, wn = scan_min(data, lo, hi)
+    assert s.search_until(lo, hi, min(hashes.values())) == (wh, wn, False)
