@@ -163,3 +163,16 @@ class TestSubDispatchDecomposition:
         first = next(n for n in range(lo, hi + 1) if hashes[n] < target)
         h, n, found = s.search_until(lo, hi, target)
         assert (found, n, h) == (True, first, hashes[first])
+
+
+def test_dispatch_finalize_overlap_api():
+    """The host<->device overlap API (SURVEY §7 double-buffering): several
+    ranges enqueued before any result is forced must finalize to exactly
+    the per-range sequential results, in any finalize order."""
+    s = NonceSearcher("overlap", batch=256)
+    ranges = [(0, 999), (1000, 2999), (100, 2047)]
+    want = [s.search(lo, hi) for lo, hi in ranges]
+    handles = [(s.dispatch(lo, hi), lo) for lo, hi in ranges]
+    got = {i: s.finalize(h, lo) for i, (h, lo) in
+           reversed(list(enumerate(handles)))}
+    assert [got[i] for i in range(len(ranges))] == want
