@@ -63,10 +63,10 @@ def default_searcher_factory(data: str, batch: Optional[int] = None,
 
     from ..models import NonceSearcher, ShardedNonceSearcher
     from ..parallel import make_mesh
-    from ..utils.config import apply_jax_platform_env
+    from ..utils.config import apply_jax_platform_env, jax_devices_robust
 
     apply_jax_platform_env()
-    devices = jax.devices()
+    devices = jax_devices_robust()
     if batch is None:
         batch = (1 << 20) if devices[0].platform != "cpu" else (1 << 12)
     if len(devices) > 1:
@@ -118,12 +118,20 @@ class MinerWorker:
                 best_hash, best_nonce = await asyncio.to_thread(
                     self._search, msg.data, msg.lower, msg.upper)
             except Exception:
-                # A compute failure must not kill the worker (the scheduler
-                # would reassign the same poisoned chunk pool-wide); answer
-                # with the empty-scan sentinel instead.
-                logger.exception("search failed for %r [%d, %d]",
+                # A broken worker must LEAVE the pool — exit so the
+                # scheduler declares the connection lost and reassigns
+                # this exact chunk (ref: the Go miner exits silently on
+                # any failure, miner.go:44-50; recovery = chunk
+                # re-execution, SURVEY §3.4). Round 3 replaced the old
+                # answer-with-sentinel behavior here: a fabricated
+                # (MAX_U64, 0) Result is indistinguishable from a real
+                # empty scan and handed single-miner clients garbage (the
+                # e2e caught exactly that when the device backend failed
+                # to init in the miner process).
+                logger.exception("search failed for %r [%d, %d]; exiting",
                                  msg.data, msg.lower, msg.upper)
-                best_hash, best_nonce = MAX_U64, 0
+                await self.client.close()
+                return
             try:
                 self.client.write(new_result(best_hash, best_nonce).to_json())
             except LspError:

@@ -44,8 +44,8 @@ def default_tier() -> str:
     search.)"""
     value = os.environ.get("DBM_COMPUTE", "auto").lower()
     if value in ("", "auto", "jax", "host"):
-        import jax
-        on_chip = jax.devices()[0].platform in ("tpu", "axon")
+        from ..utils.config import jax_devices_robust
+        on_chip = jax_devices_robust()[0].platform in ("tpu", "axon")
         return "pallas" if on_chip else "jnp"
     return value  # 'jnp'/'pallas', or unknown -> NonceSearcher raises
 
@@ -302,8 +302,8 @@ class NonceSearcher:
     def _platform(self) -> str:
         """Platform of the default device — where un-sharded dispatches
         are placed (the mesh model reads its mesh instead)."""
-        import jax
-        return jax.devices()[0].platform
+        from ..utils.config import jax_devices_robust
+        return jax_devices_robust()[0].platform
 
     def search_until(self, lower: int, upper: int,
                      target: int) -> tuple[int, int, bool]:

@@ -63,6 +63,33 @@ def apply_jax_platform_env() -> None:
         jax.config.update("jax_platforms", plats)
 
 
+def jax_devices_robust():
+    """``jax.devices()`` with a fallback to automatic platform selection.
+
+    A pinned ``jax_platforms`` naming a platform that cannot initialize
+    in THIS process — e.g. ``JAX_PLATFORMS=axon`` inherited from the
+    image environment by a miner launched from a directory where the
+    axon plugin registers its platform under a different name — made the
+    round-3 e2e miner crash on first use. Falling back to "" resolves
+    whatever the plugin actually registered. Deliberately NOT probed
+    inside :func:`apply_jax_platform_env`: an eager ``jax.devices()``
+    there initializes backends before ``jax.distributed.initialize`` and
+    breaks the multi-host pod path.
+    """
+    import jax
+    try:
+        return jax.devices()
+    except RuntimeError as exc:
+        import logging
+        logging.getLogger("dbm.config").warning(
+            "pinned jax_platforms=%r failed to initialize (%s); falling "
+            "back to automatic platform selection — if the pin existed to "
+            "avoid a wedged device, that protection is gone for this "
+            "process", jax.config.jax_platforms, exc)
+        jax.config.update("jax_platforms", "")
+        return jax.devices()
+
+
 def host_cache_dir(root: str) -> str:
     """Host-fingerprinted JAX persistent-cache path under ``root`` (see
     :func:`host_fingerprint` for why the key exists)."""

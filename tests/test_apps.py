@@ -244,3 +244,28 @@ def test_empty_range_request_does_not_wedge_scheduler():
 def test_printable_result_contract():
     assert printable_result((123, 45)) == "Result 123 45"
     assert printable_result(None) == "Disconnected"
+
+
+def test_broken_miner_exits_and_chunk_is_reassigned():
+    """A compute failure must REMOVE the worker from the pool — never
+    fabricate a Result: round 3's on-chip e2e caught a miner whose device
+    backend failed to init answering with the (MAX_U64, 0) sentinel,
+    handing a single-miner client garbage. The failing miner exits (ref:
+    the Go miner exits silently on any failure, miner.go:44-50), the
+    scheduler detects the drop, and the chunk re-executes on a healthy
+    miner."""
+    class Poisoned:
+        def __init__(self, data):
+            self.data = data
+
+        def search(self, lower, upper):
+            raise RuntimeError("device backend failed to init")
+
+    async def scenario():
+        async with Cluster(fast_params()) as c:
+            await c.start_miner(factory=lambda data, batch: Poisoned(data))
+            await c.start_miner()   # healthy oracle miner
+            result = await asyncio.wait_for(
+                submit(c.hostport, "poison", 900, c.params), 30)
+            assert result == expected("poison", 900)
+    asyncio.run(scenario())

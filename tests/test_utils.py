@@ -1,6 +1,7 @@
 """Config/logging/profiling utilities."""
 
 import logging
+import os
 
 from distributed_bitcoinminer_tpu.lsp.params import Params
 from distributed_bitcoinminer_tpu.utils import (
@@ -62,3 +63,26 @@ def test_device_trace_writes_profile(tmp_path):
         jnp.arange(16).sum().block_until_ready()
     dumped = list(logdir.rglob("*"))
     assert dumped, "profiler trace produced no files"
+
+
+def test_apply_jax_platform_env_falls_back_on_bad_platform():
+    """JAX_PLATFORMS naming a platform that cannot initialize in THIS
+    process (e.g. the image-wide JAX_PLATFORMS=axon reaching a miner
+    launched from a directory where the axon plugin registers under a
+    different name — the round-3 e2e failure) must fall back to automatic
+    selection instead of crashing every later jax.devices()."""
+    import subprocess
+    import sys
+
+    code = (
+        "from distributed_bitcoinminer_tpu.utils.config import "
+        "apply_jax_platform_env, jax_devices_robust\n"
+        "apply_jax_platform_env()\n"
+        "print('devices-ok', len(jax_devices_robust()) > 0)\n")
+    env = {**os.environ, "JAX_PLATFORMS": "nonexistent_backend",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "devices-ok True" in proc.stdout
