@@ -149,12 +149,16 @@ def main() -> int:
     # One digit class, one aligned 10^9 block geometry => ONE compile
     # signature for the whole measurement (VERDICT round-1 weakness 5: the
     # old [0, 2^26) range spanned 8 digit classes = 8 compilations).
-    # 2^28 per search: every jit invocation costs ~34 ms of axon-tunnel
+    # 2^29 per search: every jit invocation costs ~34 ms of axon-tunnel
     # enqueue regardless of span, so short ranges under-report the kernel
-    # (round 3: 2^26 measured 863M/s overlapped where 2^28 measures 1.28G);
-    # production miner chunks are larger still.
+    # (round 3: 2^26 measured 863M/s overlapped where 2^29 measures
+    # 1.32G); production miner chunks are larger still. 2^29 is the
+    # largest span that stays inside one aligned 10^9 block from this
+    # lower bound AND decomposes to a single pow2 sub-dispatch (512
+    # batches) = one compile signature; 2^30 would straddle a block
+    # boundary and warm ~10 signatures.
     lower = 2_000_000_000 if on_accel else 100_000
-    count = (1 << 28) if on_accel else (1 << 17)
+    count = (1 << 29) if on_accel else (1 << 17)
     upper = lower + count - 1
     min_time_s = 1.0 if on_accel else 0.5
     data = "cmu440"
