@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Full-stack end-to-end on the real chip: scheduler + TPU miner + client.
+
+The flagship demo as one command — three OS processes over wire-compatible
+LSP/UDP, the miner on the auto (pallas-on-chip) tier, the printed Result
+cross-checked bit-for-bit against the native host oracle. This is the run
+that caught round 3's answer-with-sentinel miner bug (a failed device
+backend init produced a legitimate-looking (MAX_U64, 0) Result), so keep
+running it whenever the miner's device path changes.
+
+Usage: python scripts/chip_e2e.py [max_nonce]   (default 2^26 - 1)
+Exit 0 = Result matches oracle.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PORT = 18485
+
+
+def main() -> int:
+    max_nonce = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 26) - 1
+    data = "chip-e2e"
+    env = {**os.environ, "PYTHONPATH": _REPO}
+    procs = []
+
+    def spawn(*args):
+        p = subprocess.Popen([sys.executable, "-m", *args], env=env,
+                             cwd=_REPO, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        procs.append(p)
+        return p
+
+    try:
+        spawn("distributed_bitcoinminer_tpu.apps.server", str(PORT))
+        time.sleep(1.5)
+        spawn("distributed_bitcoinminer_tpu.apps.miner", f"localhost:{PORT}")
+        time.sleep(20)  # device backend init + first-compile headroom
+        t0 = time.time()
+        out = subprocess.run(
+            [sys.executable, "-m", "distributed_bitcoinminer_tpu.apps.client",
+             f"localhost:{PORT}", data, str(max_nonce)],
+            env=env, cwd=_REPO, capture_output=True, text=True, timeout=300)
+        elapsed = time.time() - t0
+        line = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+        print(f"client: {line}  ({elapsed:.1f}s incl. compile)")
+        sys.path.insert(0, _REPO)
+        from distributed_bitcoinminer_tpu import native
+        want = native.scan_min_native(data, 0, max_nonce + 1)
+        print(f"oracle: Result {want[0]} {want[1]}")
+        ok = line == f"Result {want[0]} {want[1]}"
+        print("MATCH" if ok else "MISMATCH")
+        return 0 if ok else 1
+    finally:
+        for p in procs:
+            p.kill()
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    os._exit(rc)
