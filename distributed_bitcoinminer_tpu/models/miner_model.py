@@ -36,12 +36,11 @@ def default_tier() -> str:
     Mosaic kernel; the *searcher-level* values that config.make_searcher
     also reads from the same variable (``auto``/``jax``/``host``) mean
     "not a tier request" and resolve by platform — the Mosaic kernel on a
-    real chip (it benches ~20% above the jnp tier there, round 3:
-    265M vs 222M nonces/s), the XLA tier anywhere else (off-chip pallas
-    would run in the Mosaic simulator at interpreter speed). ``jnp`` pins
-    the XLA tier explicitly. (Round-3 fix lineage: ``DBM_COMPUTE=jax``
-    used to leak through as an unknown tier and crash the miner's first
-    search.)"""
+    real chip, where it benches fastest (see BASELINE.md measured
+    results), the XLA tier anywhere else (off-chip pallas would run in
+    the Mosaic simulator at interpreter speed). ``jnp`` pins the XLA tier
+    explicitly. (Round-3 fix lineage: ``DBM_COMPUTE=jax`` used to leak
+    through as an unknown tier and crash the miner's first search.)"""
     value = os.environ.get("DBM_COMPUTE", "auto").lower()
     if value in ("", "auto", "jax", "host"):
         from ..utils.config import jax_devices_robust

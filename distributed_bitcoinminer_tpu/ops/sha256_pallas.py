@@ -264,10 +264,9 @@ def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
     inputs, shard_map's vma checker requires the pallas outputs to declare
     which mesh axes they vary over.
     """
-    outs = _run_kernel(midstate, template, i0, lo_i, hi_i, rem=rem, k=k,
-                       rows=rows, nsteps=nsteps, interpret=interpret,
-                       vma=vma)
-    hi_h, lo_h, idx = outs
+    hi_h, lo_h, idx = _run_kernel(
+        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        nsteps=nsteps, interpret=interpret, vma=vma)
     return lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
 
 

@@ -37,6 +37,19 @@ _MAX_U32 = np.uint32(0xFFFFFFFF)
 AXIS = "d"
 
 
+def _pmin_lex_argmin(b_hi, b_lo, b_idx):
+    """Exact lexicographic (hash_hi, hash_lo, index) argmin across the mesh
+    axis as three staged ``pmin`` collectives over scalars (replication-
+    invariant outputs, so the merged triple is provably identical on every
+    device). Ties resolve to the lowest index = lowest nonce, matching the
+    Go scan's first-seen-wins strict ``<`` (ref: miner.go:54-58)."""
+    min_hi = jax.lax.pmin(b_hi, AXIS)
+    lo_m = jnp.where(b_hi == min_hi, b_lo, _MAX_U32)
+    min_lo = jax.lax.pmin(lo_m, AXIS)
+    idx_m = jnp.where((b_hi == min_hi) & (b_lo == min_lo), b_idx, _MAX_U32)
+    return min_hi, min_lo, jax.lax.pmin(idx_m, AXIS)
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over the first ``n_devices`` local devices (default: all)."""
     if devices is None:
@@ -92,15 +105,7 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
                 midstate, template, i0[0], lo_i, hi_i,
                 rem=rem, k=k, batch=batch, nbatches=nbatches,
                 vary_axes=(AXIS,))
-        # Cross-device exact lexicographic argmin as three staged pmin
-        # collectives over scalars (replication-invariant outputs, so the
-        # merged triple is provably identical on every device).
-        min_hi = jax.lax.pmin(hi_h, AXIS)
-        lo_m = jnp.where(hi_h == min_hi, lo_h, _MAX_U32)
-        min_lo = jax.lax.pmin(lo_m, AXIS)
-        idx_m = jnp.where((hi_h == min_hi) & (lo_h == min_lo), idx, _MAX_U32)
-        min_idx = jax.lax.pmin(idx_m, AXIS)
-        return min_hi, min_lo, min_idx
+        return _pmin_lex_argmin(hi_h, lo_h, idx)
 
     return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
                 jnp.uint32(lo_i), jnp.uint32(hi_i))
@@ -159,13 +164,7 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
         g_found = jax.lax.pmax(found, AXIS)
         # Fallback exact argmin across devices (used only when no device
         # hit, in which case every device scanned its full span).
-        min_hi = jax.lax.pmin(b_hi, AXIS)
-        lo_m = jnp.where(b_hi == min_hi, b_lo, _MAX_U32)
-        min_lo = jax.lax.pmin(lo_m, AXIS)
-        idx_m = jnp.where((b_hi == min_hi) & (b_lo == min_lo), b_idx,
-                          _MAX_U32)
-        min_idx = jax.lax.pmin(idx_m, AXIS)
-        return g_found, g_idx, min_hi, min_lo, min_idx
+        return g_found, g_idx, *_pmin_lex_argmin(b_hi, b_lo, b_idx)
 
     return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
                 jnp.uint32(lo_i), jnp.uint32(hi_i),
