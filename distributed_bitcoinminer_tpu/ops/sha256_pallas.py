@@ -9,7 +9,11 @@ bitcoin/miner/miner.go:52-59), hand-lowered for the TPU VPU:
   over four 16-round schedule blocks whose window lives in loop-carried
   registers and whose K constants are dynamic reads from the
   scalar-prefetch SMEM vector; block 0 skips the schedule update via a
-  cheap ``where`` guard. The rolled form keeps the traced graph ~16x
+  cheap ``where`` guard (measured better than the "obvious" fix: a
+  ``lax.cond`` that actually skips the ~21 ops/round schedule for block 0
+  benched 3% SLOWER on-chip despite ~10% fewer ops — Mosaic pipelines
+  the straight-line guard better than branchy control flow; round 3).
+  The rolled form keeps the traced graph ~16x
   smaller than a full unroll, which both Mosaic and — critically — the
   XLA:CPU interpret path need (an unrolled SHA graph sends XLA:CPU's pass
   pipeline into a superlinear blowup; reconfirmed on-box in round 3).
