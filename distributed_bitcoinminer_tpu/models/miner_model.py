@@ -49,25 +49,6 @@ def default_tier() -> str:
     return value  # 'jnp'/'pallas', or unknown -> NonceSearcher raises
 
 
-def pallas_interpret_mode(platform: str | None = None) -> bool:
-    """Pallas runs in interpret mode off-TPU (tests on the CPU mesh).
-
-    Thin shim over :func:`ops.sha256_pallas.interpret_on` (the one
-    authoritative platform rule) adding a ``jax.default_backend()``
-    fallback for callers with no better signal. Prefer passing the
-    platform of the devices the kernel will actually run on — the
-    fallback is wrong under this image's sitecustomize: with
-    ``JAX_PLATFORMS=cpu`` set purely as an env var the default backend
-    still resolves to the axon TPU plugin while the devices in play are
-    CPU, which round 3 caught as a real-lowering attempt on the CPU mesh
-    ("Only interpret mode is supported on CPU backend")."""
-    from ..ops.sha256_pallas import interpret_on
-    if platform is None:
-        import jax
-        platform = jax.default_backend()
-    return interpret_on(platform)
-
-
 def _digit_classes(lower: int, upper: int):
     """Split [lower, upper] at decimal-width boundaries (static width per
     device call). Yields (digits, lo, hi) inclusive sub-ranges."""

@@ -51,6 +51,10 @@ def main() -> int:
         print(f"client: {line}  ({elapsed:.1f}s incl. compile)")
         sys.path.insert(0, _REPO)
         from distributed_bitcoinminer_tpu import native
+        # The system scans [0, max_nonce+1]: the scheduler sends exclusive
+        # bounds (upper += 1) but miners read Upper inclusively — the
+        # reference's bound quirk, preserved for bit parity (scheduler.py
+        # module docstring; test_conformance.py oracles the same way).
         want = native.scan_min_native(data, 0, max_nonce + 1)
         print(f"oracle: Result {want[0]} {want[1]}")
         ok = line == f"Result {want[0]} {want[1]}"
