@@ -17,19 +17,47 @@ from ..lsp.params import Params
 
 async def submit(hostport: str, message: str, max_nonce: int,
                  params: Optional[Params] = None) -> Optional[Tuple[int, int]]:
-    """Submit and await one request; None means the connection was lost."""
+    """Submit and await one request; None means the connection was lost.
+
+    The stock exact-arg-min mode is the target-0 special case of
+    :func:`submit_until` (target 0 serializes to reference-identical
+    bytes, message.py)."""
+    result = await submit_until(hostport, message, max_nonce, 0, params)
+    return None if result is None else result[:2]
+
+
+async def submit_until(hostport: str, message: str, max_nonce: int,
+                       target: int, params: Optional[Params] = None,
+                       ) -> Optional[Tuple[int, int, bool]]:
+    """Difficulty-target mode, native protocol: one Request carrying
+    ``Target`` (wire extension, see bitcoin/message.py).
+
+    The scheduler fans the target out with every chunk and miners
+    early-exit in-kernel at their chunk's first qualifying nonce
+    (models.NonceSearcher.search_until), so a loose target completes far
+    ahead of the full arg-min scan. Returns ``(hash, nonce, found)`` —
+    found means ``hash < target`` and, when every miner speaks the
+    extension, ``nonce`` is the FIRST qualifying nonce of the scanned
+    range; found=False hands back the exact arg-min (target missed
+    everywhere). None = connection lost. For a STOCK scheduler that drops
+    the Target key, use :func:`stream_until` instead — it needs nothing
+    beyond the reference wire.
+    """
     client = await new_async_client(hostport, params)
-    client.write(new_request(message, 0, max_nonce).to_json())
+    client.write(new_request(message, 0, max_nonce, target).to_json())
     try:
         payload = await client.read()
     except LspError:
         return None
     finally:
         await client.close()
-    msg = Message.from_json(payload)
+    try:
+        msg = Message.from_json(payload)
+    except ValueError:
+        return None
     if msg.type != MsgType.RESULT:
         return None
-    return msg.hash, msg.nonce
+    return msg.hash, msg.nonce, msg.hash < target
 
 
 async def stream_until(hostport: str, message: str, target: int,
@@ -40,11 +68,12 @@ async def stream_until(hostport: str, message: str, target: int,
     """Difficulty-target mode (BASELINE config 5): stream Requests span by
     span until a merged Result beats ``target``.
 
-    Pure protocol addition — each span rides a stock Request, the scheduler
-    dynamically rebalances every span over the live miner pool, and miners
-    early-exit in-kernel via their own target heuristics if they implement
-    one. Returns (hash, nonce, spans_scanned) or None on disconnect /
-    exhausted ``max_nonce``.
+    Stock-wire strategy: each span rides a reference-shaped Request, so it
+    works against ANY scheduler — but miners run full arg-min per span
+    (the early exit is only span-granular). Against THIS framework's
+    scheduler prefer :func:`submit_until`, which threads the target to the
+    miners' in-kernel early exit. Returns (hash, nonce, spans_scanned) or
+    None on disconnect / exhausted ``max_nonce``.
 
     ``max_nonce=None`` bounds the stream at the end of the nonce space
     (2^64 - 1) rather than looping forever on an unreachable target
@@ -65,7 +94,10 @@ async def stream_until(hostport: str, message: str, target: int,
                 payload = await client.read()
             except LspError:
                 return None
-            msg = Message.from_json(payload)
+            try:
+                msg = Message.from_json(payload)
+            except ValueError:
+                return None
             if msg.type != MsgType.RESULT:
                 return None
             spans += 1
@@ -86,24 +118,45 @@ def printable_result(result: Optional[Tuple[int, int]]) -> str:
 
 def main(argv=None) -> int:
     """CLI contract of the reference binary (ref: client.go:24-58):
-    ``client <hostport> <message> <maxNonce>``."""
+    ``client <hostport> <message> <maxNonce>``, extended with an optional
+    trailing ``[target]`` selecting difficulty mode (:func:`submit_until`;
+    stdout contract unchanged — the printed Result is the first qualifying
+    nonce, or the exact arg-min when no nonce beats the target)."""
     import asyncio
     import sys
     argv = sys.argv if argv is None else argv
-    if len(argv) != 4:
+    if len(argv) not in (4, 5):
         print(f"Usage: ./{argv[0]} <hostport> <message> <maxNonce>", end="")
         return 1
-    try:
-        max_nonce = int(argv[3])
-        if max_nonce < 0:
-            raise ValueError
-    except ValueError:
-        print(f"{argv[3]} is not a number.")
+    def parse_u64(arg: str):
+        # Mirrors Go's strconv.ParseUint(s, 10, 64) in the reference
+        # client: ASCII decimal digits only (bare int() would also take
+        # '+5', ' 5 ', '1_0', and Unicode digits), bounded to uint64,
+        # same diagnostic on failure.
+        if arg.isascii() and arg.isdigit() and int(arg) < (1 << 64):
+            return int(arg)
+        print(f"{arg} is not a number.")
+        return None
+
+    max_nonce = parse_u64(argv[3])
+    if max_nonce is None:
         return 1
+    # target 0 means "no target" (message.py) and selects the stock path,
+    # same as omitting the argument.
+    target = 0
+    if len(argv) == 5:
+        target = parse_u64(argv[4])
+        if target is None:
+            return 1
     from ..utils import from_env
     try:
-        result = asyncio.run(submit(argv[1], argv[2], max_nonce,
-                                    from_env().params))
+        if target:
+            until = asyncio.run(submit_until(argv[1], argv[2], max_nonce,
+                                             target, from_env().params))
+            result = until if until is None else until[:2]
+        else:
+            result = asyncio.run(submit(argv[1], argv[2], max_nonce,
+                                        from_env().params))
     except LspError as exc:
         print("Failed to connect to server:", exc)
         return 1

@@ -44,6 +44,11 @@ class HostSearcher:
         return native.scan_min_native(self.data, lower, upper,
                                       threads=self.threads)
 
+    def search_until(self, lower: int, upper: int, target: int):
+        from .. import native
+        return native.scan_until_native(self.data, lower, upper, target,
+                                        threads=self.threads)
+
 
 def default_searcher_factory(data: str, batch: Optional[int] = None,
                              tier: Optional[str] = None):
@@ -116,7 +121,7 @@ class MinerWorker:
             # Compute off-loop so LSP heartbeats keep flowing mid-search.
             try:
                 best_hash, best_nonce = await asyncio.to_thread(
-                    self._search, msg.data, msg.lower, msg.upper)
+                    self._search, msg.data, msg.lower, msg.upper, msg.target)
             except Exception:
                 # A broken worker must LEAVE the pool — exit so the
                 # scheduler declares the connection lost and reassigns
@@ -138,7 +143,8 @@ class MinerWorker:
                 return
             self.jobs_done += 1
 
-    def _search(self, data: str, lower: int, upper: int) -> tuple[int, int]:
+    def _search(self, data: str, lower: int, upper: int,
+                target: int = 0) -> tuple[int, int]:
         if lower > upper:
             # The Go miner's loop body never runs for an inverted range and
             # it reports (maxUint, 0) (ref: miner.go:46-59); match that
@@ -152,6 +158,18 @@ class MinerWorker:
                 self._searchers.popitem(last=False)
         else:
             self._searchers.move_to_end(data)
+        if target:
+            # Difficulty-target Request (wire extension, message.py): run
+            # the early-exiting search. The Result carries the qualifying
+            # (hash, nonce) when one exists — the scheduler/client detect
+            # success by hash < target — else the exact chunk arg-min.
+            # A searcher without the mode (user-supplied factory) degrades
+            # to the full scan, exactly like a stock Go miner that dropped
+            # the unknown Target key.
+            until = getattr(searcher, "search_until", None)
+            if until is not None:
+                best_hash, best_nonce, _found = until(lower, upper, target)
+                return best_hash, best_nonce
         return searcher.search(lower, upper)
 
     async def close(self) -> None:

@@ -17,6 +17,15 @@ channel-coupled goroutines:
 - Result merge: strict ``<`` on the uint64 hash; barrier releases the Result
   to the client when every chunk of the request has been answered
   (ref: server.go:257-325).
+- Difficulty extension (no reference analog; BASELINE config 5): a Request
+  carrying ``Target`` fans out with the target on every chunk, miners
+  early-exit at their chunk's first ``hash < target`` nonce, and the merge
+  answers the lowest-nonce qualifying response — the globally first
+  qualifying nonce when every miner speaks the extension (chunks ascend
+  and each reports its chunk-first hit; a stock Target-dropping miner
+  reports a chunk arg-min instead, weakening its chunk to "a qualifying
+  nonce"). No hit anywhere degrades to the exact arg-min, and stock
+  Requests (``Target`` absent = 0) take the reference path byte-for-byte.
 - Miner drop: reassign its unanswered chunks to available miners, else park
   them; parked chunks are re-issued when a miner joins or frees up
   (ref: server.go:326-376, 222-244, 285-304).
@@ -58,6 +67,7 @@ class Chunk:
     data: str
     lower: int
     upper: int              # exclusive end, as sent on the wire
+    target: int = 0         # difficulty target; rides every (re)assignment
     # Set when the requesting client drops: the chunk stays in the miner's
     # pending FIFO (its Result must still pop in order) but no longer
     # counts against the miner's availability.
@@ -84,11 +94,23 @@ class Request:
     data: str
     lower: int
     upper: int              # inclusive on arrival; +1 at load_balance
+    target: int = 0         # difficulty target; 0 = exact arg-min (stock)
     job_id: int = 0
     num_chunks: int = 0
     min_hash: int = MAX_U64
     min_nonce: int = 0
     total_responses: int = 0
+    # Difficulty merge plane: lowest-nonce qualifying (hash < target)
+    # response seen so far. Chunks cover ascending sub-ranges and each
+    # until-speaking miner reports its chunk-FIRST qualifying nonce, so
+    # the min-nonce qualifier across chunks is the globally first
+    # qualifying nonce — provided every miner speaks the extension; a
+    # stock (Target-dropping) miner reports its chunk ARG-MIN, which may
+    # qualify later than its chunk's first hit, weakening the answer to
+    # "a qualifying nonce" (see client.submit_until docstring).
+    q_hash: int = 0
+    q_nonce: int = 0
+    q_seen: bool = False
 
 
 class Scheduler:
@@ -129,7 +151,8 @@ class Scheduler:
 
     def _on_request(self, conn_id: int, msg: Message) -> None:
         request = Request(conn_id=conn_id, data=msg.data,
-                          lower=msg.lower, upper=msg.upper)
+                          lower=msg.lower, upper=msg.upper,
+                          target=msg.target)
         if not self.queue and self.current is None and self.miners:
             self._load_balance(request)
         else:
@@ -161,10 +184,20 @@ class Scheduler:
         if msg.hash < curr.min_hash:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
+        if curr.target and msg.hash < curr.target and (
+                not curr.q_seen or msg.nonce < curr.q_nonce):
+            curr.q_hash, curr.q_nonce, curr.q_seen = msg.hash, msg.nonce, True
         curr.total_responses += 1
         if curr.total_responses == curr.num_chunks:
-            self._write(curr.conn_id,
-                        new_result(curr.min_hash, curr.min_nonce))
+            # Difficulty request with a hit: answer the globally FIRST
+            # qualifying nonce (see Request.q_* fields); otherwise — stock
+            # request, or target missed everywhere — the exact arg-min.
+            if curr.q_seen:
+                self._write(curr.conn_id, new_result(curr.q_hash,
+                                                     curr.q_nonce))
+            else:
+                self._write(curr.conn_id,
+                            new_result(curr.min_hash, curr.min_nonce))
             self.current = None
             if self.queue:
                 self._load_balance(self.queue.pop(0))
@@ -242,13 +275,15 @@ class Scheduler:
             end = start + individual + (leftover if i == 0 else 0)
             self._assign_chunk(
                 self.miners[i],
-                Chunk(request.job_id, request.data, start, end))
+                Chunk(request.job_id, request.data, start, end,
+                      target=request.target))
             start = end
 
     def _assign_chunk(self, miner: MinerState, chunk: Chunk) -> None:
         miner.pending.append(chunk)
         self._write(miner.conn_id,
-                    new_request(chunk.data, chunk.lower, chunk.upper))
+                    new_request(chunk.data, chunk.lower, chunk.upper,
+                                chunk.target))
 
     def _write(self, conn_id: int, msg: Message) -> None:
         try:

@@ -5,7 +5,7 @@ Wire-compatible with the reference ``bitcoin`` package
 """
 
 from .message import Message, MsgType, new_join, new_request, new_result
-from .hash import hash_op, MAX_U64
+from .hash import hash_op, scan_min, scan_until, MAX_U64
 
 __all__ = ["Message", "MsgType", "new_join", "new_request", "new_result",
-           "hash_op", "MAX_U64"]
+           "hash_op", "scan_min", "scan_until", "MAX_U64"]

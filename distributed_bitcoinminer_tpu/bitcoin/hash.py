@@ -18,16 +18,33 @@ def hash_op(msg: str, nonce: int) -> int:
     return int.from_bytes(digest[:8], "big")
 
 
-def scan_min(msg: str, lower: int, upper: int) -> tuple[int, int]:
-    """CPU-oracle arg-min scan over the inclusive range [lower, upper].
+def scan_until(msg: str, lower: int, upper: int,
+               target: int) -> tuple[int, int, bool]:
+    """CPU-oracle difficulty scan: ``(hash, nonce, found)``.
 
-    Mirrors the reference miner's hot loop (ref: bitcoin/miner/miner.go:52-59):
-    strict ``<`` comparison, so the earliest nonce wins ties.
+    Ascending scan of the inclusive range; stops at the FIRST nonce whose
+    hash is strictly below ``target`` (found=True). When no nonce
+    qualifies, degrades to the exact arg-min (found=False) — the same
+    contract as ``models.NonceSearcher.search_until`` and the tiers under
+    it, which this function is the bit-exactness oracle for.
     """
     best_hash = MAX_U64
     best_nonce = lower
     for n in range(lower, upper + 1):
         h = hash_op(msg, n)
+        if h < target:
+            return h, n, True
         if h < best_hash:
             best_hash, best_nonce = h, n
-    return best_hash, best_nonce
+    return best_hash, best_nonce, False
+
+
+def scan_min(msg: str, lower: int, upper: int) -> tuple[int, int]:
+    """CPU-oracle arg-min scan over the inclusive range [lower, upper].
+
+    Mirrors the reference miner's hot loop (ref: bitcoin/miner/miner.go:52-59):
+    strict ``<`` comparison, so the earliest nonce wins ties. One scan
+    loop serves both modes: target 0 can never hit (no uint64 hash is
+    ``< 0``), the same dereplication as ``dbm_scan_min`` native-side.
+    """
+    return scan_until(msg, lower, upper, 0)[:2]

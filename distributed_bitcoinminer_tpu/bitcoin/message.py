@@ -3,6 +3,16 @@
 JSON layout matches Go ``encoding/json`` of the reference struct
 (ref: bitcoin/message.go:18-49): all fields always present, in struct order,
 ``Lower``/``Upper``/``Hash``/``Nonce`` are uint64 numbers.
+
+Difficulty extension (this framework only): a Request may carry a
+``Target`` field — "stop at the first nonce whose hash is strictly below
+this" (BASELINE config 5). It is appended AFTER the reference fields and
+only when set, so a target-less message is byte-identical to the stock
+encoding, and a stock Go endpoint parsing a target-ful one simply drops
+the unknown key (``encoding/json`` ignores fields with no struct match)
+and performs a full arg-min scan — a valid, if slower, answer to the same
+Request. ``target == 0`` means "no target": no uint64 hash is ``< 0``, so
+zero could never qualify a nonce anyway.
 """
 
 from __future__ import annotations
@@ -35,24 +45,57 @@ class Message:
     upper: int = 0
     hash: int = 0
     nonce: int = 0
+    target: int = 0   # extension; 0 = absent (stock bytes)
 
     def to_json(self) -> bytes:
+        tail = f',"Target":{self.target}' if self.target else ""
         return (
-            '{"Type":%d,"Data":%s,"Lower":%d,"Upper":%d,"Hash":%d,"Nonce":%d}'
+            '{"Type":%d,"Data":%s,"Lower":%d,"Upper":%d,"Hash":%d,"Nonce":%d%s}'
             % (int(self.type), _go_json_string(self.data), self.lower, self.upper,
-               self.hash, self.nonce)
+               self.hash, self.nonce, tail)
         ).encode("utf-8")
 
     @classmethod
     def from_json(cls, raw: bytes) -> "Message":
         obj = json.loads(raw)
+        # Valid JSON that isn't an object ([1,2], "x", 5) or carries a
+        # non-string Data must raise ValueError like malformed bytes do:
+        # an AttributeError here escapes the recv loops' `except
+        # ValueError: continue` and kills the whole endpoint, not one
+        # message (code-review r4).
+        if not isinstance(obj, dict) or not isinstance(obj.get("Data", ""),
+                                                       str):
+            raise ValueError("not a message object")
+
+        def u64(key: str) -> int:
+            # Go json.Unmarshal into uint64 errors on out-of-range,
+            # fractional, or non-numeric values and the reference endpoints
+            # skip unparsable messages; raising ValueError here reaches the
+            # same `except ValueError: continue` in every caller. The
+            # isinstance check must come before any int() conversion:
+            # int(None)/int([1]) raise TypeError and int(float('inf'))
+            # OverflowError, which would escape those guards and kill the
+            # endpoint, not the message; and without the range check a
+            # poison Request (e.g. Target = 2^64) would crash each miner's
+            # c_uint64/uint32 conversion in turn and drain the whole pool
+            # (code-review r4).
+            value = obj.get(key, 0)
+            if isinstance(value, bool) or not isinstance(value, int) \
+                    or not 0 <= value < (1 << 64):
+                raise ValueError(f"{key} is not a uint64")
+            return value
+
+        type_value = obj.get("Type", 0)
+        if isinstance(type_value, bool) or not isinstance(type_value, int):
+            raise ValueError("Type is not an integer")
         return cls(
-            type=MsgType(obj.get("Type", 0)),
+            type=MsgType(type_value),
             data=obj.get("Data", ""),
-            lower=int(obj.get("Lower", 0)),
-            upper=int(obj.get("Upper", 0)),
-            hash=int(obj.get("Hash", 0)),
-            nonce=int(obj.get("Nonce", 0)),
+            lower=u64("Lower"),
+            upper=u64("Upper"),
+            hash=u64("Hash"),
+            nonce=u64("Nonce"),
+            target=u64("Target"),
         )
 
     def __str__(self) -> str:
@@ -68,8 +111,9 @@ def new_join() -> Message:
     return Message(type=MsgType.JOIN)
 
 
-def new_request(data: str, lower: int, upper: int) -> Message:
-    return Message(type=MsgType.REQUEST, data=data, lower=lower, upper=upper)
+def new_request(data: str, lower: int, upper: int, target: int = 0) -> Message:
+    return Message(type=MsgType.REQUEST, data=data, lower=lower, upper=upper,
+                   target=target)
 
 
 def new_result(hash_value: int, nonce: int) -> Message:

@@ -60,7 +60,8 @@ def load() -> Optional[ctypes.CDLL]:
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
-            if not hasattr(lib, "dbm_scan_min_mt"):
+            if not (hasattr(lib, "dbm_scan_min_mt")
+                    and hasattr(lib, "dbm_scan_until_mt")):
                 # Stale cached .so from before the MT scan existed (mtime
                 # can lie after a checkout restore): rebuild once. dlclose
                 # first — dlopen caches by path, so reloading without it
@@ -92,6 +93,22 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_uint64, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64)]
+        if hasattr(lib, "dbm_scan_until"):
+            lib.dbm_scan_until.restype = ctypes.c_int
+            lib.dbm_scan_until.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int)]
+        if hasattr(lib, "dbm_scan_until_mt"):
+            lib.dbm_scan_until_mt.restype = ctypes.c_int
+            lib.dbm_scan_until_mt.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int)]
         _lib = lib
         return _lib
 
@@ -113,30 +130,53 @@ def scan_min_native(data: str, lower: int, upper: int,
     ``threads``: 0 = auto (all cores for ranges >= 2^17, else one);
     1 forces single-threaded; N pins the worker count. The tie rule is
     identical either way (contiguous ascending sub-ranges, first-seen
-    wins).
+    wins). Arg-min is the target-0 special case of the until dispatch
+    (target 0 never hits), keeping one copy of the threshold/threads/rc
+    scaffolding — the same dereplication as ``bitcoin.hash.scan_min``
+    and ``dbm_scan_min`` at their layers.
     """
+    hash_value, nonce, _found = scan_until_native(data, lower, upper, 0,
+                                                  threads=threads)
+    return hash_value, nonce
+
+
+def scan_until_native(data: str, lower: int, upper: int, target: int,
+                      threads: int = 0) -> Tuple[int, int, bool]:
+    """Native difficulty scan over [lower, upper]: first nonce with
+    ``hash < target`` (found=True), else exact arg-min (found=False).
+
+    ``threads`` as in :func:`scan_min_native`; the MT fan-out keeps
+    first-qualifying semantics (ascending shards, lowest hitting shard
+    wins, higher shards cooperatively aborted). Falls back to the Python
+    oracle without a toolchain or with a stale pre-until ``.so`` kept
+    alive by a vanished toolchain."""
+    if lower > upper:
+        raise ValueError("empty range")  # uniform across native/fallback
     lib = load()
-    if lib is None:
-        from ..bitcoin.hash import scan_min
-        return scan_min(data, lower, upper)
+    if lib is None or not hasattr(lib, "dbm_scan_until"):
+        from ..bitcoin.hash import scan_until
+        return scan_until(data, lower, upper, target)
     raw = data.encode("utf-8")
     out_hash = ctypes.c_uint64()
     out_nonce = ctypes.c_uint64()
+    out_found = ctypes.c_int()
     if threads == 0 and upper - lower + 1 < _MT_THRESHOLD:
         threads = 1
-    if not hasattr(lib, "dbm_scan_min_mt"):
-        threads = 1  # stale pre-MT lib kept alive without a toolchain
+    if not hasattr(lib, "dbm_scan_until_mt"):
+        threads = 1
     if threads == 1:
-        rc = lib.dbm_scan_min(raw, len(raw), lower, upper,
-                              ctypes.byref(out_hash),
-                              ctypes.byref(out_nonce))
+        rc = lib.dbm_scan_until(raw, len(raw), lower, upper, target,
+                                ctypes.byref(out_hash),
+                                ctypes.byref(out_nonce),
+                                ctypes.byref(out_found))
     else:
-        rc = lib.dbm_scan_min_mt(raw, len(raw), lower, upper, threads,
-                                 ctypes.byref(out_hash),
-                                 ctypes.byref(out_nonce))
+        rc = lib.dbm_scan_until_mt(raw, len(raw), lower, upper, target,
+                                   threads, ctypes.byref(out_hash),
+                                   ctypes.byref(out_nonce),
+                                   ctypes.byref(out_found))
     if rc != 0:
         raise ValueError("empty range")
-    return out_hash.value, out_nonce.value
+    return out_hash.value, out_nonce.value, bool(out_found.value)
 
 
 def hash_native(data: str, nonce: int) -> int:

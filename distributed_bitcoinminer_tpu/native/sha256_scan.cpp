@@ -14,6 +14,7 @@
 // counter in the tail block avoid re-hashing the prefix and re-formatting
 // the nonce per iteration.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -169,14 +170,23 @@ uint64_t finish(const uint32_t mid[8], const uint8_t* tail, int tail_len,
   return (uint64_t(st[0]) << 32) | uint64_t(st[1]);
 }
 
-}  // namespace
-
-extern "C" {
-
-// Scan [lower, upper] inclusive; writes (min_hash, argmin_nonce). Returns 0,
-// or -1 for an empty range (outputs untouched).
-int dbm_scan_min(const char* data, uint64_t data_len, uint64_t lower,
-                 uint64_t upper, uint64_t* out_hash, uint64_t* out_nonce) {
+// The one scan loop behind every extern entry point. Ascending over
+// [lower, upper]; stops at the FIRST nonce whose hash < target
+// (*out_found = 1); otherwise tracks the exact arg-min (*out_found = 0)
+// with strict-'<' earliest-nonce ties. target = 0 can never hit (no
+// uint64 is < 0), so the arg-min scan is the target-0 special case.
+//
+// Cooperative MT abort: when min_found_shard is non-null the loop checks
+// it every 4096 nonces and bails (returns 1, outputs = partial arg-min)
+// once a LOWER-indexed shard has a hit — anything this shard could still
+// find is beaten by that hit. Lower shards are never stopped by higher
+// ones (the global first-qualifying nonce may sit late in an early
+// shard). Returns 0 = completed, 1 = aborted, -1 = empty range.
+int scan_until_core(const char* data, uint64_t data_len, uint64_t lower,
+                    uint64_t upper, uint64_t target,
+                    const std::atomic<uint64_t>* min_found_shard,
+                    uint64_t my_shard, uint64_t* out_hash,
+                    uint64_t* out_nonce, int* out_found) {
   if (lower > upper) return -1;
 
   // Absorb all complete 64-byte blocks of "<data> " once.
@@ -210,8 +220,21 @@ int dbm_scan_min(const char* data, uint64_t data_len, uint64_t lower,
   uint64_t best_hash = ~uint64_t(0);
   uint64_t best_nonce = lower;
   for (uint64_t n = lower;; ++n) {
+    if (min_found_shard && (n & 4095) == 0 &&
+        min_found_shard->load(std::memory_order_relaxed) < my_shard) {
+      *out_hash = best_hash;
+      *out_nonce = best_nonce;
+      *out_found = 0;
+      return 1;
+    }
     std::memcpy(tail + rem, digits, nd);
     uint64_t h = finish(mid, tail, rem + nd, prefix_len + nd);
+    if (h < target) {
+      *out_hash = h;
+      *out_nonce = n;
+      *out_found = 1;
+      return 0;
+    }
     if (h < best_hash) {
       best_hash = h;
       best_nonce = n;
@@ -230,6 +253,110 @@ int dbm_scan_min(const char* data, uint64_t data_len, uint64_t lower,
   }
   *out_hash = best_hash;
   *out_nonce = best_nonce;
+  *out_found = 0;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Difficulty scan (BASELINE config 5), single-threaded. Returns 0, or -1
+// for an empty range (outputs untouched).
+int dbm_scan_until(const char* data, uint64_t data_len, uint64_t lower,
+                   uint64_t upper, uint64_t target, uint64_t* out_hash,
+                   uint64_t* out_nonce, int* out_found) {
+  return scan_until_core(data, data_len, lower, upper, target, nullptr, 0,
+                         out_hash, out_nonce, out_found);
+}
+
+// Scan [lower, upper] inclusive; writes (min_hash, argmin_nonce). Returns 0,
+// or -1 for an empty range (outputs untouched).
+int dbm_scan_min(const char* data, uint64_t data_len, uint64_t lower,
+                 uint64_t upper, uint64_t* out_hash, uint64_t* out_nonce) {
+  int found;
+  return scan_until_core(data, data_len, lower, upper, 0, nullptr, 0,
+                         out_hash, out_nonce, &found);
+}
+
+// Multi-threaded difficulty scan: contiguous ascending shards, one per
+// thread; each stops at its own first hit and publishes its shard index,
+// which cooperatively aborts all HIGHER shards (scan_until_core). The
+// lowest hitting shard's first hit is the globally first qualifying nonce
+// (lower shards always run to completion or their own earlier hit); with
+// no hit anywhere, shards merge to the exact arg-min in index order, same
+// tie rule as dbm_scan_min_mt. nthreads <= 0 means hardware_concurrency.
+int dbm_scan_until_mt(const char* data, uint64_t data_len, uint64_t lower,
+                      uint64_t upper, uint64_t target, int nthreads,
+                      uint64_t* out_hash, uint64_t* out_nonce,
+                      int* out_found) {
+  if (lower > upper) return -1;
+  uint64_t total = upper - lower + 1;
+  unsigned hw = std::thread::hardware_concurrency();
+  uint64_t want = nthreads > 0 ? uint64_t(nthreads) : (hw ? hw : 1);
+  if (want > total) want = total;
+  if (want <= 1)
+    return dbm_scan_until(data, data_len, lower, upper, target, out_hash,
+                          out_nonce, out_found);
+
+  std::vector<uint64_t> los(want), his(want);
+  uint64_t per = total / want, extra = total % want, start = lower;
+  for (uint64_t t = 0; t < want; ++t) {
+    uint64_t len = per + (t < extra ? 1 : 0);
+    los[t] = start;
+    his[t] = start + len - 1;
+    start += len;
+  }
+  std::atomic<uint64_t> min_found{~uint64_t(0)};
+  std::vector<uint64_t> hashes(want), nonces(want);
+  auto run_shard = [&](uint64_t t, uint64_t lo, uint64_t hi) {
+    int f = 0;
+    scan_until_core(data, data_len, lo, hi, target, &min_found, t,
+                    &hashes[t], &nonces[t], &f);
+    if (f) {
+      uint64_t cur = min_found.load(std::memory_order_relaxed);
+      while (t < cur &&
+             !min_found.compare_exchange_weak(cur, t,
+                                              std::memory_order_relaxed)) {
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(want);
+  uint64_t spawned = 0;
+  try {
+    for (uint64_t t = 0; t < want; ++t) {
+      threads.emplace_back(run_shard, t, los[t], his[t]);
+      ++spawned;
+    }
+  } catch (...) {
+    // Thread spawn failed (e.g. EAGAIN under a pid limit): join what
+    // started, then cover the tail on this thread as shard `spawned`
+    // (same recovery as dbm_scan_min_mt; shard order stays ascending).
+  }
+  for (auto& th : threads) th.join();
+  uint64_t covered = spawned;
+  if (covered < want) {
+    run_shard(covered, los[covered], upper);
+    ++covered;
+  }
+  uint64_t win = min_found.load(std::memory_order_relaxed);
+  if (win != ~uint64_t(0) && win < covered) {
+    *out_hash = hashes[win];
+    *out_nonce = nonces[win];
+    *out_found = 1;
+    return 0;
+  }
+  uint64_t best_hash = hashes[0], best_nonce = nonces[0];
+  for (uint64_t t = 1; t < covered; ++t) {
+    if (hashes[t] < best_hash) {
+      best_hash = hashes[t];
+      best_nonce = nonces[t];
+    }
+  }
+  *out_hash = best_hash;
+  *out_nonce = best_nonce;
+  *out_found = 0;
   return 0;
 }
 

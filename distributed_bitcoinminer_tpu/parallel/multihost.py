@@ -38,9 +38,10 @@ from .mesh_search import make_mesh
 logger = logging.getLogger("dbm.multihost")
 
 #: broadcast frame layout (uint32): [opcode, data_len, lo_hi, lo_lo,
-#: up_hi, up_lo, data_bytes...]; opcode 0 = stop, 1 = search.
+#: up_hi, up_lo, t_hi, t_lo, data_bytes...]; opcode 0 = stop, 1 = arg-min
+#: search (target words ignored), 2 = difficulty search_until.
 _MAX_DATA = 992
-_FRAME = 6 + _MAX_DATA
+_FRAME = 8 + _MAX_DATA
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
@@ -86,17 +87,25 @@ def _broadcast_frame(frame: Optional[np.ndarray]) -> np.ndarray:
         multihost_utils.broadcast_one_to_all(frame), dtype=np.uint32)
 
 
-def broadcast_job(data: str, lower: int, upper: int) -> None:
-    """Host 0: announce one search job to every follower host."""
+def broadcast_job(data: str, lower: int, upper: int,
+                  target: int = 0) -> None:
+    """Host 0: announce one search job to every follower host.
+
+    ``target`` nonzero selects the difficulty mode (opcode 2): every host
+    runs the same ``search_until`` host loop, whose per-sub early-exit
+    decisions are made from REPLICATED collective results, so the hosts
+    stay in lockstep through the early exit.
+    """
     raw = data.encode("utf-8")
     if len(raw) > _MAX_DATA:
         raise ValueError(f"message too long for pod broadcast: {len(raw)}")
     frame = np.zeros(_FRAME, dtype=np.uint32)
-    frame[0] = 1
+    frame[0] = 2 if target else 1
     frame[1] = len(raw)
     frame[2], frame[3] = lower >> 32, lower & 0xFFFFFFFF
     frame[4], frame[5] = upper >> 32, upper & 0xFFFFFFFF
-    frame[6:6 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    frame[6], frame[7] = target >> 32, target & 0xFFFFFFFF
+    frame[8:8 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
     _broadcast_frame(frame)
 
 
@@ -106,15 +115,19 @@ def broadcast_stop() -> None:
 
 
 def _receive_job():
-    """Follower: block for the next control frame; None means stop."""
+    """Follower: block for the next control frame; None means stop.
+    Returns ``(data, lower, upper, target)`` — target 0 = arg-min job."""
     frame = _broadcast_frame(None)
     if int(frame[0]) == 0:
         return None
     n = int(frame[1])
-    data = bytes(frame[6:6 + n].astype(np.uint8)).decode("utf-8")
+    data = bytes(frame[8:8 + n].astype(np.uint8)).decode("utf-8")
     lower = (int(frame[2]) << 32) | int(frame[3])
     upper = (int(frame[4]) << 32) | int(frame[5])
-    return data, lower, upper
+    target = 0
+    if int(frame[0]) == 2:
+        target = (int(frame[6]) << 32) | int(frame[7])
+    return data, lower, upper, target
 
 
 class PodSearcher:
@@ -130,6 +143,16 @@ class PodSearcher:
     def search(self, lower: int, upper: int):
         broadcast_job(self.data, lower, upper)
         return self.inner.search(lower, upper)
+
+    def search_until(self, lower: int, upper: int, target: int):
+        if not target:
+            # target 0 would broadcast as opcode 1 (arg-min), desyncing the
+            # owner's until program from the followers' collective
+            # sequence; route it explicitly — 0 can never qualify, so the
+            # arg-min with found=False is the exact same answer.
+            return (*self.search(lower, upper), False)
+        broadcast_job(self.data, lower, upper, target)
+        return self.inner.search_until(lower, upper, target)
 
 
 def run_follower(batch: Optional[int] = None,
@@ -151,7 +174,7 @@ def run_follower(batch: Optional[int] = None,
         job = _receive_job()
         if job is None:
             return jobs
-        data, lower, upper = job
+        data, lower, upper, target = job
         s = searchers.get(data)
         if s is None:
             s = ShardedNonceSearcher(data, batch=batch or (1 << 20),
@@ -162,7 +185,12 @@ def run_follower(batch: Optional[int] = None,
         else:
             searchers.move_to_end(data)
         try:
-            s.search(lower, upper)   # result replicated; owner reports it
+            # Result replicated; the owner reports it. The until host loop
+            # branches only on replicated values, keeping hosts in lockstep.
+            if target:
+                s.search_until(lower, upper, target)
+            else:
+                s.search(lower, upper)
         except Exception:
             # Failure symmetry (round-3 review): a deterministic compute
             # error raises on EVERY host (same program); the owner's

@@ -3,10 +3,12 @@
 
 The flagship demo as one command — three OS processes over wire-compatible
 LSP/UDP, the miner on the auto (pallas-on-chip) tier, the printed Result
-cross-checked bit-for-bit against the native host oracle. This is the run
-that caught round 3's answer-with-sentinel miner bug (a failed device
-backend init produced a legitimate-looking (MAX_U64, 0) Result), so keep
-running it whenever the miner's device path changes.
+cross-checked bit-for-bit against the native host oracle; then a second
+client request carrying a difficulty target, checked against the
+first-qualifying-nonce oracle (the miner runs the in-kernel early exit).
+This is the run that caught round 3's answer-with-sentinel miner bug (a
+failed device backend init produced a legitimate-looking (MAX_U64, 0)
+Result), so keep running it whenever the miner's device path changes.
 
 Usage: python scripts/chip_e2e.py [max_nonce]   (default 2^26 - 1)
 Exit 0 = Result matches oracle.
@@ -59,7 +61,26 @@ def main() -> int:
         print(f"oracle: Result {want[0]} {want[1]}")
         ok = line == f"Result {want[0]} {want[1]}"
         print("MATCH" if ok else "MISMATCH")
-        return 0 if ok else 1
+
+        # Difficulty leg: same range with a ~2^-8-per-nonce target; the
+        # miner must run the in-kernel early exit and the Result must be
+        # the FIRST qualifying nonce (or the exact arg-min on a miss).
+        target = 1 << 56
+        t0 = time.time()
+        out = subprocess.run(
+            [sys.executable, "-m", "distributed_bitcoinminer_tpu.apps.client",
+             f"localhost:{PORT}", data, str(max_nonce), str(target)],
+            env=env, cwd=_REPO, capture_output=True, text=True, timeout=300)
+        elapsed = time.time() - t0
+        line = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+        print(f"client[target 2^56]: {line}  ({elapsed:.1f}s)")
+        u_hash, u_nonce, u_found = native.scan_until_native(
+            data, 0, max_nonce + 1, target)
+        print(f"oracle[target 2^56]: Result {u_hash} {u_nonce} "
+              f"(found={u_found})")
+        ok_until = line == f"Result {u_hash} {u_nonce}"
+        print("MATCH" if ok_until else "MISMATCH")
+        return 0 if (ok and ok_until) else 1
     finally:
         for p in procs:
             p.kill()

@@ -32,6 +32,29 @@ def test_empty_range_raises():
         native.scan_min_native("x", 5, 4)
 
 
+def test_mt_until_preserves_first_qualifying_nonce():
+    """The MT difficulty scan (ascending shards, lowest hitting shard wins,
+    higher shards cooperatively aborted) must agree bit-for-bit with the
+    single-threaded scan on the FIRST qualifying nonce — including when the
+    hit sits deep in a later shard — and on the arg-min miss fallback."""
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+    cases = [
+        ("mt until", 0, 70_000, 1 << 57),     # hit early, many shards
+        ("mt until", 0, 70_000, 1 << 50),     # hit deep or miss
+        ("deep hit", 1_000, 180_000, 1 << 53),
+        ("no luck", 0, 3_000, 1),             # miss -> exact arg-min merge
+    ]
+    for data, lo, hi, target in cases:
+        st = native.scan_until_native(data, lo, hi, target, threads=1)
+        assert st == scan_until(data, lo, hi, target)
+        for threads in (2, 3, 8):
+            assert native.scan_until_native(
+                data, lo, hi, target, threads=threads) == st
+    # More threads than nonces.
+    assert native.scan_until_native("mt", 7, 9, 1 << 62, threads=8) == \
+        scan_until("mt", 7, 9, 1 << 62)
+
+
 def test_mt_scan_matches_single_threaded():
     """The threaded fan-out (contiguous ascending sub-ranges, merged in
     index order) must preserve the strict-'<' earliest-nonce tie rule
