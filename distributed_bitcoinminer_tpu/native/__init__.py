@@ -153,13 +153,25 @@ def scan_until_native(data: str, lower: int, upper: int, target: int,
     if lower > upper:
         raise ValueError("empty range")  # uniform across native/fallback
     lib = load()
-    if lib is None or not hasattr(lib, "dbm_scan_until"):
-        from ..bitcoin.hash import scan_until
-        return scan_until(data, lower, upper, target)
     raw = data.encode("utf-8")
     out_hash = ctypes.c_uint64()
     out_nonce = ctypes.c_uint64()
     out_found = ctypes.c_int()
+    if lib is None or not hasattr(lib, "dbm_scan_until"):
+        if lib is not None and target == 0:
+            # Stale pre-until .so kept alive by a vanished toolchain:
+            # honor load()'s promise that arg-min scans still run native
+            # (single-threaded) rather than dropping to the Python oracle
+            # — scan_min_native routes through here with target 0
+            # (code-review r4).
+            rc = lib.dbm_scan_min(raw, len(raw), lower, upper,
+                                  ctypes.byref(out_hash),
+                                  ctypes.byref(out_nonce))
+            if rc != 0:
+                raise ValueError("empty range")
+            return out_hash.value, out_nonce.value, False
+        from ..bitcoin.hash import scan_until
+        return scan_until(data, lower, upper, target)
     if threads == 0 and upper - lower + 1 < _MT_THRESHOLD:
         threads = 1
     if not hasattr(lib, "dbm_scan_until_mt"):

@@ -73,6 +73,57 @@ def global_mesh():
     return make_mesh(devices=jax.devices())
 
 
+def _pod_timeout_s() -> float:
+    """Upper bound on one pod job (broadcast + collective search).
+
+    ``DBM_POD_TIMEOUT_S`` (default 600 s) — generous for any real chunk
+    (a v4-8 pod clears 10^11 nonces inside it) while still converting a
+    wedged collective into a bounded failure.
+    """
+    try:
+        return float(os.environ.get("DBM_POD_TIMEOUT_S", "600"))
+    except ValueError:
+        return 600.0
+
+
+def bounded_pod_call(fn):
+    """Run one pod job with the failure-domain bound (VERDICT r3 task 7).
+
+    A host dying mid-job leaves every OTHER host wedged inside a
+    collective (broadcast or psum) that can never complete and cannot be
+    cancelled from Python. The enforceable bound is process death: run
+    the job in a daemon thread, and if it outlives ``DBM_POD_TIMEOUT_S``
+    hard-exit. On the owner that drops its LSP connection, so the
+    scheduler declares the pod-miner lost and re-executes the chunk on
+    another miner (same recovery as any dead miner,
+    ref: bitcoin/server/server.go:326-376); a follower simply dies with
+    the pod. A *deterministic* compute error still raises symmetrically
+    on every host and is handled by the callers' except paths.
+    """
+    import threading
+    outcome: list = []
+
+    def target():
+        try:
+            outcome.append(("ok", fn()))
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            outcome.append(("err", exc))
+
+    worker = threading.Thread(target=target, daemon=True)
+    worker.start()
+    worker.join(_pod_timeout_s())
+    if not outcome:
+        logger.error(
+            "pod job exceeded DBM_POD_TIMEOUT_S=%.0fs — a peer host "
+            "likely died mid-collective; exiting so this host leaves the "
+            "pool and the chunk re-executes elsewhere", _pod_timeout_s())
+        os._exit(17)
+    kind, value = outcome[0]
+    if kind == "err":
+        raise value
+    return value
+
+
 def is_lsp_owner() -> bool:
     """True on the one host that speaks LSP for the whole pod (host 0)."""
     return jax.process_index() == 0
@@ -141,8 +192,9 @@ class PodSearcher:
             data, batch=batch or (1 << 20), mesh=global_mesh())
 
     def search(self, lower: int, upper: int):
-        broadcast_job(self.data, lower, upper)
-        return self.inner.search(lower, upper)
+        return bounded_pod_call(lambda: (
+            broadcast_job(self.data, lower, upper),
+            self.inner.search(lower, upper))[1])
 
     def search_until(self, lower: int, upper: int, target: int):
         if not target:
@@ -151,8 +203,9 @@ class PodSearcher:
             # sequence; route it explicitly — 0 can never qualify, so the
             # arg-min with found=False is the exact same answer.
             return (*self.search(lower, upper), False)
-        broadcast_job(self.data, lower, upper, target)
-        return self.inner.search_until(lower, upper, target)
+        return bounded_pod_call(lambda: (
+            broadcast_job(self.data, lower, upper, target),
+            self.inner.search_until(lower, upper, target))[1])
 
 
 def run_follower(batch: Optional[int] = None,
@@ -187,18 +240,21 @@ def run_follower(batch: Optional[int] = None,
         try:
             # Result replicated; the owner reports it. The until host loop
             # branches only on replicated values, keeping hosts in lockstep.
+            # bounded_pod_call enforces the failure-domain bound: a peer
+            # dying mid-collective wedges this search, and the bound
+            # converts the wedge into process death (r4; was a comment-only
+            # claim before).
             if target:
-                s.search_until(lower, upper, target)
+                bounded_pod_call(
+                    lambda: s.search_until(lower, upper, target))
             else:
-                s.search(lower, upper)
+                bounded_pod_call(lambda: s.search(lower, upper))
         except Exception:
             # Failure symmetry (round-3 review): a deterministic compute
             # error raises on EVERY host (same program); the owner's
-            # MinerWorker catches it and answers the sentinel, so the
-            # follower must survive and rejoin the next broadcast rather
-            # than die and deadlock the owner. (A host-asymmetric failure
-            # mid-collective is not recoverable at this layer — that is
-            # the distributed runtime's fault domain.)
+            # MinerWorker catches it and exits the pool, so the follower
+            # must survive and rejoin the next broadcast rather than die
+            # and deadlock the owner.
             logger.exception("follower search failed for %r [%d, %d]",
                              data, lower, upper)
         jobs += 1

@@ -1,7 +1,8 @@
 """Echo client runner — interactive LSP exerciser.
 
 Flag-compatible with the reference binary (ref: crunner/crunner.go:16-81):
-``--host --port --rdrop --wdrop --elim --ems --wsize --maxbackoff -v``.
+``--host --port --rdrop --wdrop --elim --ems --wsize --maxbackoff -v``,
+plus Go ``flag`` spellings (``-port=9999``; see srunner.normalize_go_flags).
 Reads whitespace-separated tokens from stdin, echoes each through the server.
 """
 
@@ -13,7 +14,7 @@ import sys
 from .. import lspnet
 from ..lsp.client import new_async_client
 from ..lsp.errors import LspError
-from .srunner import build_parser, params_from_args
+from .srunner import build_parser, normalize_go_flags, params_from_args
 
 
 async def run_client(args) -> None:
@@ -56,7 +57,7 @@ def main(argv=None) -> int:
     parser = build_parser("crunner")
     parser.add_argument("--host", type=str, default="127.0.0.1",
                         help="server host address")
-    args = parser.parse_args(argv)
+    args = parser.parse_args(normalize_go_flags(argv, parser))
     if args.v:
         lspnet.enable_debug_logs(True)
     try:

@@ -50,6 +50,76 @@ def _spawn(args, extra_env, log_path=None):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
+def test_follower_death_mid_job_bounded_and_chunk_reexecutes(tmp_path):
+    """VERDICT r3 task 7: kill the follower mid-job. The owner's wedged
+    collective must be BOUNDED (bounded_pod_call: DBM_POD_TIMEOUT_S then
+    process exit), the scheduler must declare the pod-miner lost and
+    re-execute its chunk on the surviving plain miner, and the client must
+    still receive the bit-exact Result (recovery contract:
+    ref bitcoin/server/server.go:326-376)."""
+    lsp_port = _free_udp_port()
+    coord_port = _free_tcp_port()
+    pkg = "distributed_bitcoinminer_tpu.apps"
+    lsp_env = {"DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
+               "DBM_WINDOW": "5", "JAX_PLATFORMS": "cpu"}
+    pod_env = {
+        **lsp_env,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "DBM_COORDINATOR": f"127.0.0.1:{coord_port}",
+        "DBM_NUM_PROCS": "2",
+        # Tiny batch = the pod grinds its job slowly, guaranteeing the
+        # kill lands mid-job; the bound then fires well inside the test.
+        "DBM_BATCH": "64",
+        "DBM_POD_TIMEOUT_S": "20",
+    }
+    server = _spawn([f"{pkg}.server", str(lsp_port)], lsp_env,
+                    log_path=tmp_path / "server.log")
+    owner = follower = spare = client = None
+    try:
+        time.sleep(1.0)
+        owner = _spawn([f"{pkg}.miner", f"127.0.0.1:{lsp_port}"],
+                       {**pod_env, "DBM_PROC_ID": "0"},
+                       log_path=tmp_path / "owner.log")
+        follower = _spawn([f"{pkg}.miner", f"127.0.0.1:{lsp_port}"],
+                          {**pod_env, "DBM_PROC_ID": "1"},
+                          log_path=tmp_path / "follower.log")
+        # Submit FIRST: the request queues until the pod joins, so the
+        # pod — the only miner — owns the whole job when the kill lands
+        # (spawning a spare up front raced the slow pod join and handed
+        # the spare the entire range, leaving the pod idle and unbounded).
+        client = _spawn(
+            [f"{pkg}.client", f"127.0.0.1:{lsp_port}", "drill", "1999999"],
+            lsp_env)
+        time.sleep(12.0)  # pod init + join + job broadcast + collective
+        follower.kill()
+        follower.wait()
+        # NOW the rescue miner joins; it inherits the chunk once the
+        # owner's bound fires and the scheduler declares the pod lost.
+        spare = _spawn([f"{pkg}.miner", f"127.0.0.1:{lsp_port}"],
+                       {**lsp_env, "DBM_COMPUTE": "host"},
+                       log_path=tmp_path / "spare.log")
+        out, err = client.communicate(timeout=240)
+        want_hash, want_nonce = scan_min("drill", 0, 2000000)  # +1 ref quirk
+        assert out.strip() == f"Result {want_hash} {want_nonce}", (
+            out, err, (tmp_path / "owner.log").read_text()[-800:])
+        # The owner must have EXITED — wait() raises TimeoutExpired if it
+        # is still alive and wedged — and specifically through
+        # bounded_pod_call's hard exit (17): the distributed runtime's own
+        # heartbeat failure path takes ~100 s, well past this 20 s bound,
+        # so the bound must be what fired.
+        try:
+            rc = owner.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            raise AssertionError("owner still alive (wedged) — the pod "
+                                 "timeout bound never fired")
+        assert rc == 17, (rc, (tmp_path / "owner.log").read_text()[-800:])
+    finally:
+        for proc in (client, spare, follower, owner, server):
+            if proc is not None:
+                proc.kill()
+                proc.wait()
+
+
 def test_pod_joins_as_one_miner_and_matches_oracle(tmp_path):
     lsp_port = _free_udp_port()
     coord_port = _free_tcp_port()
