@@ -113,8 +113,11 @@ class AsyncClient:
 
 
 async def new_async_client(hostport: str, params: Optional[Params] = None) -> AsyncClient:
-    """Connect to an LSP server; raises ConnectTimeout after EpochLimit epochs."""
-    host, _, port = hostport.rpartition(":")
+    """Connect to an LSP server; raises ConnectTimeout after EpochLimit
+    epochs. ``hostport`` is parsed with Go ``net.SplitHostPort`` semantics
+    (incl. bracketed IPv6 literals, ref: lspnet/net.go:86-89)."""
+    from ..lspnet import split_host_port
+    host, port = split_host_port(hostport)
     client = AsyncClient()
     await client._connect(host or "127.0.0.1", int(port), params or Params())
     return client

@@ -17,7 +17,8 @@ from .faults import (
     reset_drop_percent, reset_all_faults, enable_debug_logs,
 )
 from .sniff import start_sniff, stop_sniff, SniffResult
-from .net import UDPEndpoint, listen_udp, dial_udp
+from .net import (UDPEndpoint, listen_udp, dial_udp, join_host_port,
+                  split_host_port)
 
 __all__ = [
     "set_read_drop_percent", "set_write_drop_percent",
@@ -28,4 +29,5 @@ __all__ = [
     "reset_drop_percent", "reset_all_faults", "enable_debug_logs",
     "start_sniff", "stop_sniff", "SniffResult",
     "UDPEndpoint", "listen_udp", "dial_udp",
+    "join_host_port", "split_host_port",
 ]

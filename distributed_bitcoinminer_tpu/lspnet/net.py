@@ -24,6 +24,47 @@ from .faults import DELAY_MILLIS, knobs, log, sometimes
 from . import sniff
 
 
+def join_host_port(host: str, port: str | int) -> str:
+    """Go ``net.JoinHostPort`` semantics (ref: lspnet/net.go:81-84): a
+    host containing a colon (IPv6 literal) is bracketed."""
+    if ":" in host:
+        return f"[{host}]:{port}"
+    return f"{host}:{port}"
+
+
+def split_host_port(hostport: str) -> tuple[str, str]:
+    """Go ``net.SplitHostPort`` semantics (ref: lspnet/net.go:86-89):
+    ``host:port`` / ``[ipv6]:port`` -> (host, port); malformed input
+    raises ValueError with Go's diagnostic phrasing. An empty host is
+    allowed (``:6060`` means all interfaces / localhost by context),
+    exactly as in Go.
+    """
+    if hostport.startswith("["):
+        end = hostport.find("]")
+        if end < 0:
+            raise ValueError(f"address {hostport}: missing ']' in address")
+        host = hostport[1:end]
+        rest = hostport[end + 1:]
+        if not rest.startswith(":"):
+            raise ValueError(f"address {hostport}: missing port in address")
+        port = rest[1:]
+        if ":" in port:
+            raise ValueError(
+                f"address {hostport}: too many colons in address")
+    else:
+        host, sep, port = hostport.partition(":")
+        if not sep:
+            raise ValueError(f"address {hostport}: missing port in address")
+        if ":" in host or ":" in port:
+            raise ValueError(
+                f"address {hostport}: too many colons in address")
+    for ch, msg in (("[", "unexpected '[' in address"),
+                    ("]", "unexpected ']' in address")):
+        if ch in host or ch in port:
+            raise ValueError(f"address {hostport}: {msg}")
+    return host, port
+
+
 def _mutate_data_packet(data: bytes, obj: dict) -> bytes:
     """Apply shorten/lengthen/corrupt to a Data message (ref: lspnet/conn.go:143-175).
 

@@ -20,7 +20,10 @@ from .srunner import build_parser, normalize_go_flags, params_from_args
 async def run_client(args) -> None:
     lspnet.set_client_read_drop_percent(args.rdrop)
     lspnet.set_client_write_drop_percent(args.wdrop)
-    hostport = f"{args.host}:{args.port}"
+    # join_host_port brackets IPv6 literals, matching the client's
+    # Go-strict split_host_port (--host ::1 would otherwise read as
+    # "too many colons").
+    hostport = lspnet.join_host_port(args.host, args.port)
     print(f"Connecting to server at '{hostport}'...", flush=True)
     try:
         client = await new_async_client(hostport, params_from_args(args))

@@ -167,3 +167,53 @@ def test_sniffer_counts_sent_and_dropped():
         server.close()
         client.close()
     asyncio.run(scenario())
+
+
+def test_host_port_helpers_match_go_net_semantics():
+    """join/split_host_port mirror Go's net.JoinHostPort/SplitHostPort
+    (ref: lspnet/net.go:81-89), incl. bracketed IPv6 literals and Go's
+    error phrasing for malformed addresses."""
+    import pytest
+
+    assert lspnet.join_host_port("localhost", 6060) == "localhost:6060"
+    assert lspnet.join_host_port("::1", "80") == "[::1]:80"
+    assert lspnet.split_host_port("localhost:6060") == ("localhost", "6060")
+    assert lspnet.split_host_port(":6060") == ("", "6060")
+    assert lspnet.split_host_port("[::1]:80") == ("::1", "80")
+    # Round trip.
+    for host, port in (("127.0.0.1", "9999"), ("fe80::2", "1")):
+        assert lspnet.split_host_port(
+            lspnet.join_host_port(host, port)) == (host, port)
+    for bad, phrase in [
+            ("localhost", "missing port"),
+            ("[::1]", "missing port"),
+            ("::1:80", "too many colons"),
+            ("[::1:80", "missing ']'"),
+            ("host]:1", "unexpected ']'"),
+            ("[ho[st]:1", "unexpected '['")]:
+        with pytest.raises(ValueError, match="address .*" + phrase.replace(
+                "[", r"\[").replace("]", r"\]").replace("'", "'")):
+            lspnet.split_host_port(bad)
+
+
+def test_client_accepts_bracketed_and_plain_hostports():
+    """new_async_client parses via split_host_port: a plain host:port
+    connects; a malformed address raises ValueError immediately (not a
+    connect timeout)."""
+    import pytest
+    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+    from distributed_bitcoinminer_tpu.lsp.params import Params
+    from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+
+    async def scenario():
+        server = await new_async_server(0, Params(epoch_millis=100))
+        client = await new_async_client(f"127.0.0.1:{server.port}",
+                                        Params(epoch_millis=100))
+        client.write(b"ping")
+        conn_id, payload = await asyncio.wait_for(server.read(), 5)
+        assert payload == b"ping"
+        await client.close()
+        await server.close()
+        with pytest.raises(ValueError):
+            await new_async_client("no-port-here", Params())
+    asyncio.run(scenario())
