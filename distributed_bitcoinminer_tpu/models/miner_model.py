@@ -91,6 +91,9 @@ class NonceSearcher:
             raise ValueError(f"unknown compute tier {self.tier!r}")
         self._prefix = data.encode("utf-8") + b" "
         self._midstate_cache: dict[str, tuple] = {}
+        #: Sticky fallback: pallas until-tier failed to lower/run once ->
+        #: this searcher serves difficulty mode from the jnp tier.
+        self._until_degraded = False
 
     def _plan_block(self, d: int, k: int, block_base: int, lo: int, hi: int) -> _BlockPlan:
         top = str(block_base)[: d - k] if d > k else ""
@@ -231,16 +234,41 @@ class NonceSearcher:
         ``(found, f_idx, best_hi, best_lo, best_idx)`` of
         :func:`ops.search.search_span_until` (the qualifying HASH is
         recomputed by ``_until_block`` with the host oracle — one shared
-        contract for both tiers)."""
-        if self.tier == "pallas":
+        contract for both tiers). Both tiers early-exit inside the
+        dispatch: the jnp tier per while_loop batch, the pallas tier per
+        grid step via the SMEM found-flag skip (r4), so even the largest
+        pow2 sub costs only ~one step of compute past the first hit."""
+        if self.tier == "pallas" and not self._until_degraded:
+            import jax
+
             from ..ops.sha256_pallas import pallas_until
 
-            return pallas_until(
-                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-                np.uint32(t_hi), np.uint32(t_lo),
-                rem=plan.rem, k=plan.k, total=self.batch * nbatches,
-                platform=self._platform())
+            try:
+                # Forced HERE, not in _until_block: dispatch is async, so
+                # a runtime kernel fault would otherwise surface at the
+                # caller's device_get, outside this fallback (the block
+                # forces per sub anyway — no overlap is lost).
+                return jax.device_get(pallas_until(
+                    np.asarray(plan.midstate, dtype=np.uint32),
+                    plan.template,
+                    np.uint32(i0), np.uint32(plan.lo_i),
+                    np.uint32(plan.hi_i),
+                    np.uint32(t_hi), np.uint32(t_lo),
+                    rem=plan.rem, k=plan.k, total=self.batch * nbatches,
+                    platform=self._platform()))
+            except Exception:
+                # Tier degradation, not a miner death: a Mosaic lowering
+                # regression in the until kernel (its SMEM-flag skip is a
+                # newer construct than the battle-tested argmin kernel)
+                # must not take difficulty mode down with it — the jnp
+                # tier answers the identical contract. Sticky per
+                # searcher so one block's failure doesn't retry the
+                # broken lowering for every sub of every later block.
+                import logging
+                logging.getLogger("dbm.model").exception(
+                    "pallas until tier failed; degrading this searcher "
+                    "to the jnp until tier")
+                self._until_degraded = True
         return search_span_until(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
@@ -254,9 +282,8 @@ class NonceSearcher:
         globally is the first sub's first hit. Returns host ints
         ``(found, f_hash, f_idx, best_hi, best_lo, best_idx)`` — f_hash is
         recomputed from the host oracle (the device tiers report only the
-        qualifying INDEX: a pallas grid has no per-batch early exit, so
-        carrying hash accumulators buys nothing, and one host sha256 is
-        exact and free at this frequency)."""
+        qualifying INDEX; one host sha256 is exact and free at this
+        frequency)."""
         import jax
 
         sent = (*_SENTINEL, 0xFFFFFFFF)

@@ -56,10 +56,29 @@ class ShardedNonceSearcher(NonceSearcher):
         """Sharded difficulty-target sub-dispatch (VERDICT r2 task 6): each
         device early-exits on its own contiguous span; the collective merge
         preserves the global first-qualifying-nonce rule (see
-        ``parallel.mesh_search.sharded_search_span_until``)."""
+        ``parallel.mesh_search.sharded_search_span_until``). Same sticky
+        pallas->jnp until-tier degradation as the single-device model
+        (miner_model._until_sub): a lowering failure in the newer
+        SMEM-flag kernel must not take difficulty mode down."""
+        import jax
+
         i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
-        return sharded_search_span_until(
-            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-            i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo,
-            mesh=self.mesh, rem=plan.rem, k=plan.k,
-            batch=self.batch, nbatches=nbatches, tier=self.tier)
+        tier = "jnp" if self._until_degraded else self.tier
+        try:
+            # Forced here so a runtime kernel fault lands inside this
+            # fallback, not at the caller's device_get (see
+            # miner_model._until_sub).
+            return jax.device_get(sharded_search_span_until(
+                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo,
+                mesh=self.mesh, rem=plan.rem, k=plan.k,
+                batch=self.batch, nbatches=nbatches, tier=tier))
+        except Exception:
+            if tier != "pallas":
+                raise
+            import logging
+            logging.getLogger("dbm.model").exception(
+                "sharded pallas until tier failed; degrading this "
+                "searcher to the jnp until tier")
+            self._until_degraded = True
+            return self._until_sub(plan, i0, nbatches, t_hi, t_lo)

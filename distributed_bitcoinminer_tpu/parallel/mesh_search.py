@@ -124,8 +124,9 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
     early-exiting :func:`span_until_body` (the ``while_loop`` predicate is
     device-varying, so a device stops at ITS first qualifying batch
     independently; no collectives ride inside the loop), the pallas tier
-    with the Mosaic kernel's qualifying-index accumulator (whole-span
-    scan; callers early-exit between sub-dispatches instead). The merge
+    with the Mosaic kernel's qualifying-index accumulator plus its
+    per-grid-step SMEM found-flag skip (r4): a device that hits early
+    spends ~one step of compute on the rest of its span. The merge
     preserves the first-qualifying-nonce rule globally: spans are
     contiguous and disjoint and each device's hit is the minimal
     qualifying nonce of its span, so the global first hit is the ``pmin``

@@ -98,6 +98,48 @@ class TestScanUntilOracles:
             native.scan_until_native("x", 5, 3, 1 << 60)
 
 
+class TestUntilTierDegradation:
+    """A pallas until-tier failure (e.g. a Mosaic lowering regression in
+    the SMEM-flag early-exit kernel, which is newer than the argmin
+    kernel) must degrade the searcher to the jnp until tier — exact same
+    contract — instead of killing difficulty mode."""
+
+    def test_single_device_degrades_and_stays_exact(self, monkeypatch):
+        from distributed_bitcoinminer_tpu.ops import sha256_pallas
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic Mosaic lowering failure")
+        monkeypatch.setattr(sha256_pallas, "pallas_until", boom)
+        s = NonceSearcher("degrade", batch=128, tier="pallas")
+        target = 1 << 58
+        assert s.search_until(0, 2999, target) == \
+            first_below("degrade", 0, 2999, target)
+        assert s._until_degraded
+        # Argmin path is untouched by the degradation flag.
+        assert s.search(0, 499) == scan_min("degrade", 0, 499)
+
+    def test_sharded_degrades_and_stays_exact(self, monkeypatch):
+        from distributed_bitcoinminer_tpu.parallel import mesh_search
+
+        real = mesh_search.sharded_search_span_until
+        calls = {"pallas": 0}
+
+        def flaky(*a, **k):
+            if k.get("tier") == "pallas":
+                calls["pallas"] += 1
+                raise RuntimeError("synthetic Mosaic lowering failure")
+            return real(*a, **k)
+        # Patch at the module models.sharded imports from.
+        import distributed_bitcoinminer_tpu.models.sharded as sharded_mod
+        monkeypatch.setattr(sharded_mod, "sharded_search_span_until", flaky)
+        s = ShardedNonceSearcher("degrade", batch=64, tier="pallas")
+        target = 1 << 58
+        assert s.search_until(0, 2999, target) == \
+            first_below("degrade", 0, 2999, target)
+        assert s._until_degraded
+        assert calls["pallas"] == 1  # sticky: no per-sub retry storm
+
+
 class UntilOracleSearcher:
     """Host-oracle searcher speaking the until protocol (optionally slow),
     standing in for a TPU miner in cluster tests."""
