@@ -227,6 +227,39 @@ def main() -> int:
 
     best_tier = max(results, key=lambda t: results[t]["rate"])
     best = results[best_tier]
+
+    # Difficulty mode on the winning tier: time-to-first-hit at a ~2^-8
+    # per-nonce target over the SAME range. With the in-kernel early exit
+    # this must not scale with the range — it measures dispatch latency +
+    # ~one batch of compute. Isolated: a failure here never touches the
+    # headline number. Warm with an unreachable target (full scan) so the
+    # timed run reuses the compiled signature.
+    until_detail = {}
+    try:
+        from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+        u_searcher = build(best_tier)
+        target_log2 = 56               # ~2^-8 hit chance per nonce
+        target = 1 << target_log2
+        u_searcher.search_until(lower, upper, 0)   # warm; 0 never hits
+        with Timer() as t:
+            u_hash, u_nonce, u_found = u_searcher.search_until(
+                lower, upper, target)
+        if u_found:
+            # Exactness gate: the host oracle up to the reported hit must
+            # agree this is the FIRST qualifying nonce.
+            assert scan_until(data, lower, u_nonce, target) == \
+                (u_hash, u_nonce, True), "until gate failed"
+        until_detail = {"until_ttfh_s": round(t.seconds, 4),
+                        "until_found": bool(u_found),
+                        "until_target_log2": target_log2}
+        # Auditability: a pallas searcher that silently degraded to the
+        # jnp until tier must be visible in the recorded JSON, not only
+        # in a log line.
+        if getattr(u_searcher, "_until_degraded", False):
+            until_detail["until_degraded_to_jnp"] = True
+    except Exception as exc:  # noqa: BLE001
+        until_detail = {"until_error": repr(exc)[:200]}
+
     _emit(best["rate"], {
         "tier": best_tier,
         "devices": len(devices),
@@ -240,6 +273,7 @@ def main() -> int:
         # The SURVEY §7 waterfall: sequential vs dispatch-pipelined rates.
         "overlapped": {t: r["overlapped_rate"] for t, r in results.items()
                        if "overlapped_rate" in r},
+        **until_detail,
         **({"tier_errors": errors} if errors else {}),
         **({"probe": probe} if force_cpu else {}),
     })
