@@ -37,14 +37,31 @@ _build_failed = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _LIB]
+    # -mno-avx512f: -march=native on this image's VM advertises AVX-512,
+    # but every EVEX-encoded instruction the auto-vectorizer then emits
+    # traps to the hypervisor (~µs each) — measured 0.13 M nonces/s vs
+    # 16 M with the flag (round 4). The SHA-NI intrinsics are SSE-encoded
+    # and unaffected. Retried without the flag for toolchains that
+    # reject it.
+    base = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+            _SRC, "-o", _LIB]
+    cmd = base[:2] + ["-mno-avx512f"] + base[2:]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode == 0:
+            return True
+        # Retry flagless ONLY for a toolchain that rejects the flag —
+        # a real compile failure would just fail identically twice and
+        # bury its own diagnostic (code-review r4).
+        if b"mno-avx512f" in proc.stderr:
+            proc = subprocess.run(base, capture_output=True, timeout=120)
+            if proc.returncode == 0:
+                return True
+        logger.info("native build failed (%s); falling back to Python",
+                    proc.stderr.decode(errors="replace")[-300:])
     except (OSError, subprocess.SubprocessError) as exc:
         logger.info("native build failed (%s); falling back to Python", exc)
-        return False
+    return False
 
 
 def load() -> Optional[ctypes.CDLL]:

@@ -141,22 +141,119 @@ void compress_ni(uint32_t state[8], const uint8_t block[64]) {
   _mm_storeu_si128(reinterpret_cast<__m128i*>(&state[4]), S1);
 }
 
+// Two-message interleaved compression: the sha256rnds2 dependency chain
+// (latency ~4 cycles, throughput ~1/cycle) leaves the unit mostly idle on
+// a single chain; alternating rounds of two INDEPENDENT messages nearly
+// doubles throughput. Register budget: ~8 xmm per chain = the full
+// 16-register file, which is why this stops at 2-way.
+void compress2_ni(uint32_t state_a[8], const uint8_t block_a[64],
+                  uint32_t state_b[8], const uint8_t block_b[64]) {
+  const __m128i SHUF = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
+                                      0x0405060700010203ULL);
+#define LOAD_STATE(st, S0, S1, SAVE0, SAVE1)                              \
+  __m128i TMP##S0 = _mm_loadu_si128(                                      \
+      reinterpret_cast<const __m128i*>(&(st)[0]));                        \
+  __m128i S1 = _mm_loadu_si128(                                           \
+      reinterpret_cast<const __m128i*>(&(st)[4]));                        \
+  TMP##S0 = _mm_shuffle_epi32(TMP##S0, 0xB1);                             \
+  S1 = _mm_shuffle_epi32(S1, 0x1B);                                       \
+  __m128i S0 = _mm_alignr_epi8(TMP##S0, S1, 8);                           \
+  S1 = _mm_blend_epi16(S1, TMP##S0, 0xF0);                                \
+  const __m128i SAVE0 = S0, SAVE1 = S1;
+  LOAD_STATE(state_a, A0, A1, ASAVE0, ASAVE1)
+  LOAD_STATE(state_b, B0, B1, BSAVE0, BSAVE1)
+#undef LOAD_STATE
+
+#define LOAD_MSG(block, M0, M1, M2, M3)                                   \
+  __m128i M0 = _mm_shuffle_epi8(_mm_loadu_si128(                          \
+      reinterpret_cast<const __m128i*>((block) + 0)), SHUF);              \
+  __m128i M1 = _mm_shuffle_epi8(_mm_loadu_si128(                          \
+      reinterpret_cast<const __m128i*>((block) + 16)), SHUF);             \
+  __m128i M2 = _mm_shuffle_epi8(_mm_loadu_si128(                          \
+      reinterpret_cast<const __m128i*>((block) + 32)), SHUF);             \
+  __m128i M3 = _mm_shuffle_epi8(_mm_loadu_si128(                          \
+      reinterpret_cast<const __m128i*>((block) + 48)), SHUF);
+  LOAD_MSG(block_a, MA0, MA1, MA2, MA3)
+  LOAD_MSG(block_b, MB0, MB1, MB2, MB3)
+#undef LOAD_MSG
+  __m128i MSG;
+
+  // Same group schedule as compress_ni's QROUND, issued for chain A then
+  // chain B each group so the two rnds2 chains overlap in the pipeline.
+#define QROUND2(S0, S1, Mc, Mp, Mn, g, do_msg2, do_msg1)                  \
+  MSG = _mm_add_epi32(                                                    \
+      Mc, _mm_set_epi64x(                                                 \
+              (uint64_t(K[4 * (g) + 3]) << 32) | K[4 * (g) + 2],          \
+              (uint64_t(K[4 * (g) + 1]) << 32) | K[4 * (g)]));            \
+  S1 = _mm_sha256rnds2_epu32(S1, S0, MSG);                                \
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);                                     \
+  S0 = _mm_sha256rnds2_epu32(S0, S1, MSG);                                \
+  if (do_msg2) {                                                          \
+    Mn = _mm_add_epi32(Mn, _mm_alignr_epi8(Mc, Mp, 4));                   \
+    Mn = _mm_sha256msg2_epu32(Mn, Mc);                                    \
+  }                                                                       \
+  if (do_msg1) Mp = _mm_sha256msg1_epu32(Mp, Mc);
+
+#define GROUP2(ca, pa, na, cb, pb, nb, g, do2, do1)                       \
+  QROUND2(A0, A1, ca, pa, na, g, do2, do1)                                \
+  QROUND2(B0, B1, cb, pb, nb, g, do2, do1)
+
+  GROUP2(MA0, MA3, MA1, MB0, MB3, MB1, 0, 0, 0)
+  GROUP2(MA1, MA0, MA2, MB1, MB0, MB2, 1, 0, 1)
+  GROUP2(MA2, MA1, MA3, MB2, MB1, MB3, 2, 0, 1)
+  GROUP2(MA3, MA2, MA0, MB3, MB2, MB0, 3, 1, 1)
+  GROUP2(MA0, MA3, MA1, MB0, MB3, MB1, 4, 1, 1)
+  GROUP2(MA1, MA0, MA2, MB1, MB0, MB2, 5, 1, 1)
+  GROUP2(MA2, MA1, MA3, MB2, MB1, MB3, 6, 1, 1)
+  GROUP2(MA3, MA2, MA0, MB3, MB2, MB0, 7, 1, 1)
+  GROUP2(MA0, MA3, MA1, MB0, MB3, MB1, 8, 1, 1)
+  GROUP2(MA1, MA0, MA2, MB1, MB0, MB2, 9, 1, 1)
+  GROUP2(MA2, MA1, MA3, MB2, MB1, MB3, 10, 1, 1)
+  GROUP2(MA3, MA2, MA0, MB3, MB2, MB0, 11, 1, 1)
+  GROUP2(MA0, MA3, MA1, MB0, MB3, MB1, 12, 1, 1)
+  GROUP2(MA1, MA0, MA2, MB1, MB0, MB2, 13, 1, 0)
+  GROUP2(MA2, MA1, MA3, MB2, MB1, MB3, 14, 1, 0)
+  GROUP2(MA3, MA2, MA0, MB3, MB2, MB0, 15, 0, 0)
+#undef GROUP2
+#undef QROUND2
+
+#define STORE_STATE(st, S0, S1, SAVE0, SAVE1)                             \
+  S0 = _mm_add_epi32(S0, SAVE0);                                          \
+  S1 = _mm_add_epi32(S1, SAVE1);                                          \
+  {                                                                       \
+    __m128i T = _mm_shuffle_epi32(S0, 0x1B);                              \
+    S1 = _mm_shuffle_epi32(S1, 0xB1);                                     \
+    S0 = _mm_blend_epi16(T, S1, 0xF0);                                    \
+    S1 = _mm_alignr_epi8(S1, T, 8);                                       \
+  }                                                                       \
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&(st)[0]), S0);             \
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&(st)[4]), S1);
+  STORE_STATE(state_a, A0, A1, ASAVE0, ASAVE1)
+  STORE_STATE(state_b, B0, B1, BSAVE0, BSAVE1)
+#undef STORE_STATE
+}
+
 inline void compress(uint32_t state[8], const uint8_t block[64]) {
   compress_ni(state, block);
+}
+inline void compress2(uint32_t sa[8], const uint8_t ba[64],
+                      uint32_t sb[8], const uint8_t bb[64]) {
+  compress2_ni(sa, ba, sb, bb);
 }
 #else
 inline void compress(uint32_t state[8], const uint8_t block[64]) {
   compress_portable(state, block);
 }
+inline void compress2(uint32_t sa[8], const uint8_t ba[64],
+                      uint32_t sb[8], const uint8_t bb[64]) {
+  compress_portable(sa, ba);
+  compress_portable(sb, bb);
+}
 #endif
 
-// Hash prefix-midstate + tail (tail_len < 64 + up to 20 digit bytes), return
-// big-endian uint64 of digest[0:8]. total_len in bytes.
-uint64_t finish(const uint32_t mid[8], const uint8_t* tail, int tail_len,
-                uint64_t total_len) {
-  uint32_t st[8];
-  std::memcpy(st, mid, sizeof(st));
-  uint8_t buf[128];
+// Build the padded tail block(s) (1 or 2 x 64 bytes); returns nblocks.
+int pad_tail(uint8_t buf[128], const uint8_t* tail, int tail_len,
+             uint64_t total_len) {
   std::memcpy(buf, tail, tail_len);
   buf[tail_len] = 0x80;
   int nblocks = (tail_len + 1 + 8 <= 64) ? 1 : 2;
@@ -165,9 +262,45 @@ uint64_t finish(const uint32_t mid[8], const uint8_t* tail, int tail_len,
   uint64_t bits = total_len * 8;
   for (int j = 0; j < 8; ++j)
     buf[padded - 1 - j] = uint8_t(bits >> (8 * j));
+  return nblocks;
+}
+
+// Hash prefix-midstate + tail (tail_len < 64 + up to 20 digit bytes), return
+// big-endian uint64 of digest[0:8]. total_len in bytes.
+uint64_t finish(const uint32_t mid[8], const uint8_t* tail, int tail_len,
+                uint64_t total_len) {
+  uint32_t st[8];
+  std::memcpy(st, mid, sizeof(st));
+  uint8_t buf[128];
+  int nblocks = pad_tail(buf, tail, tail_len, total_len);
   compress(st, buf);
   if (nblocks == 2) compress(st, buf + 64);
   return (uint64_t(st[0]) << 32) | uint64_t(st[1]);
+}
+
+// Two tails from the SAME midstate, hashed as interleaved chains (the
+// scan's hot pair path). Tail lengths may differ (digit rollover inside a
+// pair); unequal BLOCK counts (one message crossing the 64-byte pad
+// boundary the other doesn't) fall back to two scalar finishes.
+void finish2(const uint32_t mid[8],
+             const uint8_t* tail_a, int len_a, uint64_t total_a,
+             const uint8_t* tail_b, int len_b, uint64_t total_b,
+             uint64_t* out_a, uint64_t* out_b) {
+  uint8_t buf_a[128], buf_b[128];
+  int na = pad_tail(buf_a, tail_a, len_a, total_a);
+  int nb = pad_tail(buf_b, tail_b, len_b, total_b);
+  if (na != nb) {
+    *out_a = finish(mid, tail_a, len_a, total_a);
+    *out_b = finish(mid, tail_b, len_b, total_b);
+    return;
+  }
+  uint32_t sa[8], sb[8];
+  std::memcpy(sa, mid, sizeof(sa));
+  std::memcpy(sb, mid, sizeof(sb));
+  for (int j = 0; j < na; ++j)
+    compress2(sa, buf_a + 64 * j, sb, buf_b + 64 * j);
+  *out_a = (uint64_t(sa[0]) << 32) | uint64_t(sa[1]);
+  *out_b = (uint64_t(sb[0]) << 32) | uint64_t(sb[1]);
 }
 
 // The one scan loop behind every extern entry point. Ascending over
@@ -204,6 +337,8 @@ int scan_until_core(const char* data, uint64_t data_len, uint64_t lower,
   uint8_t tail[64 + 24];
   for (int j = 0; j < rem; ++j)
     tail[j] = uint8_t(full + j < data_len ? data[full + j] : ' ');
+  uint8_t tail2[64 + 24];
+  std::memcpy(tail2, tail, rem);
 
   // Incremental ASCII decimal counter for the nonce digits.
   uint8_t digits[24];
@@ -216,31 +351,8 @@ int scan_until_core(const char* data, uint64_t data_len, uint64_t lower,
   for (int i = 0; i < nd / 2; ++i) {
     uint8_t t = digits[i]; digits[i] = digits[nd - 1 - i]; digits[nd - 1 - i] = t;
   }
-
-  uint64_t best_hash = ~uint64_t(0);
-  uint64_t best_nonce = lower;
-  for (uint64_t n = lower;; ++n) {
-    if (min_found_shard && (n & 4095) == 0 &&
-        min_found_shard->load(std::memory_order_relaxed) < my_shard) {
-      *out_hash = best_hash;
-      *out_nonce = best_nonce;
-      *out_found = 0;
-      return 1;
-    }
-    std::memcpy(tail + rem, digits, nd);
-    uint64_t h = finish(mid, tail, rem + nd, prefix_len + nd);
-    if (h < target) {
-      *out_hash = h;
-      *out_nonce = n;
-      *out_found = 1;
-      return 0;
-    }
-    if (h < best_hash) {
-      best_hash = h;
-      best_nonce = n;
-    }
-    if (n == upper) break;
-    // ++counter with decimal carry.
+  // ++counter with decimal carry.
+  auto incr = [&digits, &nd]() {
     int i = nd - 1;
     while (i >= 0 && digits[i] == '9') digits[i--] = '0';
     if (i < 0) {
@@ -250,6 +362,70 @@ int scan_until_core(const char* data, uint64_t data_len, uint64_t lower,
     } else {
       ++digits[i];
     }
+  };
+
+  // Nonce PAIRS through the interleaved two-chain compression (finish2):
+  // one sha256rnds2 chain leaves the SHA unit mostly idle on its ~4-cycle
+  // latency, so two independent chains nearly double throughput. The
+  // target check stays in ascending order — a hit on the first of a pair
+  // returns before the second is examined — so first-qualifying and
+  // earliest-tie semantics are byte-identical to the scalar loop.
+  uint64_t best_hash = ~uint64_t(0);
+  uint64_t best_nonce = lower;
+  uint64_t n = lower, iter = 0;
+  while (true) {
+    if (min_found_shard && (iter++ & 2047) == 0 &&
+        min_found_shard->load(std::memory_order_relaxed) < my_shard) {
+      *out_hash = best_hash;
+      *out_nonce = best_nonce;
+      *out_found = 0;
+      return 1;
+    }
+    std::memcpy(tail + rem, digits, nd);
+    int len_a = rem + nd;
+    uint64_t tot_a = prefix_len + nd;
+    if (n == upper) {  // odd tail of the range: one scalar hash
+      uint64_t h = finish(mid, tail, len_a, tot_a);
+      if (h < target) {
+        *out_hash = h;
+        *out_nonce = n;
+        *out_found = 1;
+        return 0;
+      }
+      if (h < best_hash) {
+        best_hash = h;
+        best_nonce = n;
+      }
+      break;
+    }
+    incr();
+    std::memcpy(tail2 + rem, digits, nd);
+    uint64_t ha, hb;
+    finish2(mid, tail, len_a, tot_a,
+            tail2, rem + nd, prefix_len + nd, &ha, &hb);
+    if (ha < target) {
+      *out_hash = ha;
+      *out_nonce = n;
+      *out_found = 1;
+      return 0;
+    }
+    if (ha < best_hash) {
+      best_hash = ha;
+      best_nonce = n;
+    }
+    if (hb < target) {
+      *out_hash = hb;
+      *out_nonce = n + 1;
+      *out_found = 1;
+      return 0;
+    }
+    if (hb < best_hash) {
+      best_hash = hb;
+      best_nonce = n + 1;
+    }
+    if (n + 1 == upper) break;
+    incr();
+    n += 2;
   }
   *out_hash = best_hash;
   *out_nonce = best_nonce;

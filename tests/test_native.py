@@ -17,7 +17,15 @@ pytestmark = pytest.mark.skipif(not native.available(),
     (123_456, 124_000),
 ])
 def test_scan_matches_oracle(lower, upper):
-    for data in ("cmu440", "", "x" * 70):  # incl. multi-block prefixes
+    # Data lengths chosen to cover every tail-block shape of the pair
+    # scan: short, empty, multi-block prefix, and the 52-56 band where
+    # rem + nd straddles the 64-byte pad boundary — there a digit
+    # rollover INSIDE a pair makes one message need two padded blocks
+    # and its partner one, exercising finish2's two-block loop and its
+    # unequal-block scalar fallback (code-review r4: previously no test
+    # reached either path).
+    for data in ("cmu440", "", "x" * 70, "x" * 52, "x" * 53, "x" * 54,
+                 "x" * 55, "x" * 56):
         assert native.scan_min_native(data, lower, upper) == \
             scan_min(data, lower, upper)
 
