@@ -134,11 +134,11 @@ def test_pod_joins_as_one_miner_and_matches_oracle(tmp_path):
         "DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
         "DBM_WINDOW": "5",
     }
-    server = _spawn([f"{pkg}.server", str(lsp_port)],
-                    {"DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
-                     "DBM_WINDOW": "5", "JAX_PLATFORMS": "cpu"},
+    lsp_env = {"DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
+               "DBM_WINDOW": "5", "JAX_PLATFORMS": "cpu"}
+    server = _spawn([f"{pkg}.server", str(lsp_port)], lsp_env,
                     log_path=tmp_path / "server.log")
-    owner = follower = client = None
+    owner = follower = client = client2 = None
     try:
         time.sleep(1.0)
         owner = _spawn([f"{pkg}.miner", f"127.0.0.1:{lsp_port}"],
@@ -150,11 +150,26 @@ def test_pod_joins_as_one_miner_and_matches_oracle(tmp_path):
         time.sleep(2.0)  # distributed init + LSP join
         client = _spawn(
             [f"{pkg}.client", f"127.0.0.1:{lsp_port}", "podjob", "20000"],
-            {"DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "60",
-             "DBM_WINDOW": "5", "JAX_PLATFORMS": "cpu"})
+            lsp_env)
         out, err = client.communicate(timeout=180)
         want_hash, want_nonce = scan_min("podjob", 0, 20001)  # +1 ref quirk
         assert out.strip() == f"Result {want_hash} {want_nonce}", (out, err)
+
+        # Difficulty job through the SAME live pod (VERDICT r3 weak #4
+        # tail): the target broadcasts as opcode 2, every host runs the
+        # lockstep search_until, and the Result is the first-qualifying
+        # nonce exactly as the host oracle sees it.
+        from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+        target = 1 << 58
+        u_want = scan_until("podjob", 0, 20001, target)
+        assert u_want[2], "test target must be reachable in the range"
+        client2 = _spawn(
+            [f"{pkg}.client", f"127.0.0.1:{lsp_port}", "podjob", "20000",
+             str(target)],
+            lsp_env)
+        out2, err2 = client2.communicate(timeout=180)
+        assert out2.strip() == f"Result {u_want[0]} {u_want[1]}", (
+            out2, err2, (tmp_path / "owner.log").read_text()[-800:])
 
         # The pod joined as ONE miner: kill the server; the owner's LSP
         # connection dies, it broadcasts stop, and BOTH pod processes exit
@@ -166,7 +181,7 @@ def test_pod_joins_as_one_miner_and_matches_oracle(tmp_path):
         assert follower.wait(timeout=60) == 0, \
             (tmp_path / "follower.log").read_text()[-800:]
     finally:
-        for proc in (client, follower, owner, server):
+        for proc in (client2, client, follower, owner, server):
             if proc is not None:
                 proc.kill()
                 proc.wait()
