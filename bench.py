@@ -30,17 +30,11 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 GO_MINER_BASELINE_NPS = 1.0e7  # upper structural estimate, BASELINE.md
 _REPO = os.path.dirname(os.path.abspath(__file__))
-
-_PROBE_CODE = (
-    "import jax, json; d = jax.devices(); "
-    "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
-)
 
 
 def _emit(value: float, detail: dict) -> None:
@@ -51,29 +45,6 @@ def _emit(value: float, detail: dict) -> None:
         "vs_baseline": round(value / GO_MINER_BASELINE_NPS, 4),
         "detail": detail,
     }), flush=True)
-
-
-def _probe_backend(timeout_s: float) -> dict:
-    """Initialize the default JAX backend in a child process with a deadline.
-
-    Returns {"platform", "n"} on success; {"error": ...} when init fails or
-    hangs (round-1 failure mode: the chip held by a timed-out process made
-    bare ``jax.devices()`` hang past the driver budget).
-    """
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_CODE],
-            capture_output=True, text=True, timeout=timeout_s,
-            cwd=_REPO,
-        )
-    except subprocess.TimeoutExpired:
-        return {"error": f"backend init exceeded {timeout_s:.0f}s deadline"}
-    if proc.returncode != 0:
-        return {"error": f"backend init failed: {proc.stderr.strip()[-400:]}"}
-    try:
-        return json.loads(proc.stdout.strip().splitlines()[-1])
-    except (ValueError, IndexError):
-        return {"error": f"unparseable probe output: {proc.stdout[-200:]}"}
 
 
 def _measure(searcher, lower: int, upper: int, min_time_s: float,
@@ -110,8 +81,9 @@ def _measure_overlapped(searcher, lower: int, upper: int, reps: int,
 
 
 def main() -> int:
+    from distributed_bitcoinminer_tpu.utils.config import probe_backend
     init_deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
-    probe = _probe_backend(init_deadline)
+    probe = probe_backend(init_deadline, _REPO)
     force_cpu = "error" in probe
 
     if force_cpu:

@@ -90,6 +90,44 @@ def jax_devices_robust():
         return jax.devices()
 
 
+def probe_backend(timeout_s: float, repo_dir: str | None = None) -> dict:
+    """Resolve the JAX backend in a CHILD process with a deadline.
+
+    Uses the SAME resolution order as the apps — ``apply_jax_platform_env``
+    then ``jax_devices_robust`` — so the reported platform is the one a
+    miner spawned in this environment would actually compute on (a probe
+    skipping ``apply_jax_platform_env`` once vouched for a chip while the
+    miner honored a ``JAX_PLATFORMS=cpu`` pin, code-review r4). A wedged
+    accelerator can never hang the caller: that is the whole point of the
+    subprocess (bench round-1 failure mode). Returns ``{"platform", "n"}``
+    or ``{"error": ...}``.
+    """
+    import json
+    import subprocess
+    import sys
+    repo = repo_dir or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    code = (
+        "import sys, json; sys.path.insert(0, %r); "
+        "from distributed_bitcoinminer_tpu.utils.config import "
+        "apply_jax_platform_env, jax_devices_robust; "
+        "apply_jax_platform_env(); d = jax_devices_robust(); "
+        "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
+        % repo)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s, cwd=repo)
+    except subprocess.TimeoutExpired:
+        return {"error": f"backend init exceeded {timeout_s:.0f}s deadline"}
+    if proc.returncode != 0:
+        return {"error": f"backend init failed: {proc.stderr.strip()[-400:]}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable probe output: {proc.stdout[-200:]}"}
+
+
 def host_cache_dir(root: str) -> str:
     """Host-fingerprinted JAX persistent-cache path under ``root`` (see
     :func:`host_fingerprint` for why the key exists)."""

@@ -29,6 +29,23 @@ def main() -> int:
     max_nonce = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 26) - 1
     data = "chip-e2e"
     env = {**os.environ, "PYTHONPATH": _REPO}
+
+    # Fast-fail on a wedged tunnel (shared probe, same app resolution
+    # order): a dead axon endpoint otherwise shows up as a confusing
+    # 5-minute client timeout — and a CPU-resolved fallback would "pass"
+    # without validating the chip path this script exists for.
+    sys.path.insert(0, _REPO)
+    from distributed_bitcoinminer_tpu.utils.config import probe_backend
+    probe = probe_backend(120, _REPO)
+    if "error" in probe:
+        print(f"chip unreachable: {probe['error']}")
+        return 2
+    if probe["platform"] not in ("tpu", "axon"):
+        print(f"chip unreachable: backend resolved to "
+              f"{probe['platform']!r}, not a TPU — refusing to run a "
+              "false chip e2e")
+        return 2
+
     procs = []
 
     def spawn(*args):
@@ -51,7 +68,6 @@ def main() -> int:
         elapsed = time.time() - t0
         line = out.stdout.strip().splitlines()[-1] if out.stdout else ""
         print(f"client: {line}  ({elapsed:.1f}s incl. compile)")
-        sys.path.insert(0, _REPO)
         from distributed_bitcoinminer_tpu import native
         # The system scans [0, max_nonce+1]: the scheduler sends exclusive
         # bounds (upper += 1) but miners read Upper inclusively — the
