@@ -112,10 +112,14 @@ def main() -> int:
     from distributed_bitcoinminer_tpu.models import (
         NonceSearcher, ShardedNonceSearcher)
     from distributed_bitcoinminer_tpu.parallel import make_mesh
+    from distributed_bitcoinminer_tpu.utils.config import jax_devices_robust
     from distributed_bitcoinminer_tpu.utils.profiling import (Timer,
                                                               device_trace)
 
-    devices = jax.devices()
+    # Same resolution order as the probe child and the miners — a bare
+    # jax.devices() here could crash on the exact pin the robust probe
+    # just recovered from (code-review r4).
+    devices = jax_devices_robust()
     on_accel = devices[0].platform != "cpu"
     batch = (1 << 20) if on_accel else (1 << 13)
     # One digit class, one aligned 10^9 block geometry => ONE compile

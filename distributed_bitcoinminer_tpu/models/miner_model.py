@@ -43,8 +43,8 @@ def default_tier() -> str:
     through as an unknown tier and crash the miner's first search.)"""
     value = os.environ.get("DBM_COMPUTE", "auto").lower()
     if value in ("", "auto", "jax", "host"):
-        from ..utils.config import jax_devices_robust
-        on_chip = jax_devices_robust()[0].platform in ("tpu", "axon")
+        from ..utils.config import CHIP_PLATFORMS, jax_devices_robust
+        on_chip = jax_devices_robust()[0].platform in CHIP_PLATFORMS
         return "pallas" if on_chip else "jnp"
     return value  # 'jnp'/'pallas', or unknown -> NonceSearcher raises
 

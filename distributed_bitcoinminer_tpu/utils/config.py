@@ -26,6 +26,11 @@ from dataclasses import dataclass, field
 
 from ..lsp.params import Params
 
+#: Platform names that mean "a real chip" — the axon plugin's registered
+#: name is cwd-dependent in this image (axon vs tpu), and the miner's tier
+#: selection plus every chip gate must agree on the set.
+CHIP_PLATFORMS = ("tpu", "axon")
+
 
 def host_fingerprint() -> str:
     """12-hex CPU-feature fingerprint of this host.
@@ -107,12 +112,18 @@ def probe_backend(timeout_s: float, repo_dir: str | None = None) -> dict:
     import sys
     repo = repo_dir or os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # The child hard-exits after printing: this image's axon/jax stack
+    # can hang for minutes in interpreter-shutdown finalizers (bench.py
+    # tail, round 3), and subprocess.run waits for process EXIT — a
+    # healthy chip would otherwise be reported as a probe timeout
+    # (code-review r4).
     code = (
-        "import sys, json; sys.path.insert(0, %r); "
+        "import sys, os, json; sys.path.insert(0, %r); "
         "from distributed_bitcoinminer_tpu.utils.config import "
         "apply_jax_platform_env, jax_devices_robust; "
         "apply_jax_platform_env(); d = jax_devices_robust(); "
-        "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
+        "print(json.dumps({'platform': d[0].platform, 'n': len(d)})); "
+        "sys.stdout.flush(); os._exit(0)"
         % repo)
     try:
         proc = subprocess.run(

@@ -35,12 +35,14 @@ def main() -> int:
     # 5-minute client timeout — and a CPU-resolved fallback would "pass"
     # without validating the chip path this script exists for.
     sys.path.insert(0, _REPO)
-    from distributed_bitcoinminer_tpu.utils.config import probe_backend
-    probe = probe_backend(120, _REPO)
+    from distributed_bitcoinminer_tpu.utils.config import (CHIP_PLATFORMS,
+                                                           probe_backend)
+    deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
+    probe = probe_backend(deadline, _REPO)
     if "error" in probe:
         print(f"chip unreachable: {probe['error']}")
         return 2
-    if probe["platform"] not in ("tpu", "axon"):
+    if probe["platform"] not in CHIP_PLATFORMS:
         print(f"chip unreachable: backend resolved to "
               f"{probe['platform']!r}, not a TPU — refusing to run a "
               "false chip e2e")

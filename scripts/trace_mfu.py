@@ -172,6 +172,15 @@ def trace(span_log2: int = 29) -> dict:
     import tempfile
     import time
 
+    from distributed_bitcoinminer_tpu.utils.config import (CHIP_PLATFORMS,
+                                                           probe_backend)
+    probe = probe_backend(
+        float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300")))
+    if "error" in probe or probe.get("platform") not in CHIP_PLATFORMS:
+        report = {"error": "chip unreachable", "probe": probe}
+        print(json.dumps(report))
+        return report
+
     import jax
 
     from distributed_bitcoinminer_tpu.models import NonceSearcher
