@@ -68,7 +68,8 @@ def interpret_on(platform: str) -> bool:
     on (``mesh.devices.flat[0].platform`` / ``jax.devices()[0].platform``)
     — NOT ``jax.default_backend()``, which this image's sitecustomize can
     pin to the axon plugin while the devices in play are CPU."""
-    return platform not in ("tpu", "axon")
+    from ..utils.config import CHIP_PLATFORMS
+    return platform not in CHIP_PLATFORMS
 
 
 def pallas_argmin(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,

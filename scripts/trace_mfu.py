@@ -185,7 +185,15 @@ def trace(span_log2: int = 29) -> dict:
 
     from distributed_bitcoinminer_tpu.models import NonceSearcher
 
-    c = census()
+    # Census in a SUBPROCESS: census() pins jax_platforms='cpu'
+    # process-wide (its tracing must stay off the chip), which in this
+    # process would flip the "real chip" search below into the Mosaic
+    # interpreter (code-review r4).
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "census"],
+        capture_output=True, text=True, timeout=600)
+    c = json.loads(proc.stdout.strip().splitlines()[-1])
     searcher = NonceSearcher("cmu440", batch=1 << 20, tier="pallas")
     lo = 2_000_000_000
     hi = lo + (1 << span_log2) - 1
@@ -217,10 +225,12 @@ def trace(span_log2: int = 29) -> dict:
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "census"
+    rc = 0
     if mode == "census":
         import json
         print(json.dumps(census(), indent=2))
     else:
-        trace(int(sys.argv[2]) if len(sys.argv) > 2 else 29)
+        report = trace(int(sys.argv[2]) if len(sys.argv) > 2 else 29)
+        rc = 2 if "error" in report else 0   # match chip_e2e's contract
     sys.stdout.flush()
-    os._exit(0)
+    os._exit(rc)

@@ -1,0 +1,38 @@
+"""bench.py contract smoke: the driver scores the round from this output.
+
+Runs the real benchmark as a subprocess pinned to the CPU/host tier (fast
+and chip-independent) and asserts the one-JSON-line contract the driver
+parses, plus the round-4 difficulty detail. A regression here would not
+fail any unit test but would zero the round's recorded benchmark.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_one_valid_json_line_with_contract_fields():
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=_REPO, capture_output=True,
+        text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "DBM_COMPUTE": "host",
+             "DBM_BENCH_INIT_TIMEOUT": "60"})
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["metric"] == "nonce_search_throughput"
+    assert out["unit"] == "nonces/sec"
+    assert out["value"] > 0
+    # vs_baseline derives from the UNROUNDED rate; comparing against the
+    # rounded value needs a tolerance spanning both roundings.
+    assert abs(out["vs_baseline"] - out["value"] / 1.0e7) < 2e-4
+    detail = out["detail"]
+    assert detail["tier"] == "host"
+    # Difficulty-mode detail (round 4): measured and oracle-gated inside
+    # bench itself; a failure would surface as until_error instead.
+    assert detail.get("until_found") is True, detail
+    assert "until_ttfh_s" in detail
