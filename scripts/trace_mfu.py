@@ -193,7 +193,12 @@ def trace(span_log2: int = 29) -> dict:
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "census"],
         capture_output=True, text=True, timeout=600)
-    c = json.loads(proc.stdout.strip().splitlines()[-1])
+    if proc.returncode != 0:
+        raise RuntimeError(f"census subprocess failed:\n"
+                           f"{proc.stderr.strip()[-800:]}")
+    # The child prints one pretty-printed JSON object; parse the whole
+    # stream (a last-line parse would read just the closing brace).
+    c = json.loads(proc.stdout)
     searcher = NonceSearcher("cmu440", batch=1 << 20, tier="pallas")
     lo = 2_000_000_000
     hi = lo + (1 << span_log2) - 1
