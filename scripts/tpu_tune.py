@@ -112,6 +112,37 @@ def main() -> int:
 
     rows, nsteps = pallas_geometry(total)
     print(f"default geometry: rows={rows} nsteps={nsteps}", flush=True)
+
+    # --- 4. until-mode characterization (r4 in-kernel early exit) ---------
+    from distributed_bitcoinminer_tpu.ops.sha256_pallas import (
+        pallas_search_span_until)
+
+    def ucall(t_hi, t_lo):
+        return functools.partial(
+            pallas_search_span_until, ms, tp, np.uint32(0), np.uint32(0),
+            np.uint32(total - 1), np.uint32(t_hi), np.uint32(t_lo),
+            rem=len(tail), k=k, rows=rows, nsteps=nsteps)
+
+    # (a) miss path (target 0 never hits): the until kernel's flag
+    # bookkeeping overhead vs the plain argmin kernel above.
+    miss = ucall(0, 0)
+    jax.device_get(miss())
+    best = min(_timed(miss) for _ in range(3))
+    print(f"until miss     : {total / best / 1e6:8.1f} Mnonce/s "
+          "(flag-bookkeeping overhead vs argmin rows line)", flush=True)
+
+    # (b) hit at step 0 (all-ones target qualifies every lane): total
+    # time = dispatch + ONE compute step + (nsteps-1) skipped steps, so
+    # this bounds the skipped-step cost — the number that decides whether
+    # until-mode sub-dispatches ever need a size cap. The axon tunnel
+    # contributes a ~35-100 ms floor; with 2^29 lanes (262k steps) a
+    # 1 µs skip would show as ~0.26 s on top of it.
+    hit = ucall(0xFFFFFFFF, 0xFFFFFFFF)
+    jax.device_get(hit())
+    best = min(_timed(hit) for _ in range(3))
+    print(f"until hit@step0: {best * 1e3:8.2f} ms total over {nsteps} "
+          f"steps -> <= {best / max(1, nsteps - 1) * 1e6:.2f} us/skipped "
+          "step incl. tunnel floor", flush=True)
     return 0
 
 

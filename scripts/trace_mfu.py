@@ -231,11 +231,18 @@ def trace(span_log2: int = 29) -> dict:
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "census"
     rc = 0
-    if mode == "census":
-        import json
-        print(json.dumps(census(), indent=2))
-    else:
-        report = trace(int(sys.argv[2]) if len(sys.argv) > 2 else 29)
-        rc = 2 if "error" in report else 0   # match chip_e2e's contract
+    try:
+        if mode == "census":
+            import json
+            print(json.dumps(census(), indent=2))
+        else:
+            report = trace(int(sys.argv[2]) if len(sys.argv) > 2 else 29)
+            rc = 2 if "error" in report else 0  # match chip_e2e's contract
+    except Exception as exc:  # noqa: BLE001 — every path must reach the
+        # hard exit below: an uncaught exception after jax touched the
+        # axon backend would hang in interpreter-shutdown finalizers.
+        print(f"trace_mfu failed: {exc!r}"[:800], file=sys.stderr)
+        rc = 1
     sys.stdout.flush()
+    sys.stderr.flush()
     os._exit(rc)
