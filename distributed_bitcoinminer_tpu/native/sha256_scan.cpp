@@ -145,7 +145,12 @@ void compress_ni(uint32_t state[8], const uint8_t block[64]) {
 // (latency ~4 cycles, throughput ~1/cycle) leaves the unit mostly idle on
 // a single chain; alternating rounds of two INDEPENDENT messages nearly
 // doubles throughput. Register budget: ~8 xmm per chain = the full
-// 16-register file, which is why this stops at 2-way.
+// 16-register file, which is why this stops at 2-way. Wider interleaves
+// were measured and rejected (round 4): 3-way/4-way prototypes benched
+// 24.6-25.8 / 25.8-28.1 M hash/s vs 23.4-24.7 for 2-way on this box —
+// <= 10%, within run noise, because past two chains the spilled message
+// tiles give back most of the latency hiding; not worth the triple/quad
+// scan-loop boundary handling.
 void compress2_ni(uint32_t state_a[8], const uint8_t block_a[64],
                   uint32_t state_b[8], const uint8_t block_b[64]) {
   const __m128i SHUF = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
