@@ -134,6 +134,49 @@ def test_go_client_replay_against_our_server():
     asyncio.run(scenario())
 
 
+def load_golden(name):
+    """(golden dict, label -> bytes) from a checked-in transcript file."""
+    import os
+    with open(os.path.join(os.path.dirname(__file__), "goldens",
+                           name)) as f:
+        golden = json.load(f)
+    by_label = {e["label"]: e["bytes"].encode()
+                for e in golden["transcript"]}
+    return golden, by_label
+
+
+def golden_payload(by_label, label) -> bytes:
+    """App payload reconstructed from the golden bytes themselves."""
+    import base64
+    return base64.b64decode(json.loads(by_label[label])["Payload"])
+
+
+class TranscriptRecorder:
+    """Drift detector shared by the client/server transcript tests: every
+    observed packet must byte-equal SOME golden entry; first-occurrence
+    order and per-packet counts are kept for the scenario assertions."""
+
+    def __init__(self, peer: GoPeer, byte_set: set):
+        self.peer = peer
+        self.byte_set = byte_set
+        self.seen: list[bytes] = []
+        self.counts: dict[bytes, int] = {}
+
+    def record(self, raw: bytes) -> bytes:
+        assert raw in self.byte_set, f"unknown packet (drift): {raw!r}"
+        if raw not in self.counts:
+            self.seen.append(raw)
+        self.counts[raw] = self.counts.get(raw, 0) + 1
+        return raw
+
+    async def collect_until(self, pred, timeout=4.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not pred():
+            assert asyncio.get_running_loop().time() < deadline, \
+                (self.seen, self.counts)
+            self.record(await asyncio.to_thread(self.peer.recv))
+
+
 def test_client_transcript_matches_golden_corpus():
     """VERDICT r3 task 8: the FULL byte stream of a scripted scenario —
     connect -> window-gated writes -> backoff retransmits -> ack of server
@@ -146,55 +189,35 @@ def test_client_transcript_matches_golden_corpus():
     window must never appear before their admission acks (C1/C2/C8/C9/C10
     observables in one artifact).
     """
-    import os
-    with open(os.path.join(os.path.dirname(__file__), "goldens",
-                           "wire_transcript.json")) as f:
-        golden = json.load(f)
-    by_label = {e["label"]: e["bytes"].encode() for e in golden["transcript"]}
-    byte_set = set(by_label.values())
+    golden, by_label = load_golden("wire_transcript.json")
     params = Params(**golden["params"])
 
     async def scenario():
         peer = GoPeer()
-        seen: list[bytes] = []
-        counts: dict[bytes, int] = {}
-
-        def record(raw: bytes) -> bytes:
-            assert raw in byte_set, f"unknown packet (drift): {raw!r}"
-            if raw not in counts:
-                seen.append(raw)
-            counts[raw] = counts.get(raw, 0) + 1
-            return raw
-
-        async def collect_until(pred, timeout=3.0):
-            deadline = asyncio.get_running_loop().time() + timeout
-            while not pred():
-                assert asyncio.get_running_loop().time() < deadline, \
-                    (seen, counts)
-                record(await asyncio.to_thread(peer.recv))
+        rec = TranscriptRecorder(peer, set(by_label.values()))
 
         async def fake_go_server():
-            raw = record(await asyncio.to_thread(peer.recv))
+            raw = rec.record(await asyncio.to_thread(peer.recv))
             assert raw == by_label["connect"]
             peer.send(go_ack(1, 0))
             # Window 2 of 4 queued writes: data1+data2 flow (in order) and
             # retransmit byte-identically; data3/data4 must stay gated.
-            await collect_until(
-                lambda: counts.get(by_label["data1"], 0) >= 2
-                and counts.get(by_label["data2"], 0) >= 2, timeout=4.0)
-            assert seen.index(by_label["data1"]) < \
-                seen.index(by_label["data2"])
-            assert by_label["data3"] not in counts
-            assert by_label["data4"] not in counts
+            await rec.collect_until(
+                lambda: rec.counts.get(by_label["data1"], 0) >= 2
+                and rec.counts.get(by_label["data2"], 0) >= 2)
+            assert rec.seen.index(by_label["data1"]) < \
+                rec.seen.index(by_label["data2"])
+            assert by_label["data3"] not in rec.counts
+            assert by_label["data4"] not in rec.counts
             # Admission acks open the window for data3/data4.
             peer.send(go_ack(1, 1))
             peer.send(go_ack(1, 2))
-            await collect_until(lambda: by_label["data3"] in counts
-                                and by_label["data4"] in counts)
+            await rec.collect_until(lambda: by_label["data3"] in rec.counts
+                                    and by_label["data4"] in rec.counts)
             # Server-side data is acked with the exact golden ack bytes.
             peer.send(go_data(1, 1, b"pong"))
-            await collect_until(
-                lambda: by_label["ack_of_server_data1"] in counts)
+            await rec.collect_until(
+                lambda: by_label["ack_of_server_data1"] in rec.counts)
             peer.send(go_ack(1, 3))
             peer.send(go_ack(1, 4))
 
@@ -202,25 +225,72 @@ def test_client_transcript_matches_golden_corpus():
         client = await new_async_client(f"127.0.0.1:{peer.port}", params)
         try:
             for label in ("data1", "data2", "data3", "data4"):
-                # Payloads reconstructed from the golden bytes themselves.
-                import base64
-                client.write(base64.b64decode(
-                    json.loads(by_label[label])["Payload"]))
+                client.write(golden_payload(by_label, label))
             got = await asyncio.wait_for(client.read(), 5)
             assert got == b"pong"
             await asyncio.wait_for(server_task, 15)
             # Everything acked; close flushes without new unknown packets.
             await client.close()
             # All golden entries were exercised.
-            assert set(by_label.values()) <= set(counts), (
-                set(by_label) - {k for k, v in by_label.items()
-                                 if v in counts})
+            assert set(by_label.values()) <= set(rec.counts)
         finally:
             if not server_task.done():
                 server_task.cancel()
             client._conn.abort()
             client._ep.close()
             peer.close()
+    asyncio.run(scenario())
+
+
+def test_server_transcript_matches_golden_corpus():
+    """Server-side sibling of the client transcript test: every byte OUR
+    SERVER emits against a scripted Go client — connect grant, epoch
+    re-acks, the ack of inbound data, window-gated writes and their
+    byte-identical backoff retransmits — frozen against
+    tests/goldens/wire_transcript_server.json."""
+    golden, by_label = load_golden("wire_transcript_server.json")
+    params = Params(**golden["params"])
+
+    async def scenario():
+        server = await new_async_server(0, params)
+        peer = GoPeer(("127.0.0.1", server.port))
+        rec = TranscriptRecorder(peer, set(by_label.values()))
+        try:
+            peer.send(go_connect())
+            raw = rec.record(await asyncio.to_thread(peer.recv))
+            assert raw == by_label["grant_ack"]
+            # Inbound data is acked with the exact golden bytes.
+            peer.send(go_data(1, 1, b"ping"))
+            got = await asyncio.wait_for(server.read(), 5)
+            assert got == (1, b"ping")
+            await rec.collect_until(
+                lambda: by_label["ack_of_client_data1"] in rec.counts)
+            # Window 2 of 4 queued writes: data1+data2 flow in order and
+            # retransmit byte-identically; data3/data4 stay gated.
+            for label in ("data1", "data2", "data3", "data4"):
+                server.write(1, golden_payload(by_label, label))
+            await rec.collect_until(
+                lambda: rec.counts.get(by_label["data1"], 0) >= 2
+                and rec.counts.get(by_label["data2"], 0) >= 2, timeout=5.0)
+            assert rec.seen.index(by_label["data1"]) < \
+                rec.seen.index(by_label["data2"])
+            assert by_label["data3"] not in rec.counts
+            assert by_label["data4"] not in rec.counts
+            peer.send(go_ack(1, 1))
+            peer.send(go_ack(1, 2))
+            await rec.collect_until(lambda: by_label["data3"] in rec.counts
+                                    and by_label["data4"] in rec.counts)
+            peer.send(go_ack(1, 3))
+            peer.send(go_ack(1, 4))
+            assert set(by_label.values()) <= set(rec.counts)
+            # The heartbeat claim must be non-vacuous: grant_ack and the
+            # epoch re-ack share bytes, so require MULTIPLE sightings —
+            # the scenario spans dozens of 100 ms epochs, each of which
+            # re-acks Ack(1, 0) (ref timeRoutine, client_impl.go:266-270).
+            assert rec.counts[by_label["heartbeat_ack0"]] >= 3, rec.counts
+        finally:
+            peer.close()
+            await server.close()
     asyncio.run(scenario())
 
 
