@@ -26,6 +26,15 @@ channel-coupled goroutines:
   reports a chunk arg-min instead, weakening its chunk to "a qualifying
   nonce"). No hit anywhere degrades to the exact arg-min, and stock
   Requests (``Target`` absent = 0) take the reference path byte-for-byte.
+- Difficulty prefix release (VERDICT r4): chunks cover ascending disjoint
+  ranges, so once some chunk ``c`` reports a qualifying hit and every chunk
+  ``< c`` has answered without one, no later answer can beat it — the
+  Result is released IMMEDIATELY, without waiting for the full barrier.
+  The released job's remaining chunks are cancelled exactly like a
+  client-drop (miners free, their late Results pop as stale via the
+  job_id/FIFO machinery), so a tight target's time-to-first-hit is the
+  winning chunk's scan, not the slowest full scan. Stock arg-min requests
+  keep the reference's full barrier untouched (ref: server.go:309-324).
 - Miner drop: reassign its unanswered chunks to available miners, else park
   them; parked chunks are re-issued when a miner joins or frees up
   (ref: server.go:326-376, 222-244, 285-304).
@@ -68,6 +77,7 @@ class Chunk:
     lower: int
     upper: int              # exclusive end, as sent on the wire
     target: int = 0         # difficulty target; rides every (re)assignment
+    idx: int = 0            # position in the request's ascending chunk order
     # Set when the requesting client drops: the chunk stays in the miner's
     # pending FIFO (its Result must still pop in order) but no longer
     # counts against the miner's availability.
@@ -99,18 +109,17 @@ class Request:
     num_chunks: int = 0
     min_hash: int = MAX_U64
     min_nonce: int = 0
-    total_responses: int = 0
-    # Difficulty merge plane: lowest-nonce qualifying (hash < target)
-    # response seen so far. Chunks cover ascending sub-ranges and each
-    # until-speaking miner reports its chunk-FIRST qualifying nonce, so
-    # the min-nonce qualifier across chunks is the globally first
-    # qualifying nonce — provided every miner speaks the extension; a
-    # stock (Target-dropping) miner reports its chunk ARG-MIN, which may
+    # Difficulty merge plane, per-chunk (VERDICT r4 prefix release).
+    # Chunks cover ascending disjoint sub-ranges and each until-speaking
+    # miner reports its chunk-FIRST qualifying (hash < target) nonce, so
+    # the lowest-INDEX qualifying chunk holds the globally first
+    # qualifying nonce — final as soon as every earlier chunk has
+    # answered without a hit, regardless of chunks still in flight.
+    # (A stock Target-dropping miner reports its chunk ARG-MIN, which may
     # qualify later than its chunk's first hit, weakening the answer to
-    # "a qualifying nonce" (see client.submit_until docstring).
-    q_hash: int = 0
-    q_nonce: int = 0
-    q_seen: bool = False
+    # "a qualifying nonce" — see client.submit_until docstring.)
+    answered: list = field(default_factory=list)   # bool per chunk idx
+    chunk_q: dict = field(default_factory=dict)    # idx -> (nonce, hash)
 
 
 class Scheduler:
@@ -184,23 +193,23 @@ class Scheduler:
         if msg.hash < curr.min_hash:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
-        if curr.target and msg.hash < curr.target and (
-                not curr.q_seen or msg.nonce < curr.q_nonce):
-            curr.q_hash, curr.q_nonce, curr.q_seen = msg.hash, msg.nonce, True
-        curr.total_responses += 1
-        if curr.total_responses == curr.num_chunks:
-            # Difficulty request with a hit: answer the globally FIRST
-            # qualifying nonce (see Request.q_* fields); otherwise — stock
-            # request, or target missed everywhere — the exact arg-min.
-            if curr.q_seen:
-                self._write(curr.conn_id, new_result(curr.q_hash,
-                                                     curr.q_nonce))
-            else:
-                self._write(curr.conn_id,
-                            new_result(curr.min_hash, curr.min_nonce))
-            self.current = None
-            if self.queue:
-                self._load_balance(self.queue.pop(0))
+        curr.answered[chunk.idx] = True
+        if curr.target and msg.hash < curr.target:
+            curr.chunk_q[chunk.idx] = (msg.nonce, msg.hash)
+        # Prefix release (difficulty only): the lowest-index qualifying
+        # chunk is final once every earlier chunk has answered clean —
+        # later chunks cover strictly higher nonces and cannot beat it.
+        if curr.chunk_q:
+            c = min(curr.chunk_q)
+            if all(curr.answered[:c]):
+                nonce, q_hash = curr.chunk_q[c]
+                self._finish(curr, q_hash, nonce, early=True)
+                return
+        if all(curr.answered):
+            # Full barrier: stock request, or target missed everywhere —
+            # the exact arg-min. (A difficulty hit always releases above:
+            # at the barrier, its qualifying prefix is trivially complete.)
+            self._finish(curr, curr.min_hash, curr.min_nonce)
 
     def _on_drop(self, conn_id: int) -> None:
         miner = self._find_miner(conn_id)
@@ -227,21 +236,34 @@ class Scheduler:
             self.queue = [r for r in self.queue if r.conn_id != conn_id]
             curr = self.current
             if curr is not None and curr.conn_id == conn_id:
-                # Cancel immediately (divergence, see module docstring):
-                # mark the dead request's chunks cancelled — the pool frees
-                # (availability is derived) while the FIFO pop discipline
-                # for their stale Results is preserved — discard parked
-                # chunks, start the next request.
-                for m in self.miners:
-                    for c in m.pending:
-                        if c.job_id == curr.job_id:
-                            c.cancelled = True
-                self.parked.clear()
-                self.current = None
-                if self.queue and self.miners:
-                    self._load_balance(self.queue.pop(0))
+                # Cancel immediately (divergence, see module docstring).
+                self._retire(cancel=True)
 
     # -------------------------------------------------------------- internal
+
+    def _finish(self, curr: Request, h: int, nonce: int,
+                early: bool = False) -> None:
+        """Answer the client and retire the request. ``early`` = prefix
+        release: the job's other chunks are still in flight."""
+        self._write(curr.conn_id, new_result(h, nonce))
+        self._retire(cancel=early)
+
+    def _retire(self, cancel: bool) -> None:
+        """Retire the in-flight request and start the next. ``cancel``
+        (prefix release and client drop) marks its unanswered chunks
+        cancelled: the pool frees immediately (availability is derived),
+        the FIFO pop discipline for their late Results is preserved (they
+        drop at the job_id check), and parked chunks — which can only
+        belong to the job in flight — are discarded."""
+        if cancel:
+            for m in self.miners:
+                for c in m.pending:
+                    if c.job_id == self.current.job_id:
+                        c.cancelled = True
+            self.parked.clear()
+        self.current = None
+        if self.queue and self.miners:
+            self._load_balance(self.queue.pop(0))
 
     def _find_miner(self, conn_id: int) -> Optional[MinerState]:
         for m in self.miners:
@@ -260,23 +282,21 @@ class Scheduler:
         if total <= 0:
             # Empty/inverted range: answer like an empty scan (the reference
             # would wrap negative totals through uint64 and wedge the pool).
-            self._write(request.conn_id, new_result(MAX_U64, 0))
-            self.current = None
-            if self.queue:
-                self._load_balance(self.queue.pop(0))
+            self._finish(request, MAX_U64, 0)
             return
         individual = total // num
         leftover = total - individual * num
         if individual == 0:  # more miners than nonces
             individual, leftover, num = 1, 0, total
         request.num_chunks = num
+        request.answered = [False] * num
         start = request.lower
         for i in range(num):
             end = start + individual + (leftover if i == 0 else 0)
             self._assign_chunk(
                 self.miners[i],
                 Chunk(request.job_id, request.data, start, end,
-                      target=request.target))
+                      target=request.target, idx=i))
             start = end
 
     def _assign_chunk(self, miner: MinerState, chunk: Chunk) -> None:

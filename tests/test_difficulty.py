@@ -306,6 +306,70 @@ class TestSubmitUntilEndToEnd:
                 await poisoner.close()
         asyncio.run(scenario())
 
+    def test_prefix_release_beats_slow_pool(self):
+        """VERDICT r4 task 2: with a 3-miner pool and a target only chunk 0
+        can hit, the Result releases at chunk 0's hit — the scheduler must
+        NOT hold the all-chunks barrier while the other miners full-scan
+        their non-hitting chunks."""
+        import time
+
+        from distributed_bitcoinminer_tpu.apps.client import submit_until
+        from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+        from tests.test_apps import Cluster, fast_params
+
+        data, max_nonce = "chunk zero", 2999
+        # Chunks (3 miners): [0,1000], [1000,2000], [2000,3000] inclusive.
+        # Pick the target so qualifying hashes exist ONLY in chunk 0: any
+        # hash strictly below the best of chunks 1-2.
+        target = min(hash_op(data, n) for n in range(1000, 3001))
+        h0 = min(hash_op(data, n) for n in range(0, 1001))
+        assert h0 < target, "test needs chunk 0 to hold the global min"
+        want = scan_until(data, 0, max_nonce + 1, target)
+        assert want[2]
+        slow = 2.5
+
+        async def scenario():
+            async with Cluster(fast_params()) as c:
+                # Join order is chunk order: the fast miner gets chunk 0.
+                await c.start_miner(factory=until_factory())
+                for _ in range(2):
+                    await c.start_miner(factory=until_factory(delay=slow))
+                t0 = time.monotonic()
+                got = await asyncio.wait_for(
+                    submit_until(c.hostport, data, max_nonce, target,
+                                 c.params), 20)
+                elapsed = time.monotonic() - t0
+                assert got == want
+                # TTFH ~ chunk 0's scan, not the slow miners' stalls.
+                assert elapsed < slow * 0.6, elapsed
+        asyncio.run(scenario())
+
+    def test_prefix_release_waits_for_earlier_chunk(self):
+        """The prefix guard: a qualifying hit in chunk 1 arriving FIRST
+        (chunk 0's miner is slow) must not release early — chunk 0 also
+        hits at a lower nonce, and the answer must be the global first."""
+        from distributed_bitcoinminer_tpu.apps.client import submit_until
+        from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+        from tests.test_apps import Cluster, fast_params
+
+        data, max_nonce, target = "early exit", 2999, 1 << 59
+        want = scan_until(data, 0, max_nonce + 1, target)
+        # Precondition: both the first and a later chunk qualify, so a
+        # premature release would answer the wrong (higher) nonce.
+        assert want[2] and want[1] <= 1000
+        later = scan_until(data, 1500, 3000, target)
+        assert later[2] and later[1] != want[1]
+
+        async def scenario():
+            async with Cluster(fast_params()) as c:
+                await c.start_miner(factory=until_factory(delay=0.8))
+                await c.start_miner(factory=until_factory())
+                got = await asyncio.wait_for(
+                    submit_until(c.hostport, data, max_nonce, target,
+                                 c.params), 20)
+                assert got == want
+        asyncio.run(scenario())
+
     def test_loose_target_completes_measurably_earlier(self):
         """The whole point of threading the target: an until request on the
         same range finishes well ahead of the full arg-min scan because the
