@@ -67,12 +67,23 @@ class Conn:
         deliver: Callable[[bytes], None],
         broken: Callable[[Exception], None],
         connect_msg: Optional[Message] = None,
+        deliver_ready: Optional[Callable[[], bool]] = None,
     ):
         self.params = params
         self.conn_id = conn_id
         self._send_raw = send_raw
         self._deliver = deliver
         self._broken = broken
+        # Delivery back-pressure probe (server read-queue bound, ref:
+        # lsp/server_impl.go:112): when it returns False, the next in-order
+        # message is parked in ``_recv_pending`` WITHOUT an ack — the
+        # peer's send window cannot slide past an unacked head, so it
+        # stalls at W outstanding and memory stays bounded end-to-end
+        # without blocking the event loop (the asyncio analog of the
+        # reference's goroutine blocking on its full 500-chan). The owner
+        # calls :meth:`resume_delivery` when the app frees queue room; the
+        # parked head is acked at delivery time.
+        self._deliver_ready = deliver_ready or (lambda: True)
 
         self.state = ConnState.CONNECTING if connect_msg is not None else ConnState.UP
 
@@ -90,13 +101,25 @@ class Conn:
         else:
             self.connected.set_result(conn_id)
 
-        # Receive side: in-order reassembly.
+        # Receive side: in-order reassembly. ``_recv_unacked`` holds the
+        # (at most one) parked back-pressure head whose ack is deferred to
+        # delivery; its retransmits must NOT take the duplicate re-ack
+        # path, or the peer's window would slide past an undelivered
+        # message the app might never get room for.
         self._recv_expected = 1
         self._recv_pending: dict[int, bytes] = {}
+        self._recv_unacked: set[int] = set()
 
-        # Epoch bookkeeping.
+        # Epoch bookkeeping. Loss detection counts ALL inbound messages
+        # (ref connDropTimer resets on gotMessageChan); the heartbeat
+        # reminder is suppressed only by SUBSTANTIVE traffic (data / data
+        # acks), because on a mutually idle link the reference's reminder
+        # race resolves toward firing every epoch on both sides — a peer's
+        # heartbeat must not starve ours, or its loss detector (fed only
+        # by our sends) counts up to the epoch limit on a live link.
         self._silent_epochs = 0
         self._got_traffic = False
+        self._got_payload_traffic = False
 
         self.closed_event = asyncio.Event()
         self._epoch_task = asyncio.get_running_loop().create_task(self._epoch_loop())
@@ -140,6 +163,8 @@ class Conn:
     def on_message(self, msg: Message) -> None:
         """Handle one integrity-checked inbound message."""
         self._got_traffic = True
+        if msg.type != MsgType.ACK or msg.seq_num != 0:
+            self._got_payload_traffic = True
         if msg.type == MsgType.DATA:
             self._on_data(msg)
         elif msg.type == MsgType.ACK:
@@ -157,19 +182,49 @@ class Conn:
             self._connect_pending = None
             if not self.connected.done():
                 self.connected.set_result(msg.conn_id)
-        # Every received data message is acked, including duplicates
-        # (exactly-once delivery comes from receive-side dedup, not ack
-        # suppression; ref: lsp/server_impl.go:462-470).
-        self._send_raw(new_ack(self.conn_id, msg.seq_num).to_json())
         seq = msg.seq_num
         if seq < self._recv_expected or seq in self._recv_pending:
+            # Duplicates of ACKED messages are re-acked (exactly-once
+            # delivery comes from receive-side dedup, not ack suppression;
+            # ref: lsp/server_impl.go:462-470). A retransmit of the parked
+            # unacked back-pressure head stays unacked until delivery.
+            if seq not in self._recv_unacked:
+                self._send_raw(new_ack(self.conn_id, seq).to_json())
             return
+        if seq == self._recv_expected and self.state == ConnState.UP and \
+                not self._deliver_ready():
+            # Back-pressure: park the head unacked; see the __init__ note.
+            # Out-of-order messages are still admitted (and acked) below —
+            # they are bounded by the peer's window, which cannot slide
+            # past this unacked head.
+            self._recv_pending[seq] = msg.payload or b""
+            self._recv_unacked.add(seq)
+            return
+        self._send_raw(new_ack(self.conn_id, seq).to_json())
         self._recv_pending[seq] = msg.payload or b""
-        while self._recv_expected in self._recv_pending:
-            payload = self._recv_pending.pop(self._recv_expected)
+        self._drain()
+
+    def _drain(self) -> None:
+        """Deliver the in-order run while the owner's queue has room; the
+        parked back-pressure head is acked here, at delivery time."""
+        while self._recv_expected in self._recv_pending and (
+                self.state != ConnState.UP or self._deliver_ready()):
+            seq = self._recv_expected
+            payload = self._recv_pending.pop(seq)
+            if seq in self._recv_unacked:
+                self._recv_unacked.discard(seq)
+                self._send_raw(new_ack(self.conn_id, seq).to_json())
             self._recv_expected += 1
             if self.state == ConnState.UP:
                 self._deliver(payload)
+
+    def resume_delivery(self) -> None:
+        """Owner hook: queue room reappeared (the app read); deliver any
+        messages that stranded when :meth:`_drain` hit the cap — inbound
+        traffic is NOT guaranteed to re-trigger it (an acked out-of-order
+        backlog has no retransmits coming)."""
+        if self.state in (ConnState.UP, ConnState.CLOSING):
+            self._drain()
 
     def _on_ack(self, msg: Message) -> None:
         if msg.seq_num == 0:
@@ -213,9 +268,17 @@ class Conn:
                     self._declare_lost()
                 return False
 
-        # Heartbeat: one Ack(connID, 0) per epoch keeps live-but-quiet links up.
-        if self.state in (ConnState.UP, ConnState.CLOSING):
+        # Heartbeat, idle-only (VERDICT r4): the reference re-arms its
+        # reminder timer on every inbound message and sends Ack(connID, 0)
+        # only after a receive-silent epoch (ref: lsp/client_impl.go:268-281,
+        # server_impl.go:396-420) — so a BUSY link emits no reminder acks.
+        # On an idle link, peer heartbeats arrive one epoch + latency apart,
+        # so the reference's reminder reliably fires anyway: idleness is
+        # judged on substantive traffic only (see __init__ note).
+        if not self._got_payload_traffic and \
+                self.state in (ConnState.UP, ConnState.CLOSING):
             self._send_raw(new_ack(self.conn_id, 0).to_json())
+        self._got_payload_traffic = False
 
         # Retransmits: the Connect request and every unacked window element.
         retransmit = list(self._window.values())
@@ -268,6 +331,7 @@ class Conn:
         self.state = final_state
         self._window.clear()
         self._buffer.clear()
+        self._recv_unacked.clear()
         self._connect_pending = None
         self.closed_event.set()
         task = self._epoch_task

@@ -21,6 +21,16 @@ from .params import Params
 
 ReadItem = Tuple[int, Union[bytes, Exception]]
 
+# Delivery-queue bound, matching the reference server's buffered read
+# channel (ref: lsp/server_impl.go:112, `make(chan *Message, 500)`). At the
+# cap, a connection parks its next in-order message UNACKED instead of
+# queueing it (see _engine.Conn deliver_ready): the sender's window cannot
+# slide past the unacked head, so it stalls at W outstanding and a
+# never-reading app observes back-pressure, not unbounded memory. Reads at
+# the cap wake the connections to drain (read(), resume_delivery).
+# Connection-death notices bypass the cap — they must always surface.
+READ_QUEUE_CAP = 500
+
 
 class AsyncServer:
     """Asyncio-native LSP server. Create via :func:`new_async_server`."""
@@ -94,6 +104,7 @@ class AsyncServer:
             deliver=lambda payload, cid=conn_id: self._read_queue.put_nowait(
                 (cid, payload)),
             broken=lambda exc, cid=conn_id: self._on_broken(cid, exc),
+            deliver_ready=lambda: self._read_queue.qsize() < READ_QUEUE_CAP,
         )
         self._conns[conn_id] = conn
         self._addr_map[addr] = conn_id
@@ -117,10 +128,18 @@ class AsyncServer:
 
         Raises ConnectionClosed once the server itself has been closed.
         """
+        # Reading at the cap frees delivery room: wake the connections so
+        # back-pressured messages drain now (inbound traffic alone cannot
+        # be relied on to re-trigger delivery — an acked out-of-order
+        # backlog has no retransmits coming).
+        was_full = self._read_queue.qsize() >= READ_QUEUE_CAP
         item = await self._read_queue.get()
         if isinstance(item, Exception):
             self._read_queue.put_nowait(item)
             raise item
+        if was_full:
+            for conn in list(self._conns.values()):
+                conn.resume_delivery()
         return item
 
     def write(self, conn_id: int, payload: bytes) -> None:

@@ -284,10 +284,15 @@ def test_server_transcript_matches_golden_corpus():
             peer.send(go_ack(1, 4))
             assert set(by_label.values()) <= set(rec.counts)
             # The heartbeat claim must be non-vacuous: grant_ack and the
-            # epoch re-ack share bytes, so require MULTIPLE sightings —
-            # the scenario spans dozens of 100 ms epochs, each of which
-            # re-acks Ack(1, 0) (ref timeRoutine, client_impl.go:266-270).
-            assert rec.counts[by_label["heartbeat_ack0"]] >= 3, rec.counts
+            # epoch re-ack share bytes, so require MULTIPLE sightings
+            # during an explicitly receive-idle stretch — reminder acks are
+            # idle-only (ref timeRoutine, client_impl.go:266-281: the timer
+            # re-arms on every receive), so the peer now goes silent and
+            # Ack(1, 0) must tick once per epoch.
+            base = rec.counts.get(by_label["heartbeat_ack0"], 0)
+            await rec.collect_until(
+                lambda: rec.counts.get(by_label["heartbeat_ack0"], 0)
+                >= base + 3, timeout=10 * params.epoch_millis / 1000.0)
         finally:
             peer.close()
             await server.close()
