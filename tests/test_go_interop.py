@@ -1,0 +1,152 @@
+"""LIVE interop against the reference's own Go endpoints (VERDICT r4 #6).
+
+C20's ceiling in this image is hand-scripted byte replay
+(test_go_replay.py): the staff binaries are darwin-only and no Go
+toolchain is installed. This suite makes the gap SELF-CLOSING: it locates
+a `go` toolchain at test time, builds the reference's srunner/crunner
+from ``/root/reference/p1/src`` (copied into a writable GOPATH;
+GOPATH-mode builds need GO111MODULE=off), and drives real cross-process
+interop over localhost UDP (ref: p1/README.md:110-141):
+
+- our client <-> their srunner: the golden-corpus payloads roundtrip and
+  every outbound Data datagram we put on the wire is byte-identical to
+  ``tests/goldens/wire_transcript.json`` (field order, checksum, base64);
+- our server <-> their crunner: the Go client connects, echoes through
+  our server, and prints the exact payload.
+
+Without a toolchain every test SKIPS (visibly), and the suite goes live
+the day the environment gains `go` — no code changes needed.
+"""
+
+import asyncio
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+from distributed_bitcoinminer_tpu.lsp.params import Params
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+from tests.test_go_replay import golden_payload, load_golden
+from tests.test_multihost import _free_udp_port
+
+GO = shutil.which("go")
+REF_SRC = "/root/reference/p1/src/github.com/cmu440"
+
+pytestmark = pytest.mark.skipif(
+    GO is None or not os.path.isdir(REF_SRC),
+    reason="no `go` toolchain on PATH (the reference source tree is "
+           "present) — installing Go alone makes this suite live; "
+           "see the module docstring")
+
+
+@pytest.fixture(scope="module")
+def go_bins(tmp_path_factory):
+    """Build srunner + crunner from the reference source in a writable
+    GOPATH (the reference tree itself is read-only)."""
+    gopath = tmp_path_factory.mktemp("gopath")
+    dst = gopath / "src" / "github.com" / "cmu440"
+    shutil.copytree(REF_SRC, dst,
+                    ignore=shutil.ignore_patterns("*.tar", "bin"))
+    env = {**os.environ, "GOPATH": str(gopath), "GO111MODULE": "off"}
+    bins = {}
+    for prog in ("srunner", "crunner"):
+        out = gopath / prog
+        build = subprocess.run(
+            [GO, "build", "-o", str(out), f"github.com/cmu440/{prog}"],
+            env=env, cwd=str(gopath), capture_output=True, text=True,
+            timeout=300)
+        # A present-but-failing toolchain is a finding, not a skip.
+        assert build.returncode == 0, \
+            f"go build {prog} failed:\n{build.stdout}\n{build.stderr}"
+        bins[prog] = str(out)
+    return bins
+
+
+def _golden():
+    golden, by_label = load_golden("wire_transcript.json")
+    return Params(**golden["params"]), by_label
+
+
+def test_our_client_against_live_go_srunner(go_bins):
+    """Their echo server, our client: golden payloads roundtrip and our
+    Data bytes on the wire match the golden corpus byte-for-byte."""
+    params, by_label = _golden()
+    port = _free_udp_port()
+    proc = subprocess.Popen(
+        [go_bins["srunner"], f"-port={port}",
+         f"-ems={params.epoch_millis}", f"-elim={params.epoch_limit}",
+         f"-wsize={params.window_size}",
+         f"-maxbackoff={params.max_backoff_interval}"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        assert "waiting for clients" in proc.stdout.readline() + \
+            proc.stdout.readline()
+
+        async def scenario():
+            client = await new_async_client(f"127.0.0.1:{port}", params)
+            sent = []
+            real_send = client._ep.send
+            client._ep.send = lambda raw, *a: (sent.append(raw),
+                                               real_send(raw, *a))[1]
+            labels = ("data1", "data2", "data3", "data4")
+            payloads = [golden_payload(by_label, lb) for lb in labels]
+            for p in payloads:
+                client.write(p)
+            for p in payloads:
+                got = await asyncio.wait_for(client.read(), 10)
+                assert got == p          # srunner echoes verbatim
+            # Byte-exact wire check: what we actually sent to the live Go
+            # process is the golden transcript's bytes (srunner grants the
+            # first client conn id 1, like the golden scenario).
+            for lb in labels:
+                assert by_label[lb] in sent, lb
+            await client.close()
+        asyncio.run(scenario())
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_live_go_crunner_against_our_server(go_bins):
+    """Their client, our server: crunner reads stdin tokens, sends each
+    over LSP, and prints the echo our server returns."""
+    params, _ = _golden()
+
+    async def scenario():
+        server = await new_async_server(0, params)
+
+        async def echo():
+            while True:
+                cid, payload = await server.read()
+                if isinstance(payload, Exception):
+                    continue
+                server.write(cid, payload)
+        echo_task = asyncio.create_task(echo())
+        proc = await asyncio.create_subprocess_exec(
+            go_bins["crunner"], f"-port={server.port}",
+            f"-ems={params.epoch_millis}", f"-elim={params.epoch_limit}",
+            f"-wsize={params.window_size}",
+            f"-maxbackoff={params.max_backoff_interval}",
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT)
+        try:
+            proc.stdin.write(b"interop-token\n")
+            await proc.stdin.drain()
+            proc.stdin.close()
+            out, _ = await asyncio.wait_for(proc.communicate(), 30)
+            text = out.decode()
+            assert "Server: interop-token" in text, text
+        finally:
+            if proc.returncode is None:
+                proc.kill()
+                await proc.wait()
+            echo_task.cancel()
+            await server.close()
+    asyncio.run(scenario())
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
