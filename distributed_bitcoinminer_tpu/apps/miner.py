@@ -120,7 +120,7 @@ class MinerWorker:
                 continue
             # Compute off-loop so LSP heartbeats keep flowing mid-search.
             try:
-                best_hash, best_nonce = await asyncio.to_thread(
+                best_hash, best_nonce, echo_target = await asyncio.to_thread(
                     self._search, msg.data, msg.lower, msg.upper, msg.target)
             except Exception:
                 # A broken worker must LEAVE the pool — exit so the
@@ -138,18 +138,24 @@ class MinerWorker:
                 await self.client.close()
                 return
             try:
-                self.client.write(new_result(best_hash, best_nonce).to_json())
+                self.client.write(
+                    new_result(best_hash, best_nonce, echo_target).to_json())
             except LspError:
                 return
             self.jobs_done += 1
 
     def _search(self, data: str, lower: int, upper: int,
-                target: int = 0) -> tuple[int, int]:
+                target: int = 0) -> tuple[int, int, int]:
+        """(hash, nonce, echo_target) — echo_target is the request's
+        target when the until mode actually ran (the Result then reports
+        the chunk-FIRST qualifying nonce), 0 when this miner behaved like
+        a stock full scan; the scheduler uses the echo to grade its merge
+        guarantee (ADVICE r4)."""
         if lower > upper:
             # The Go miner's loop body never runs for an inverted range and
             # it reports (maxUint, 0) (ref: miner.go:46-59); match that
             # instead of letting the searcher raise.
-            return (MAX_U64, 0)
+            return (MAX_U64, 0, 0)
         searcher = self._searchers.get(data)
         if searcher is None:
             searcher = self.searcher_factory(data, self.batch)
@@ -169,8 +175,8 @@ class MinerWorker:
             until = getattr(searcher, "search_until", None)
             if until is not None:
                 best_hash, best_nonce, _found = until(lower, upper, target)
-                return best_hash, best_nonce
-        return searcher.search(lower, upper)
+                return best_hash, best_nonce, target
+        return (*searcher.search(lower, upper), 0)
 
     async def close(self) -> None:
         if self.client is not None:

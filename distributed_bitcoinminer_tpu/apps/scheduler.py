@@ -24,8 +24,10 @@ channel-coupled goroutines:
   qualifying nonce when every miner speaks the extension (chunks ascend
   and each reports its chunk-first hit; a stock Target-dropping miner
   reports a chunk arg-min instead, weakening its chunk to "a qualifying
-  nonce"). No hit anywhere degrades to the exact arg-min, and stock
-  Requests (``Target`` absent = 0) take the reference path byte-for-byte.
+  nonce" — detected via the Result's target echo and surfaced in logs,
+  see ``Request.weak``). No hit anywhere degrades to the exact arg-min,
+  and stock Requests (``Target`` absent = 0) take the reference path
+  byte-for-byte.
 - Difficulty prefix release (VERDICT r4): chunks cover ascending disjoint
   ranges, so once some chunk ``c`` reports a qualifying hit and every chunk
   ``< c`` has answered without one, no later answer can beat it — the
@@ -120,6 +122,11 @@ class Request:
     # "a qualifying nonce" — see client.submit_until docstring.)
     answered: list = field(default_factory=list)   # bool per chunk idx
     chunk_q: dict = field(default_factory=dict)    # idx -> (nonce, hash)
+    # True once any responder answered a target chunk without echoing the
+    # target (stock miner in the pool): the merged answer is then only
+    # guaranteed qualifying, not guaranteed globally first (ADVICE r4 —
+    # surfaced in logs, invisible on the reference-shaped wire).
+    weak: bool = False
 
 
 class Scheduler:
@@ -194,6 +201,13 @@ class Scheduler:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
         curr.answered[chunk.idx] = True
+        if curr.target and msg.target != curr.target and not curr.weak:
+            curr.weak = True
+            logger.info(
+                "difficulty request %d: miner %d answered without the "
+                "target extension; the merged result is guaranteed "
+                "qualifying, not guaranteed globally first",
+                curr.job_id, conn_id)
         if curr.target and msg.hash < curr.target:
             curr.chunk_q[chunk.idx] = (msg.nonce, msg.hash)
         # Prefix release (difficulty only): the lowest-index qualifying

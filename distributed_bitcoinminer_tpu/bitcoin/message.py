@@ -116,5 +116,9 @@ def new_request(data: str, lower: int, upper: int, target: int = 0) -> Message:
                    target=target)
 
 
-def new_result(hash_value: int, nonce: int) -> Message:
-    return Message(type=MsgType.RESULT, hash=hash_value, nonce=nonce)
+def new_result(hash_value: int, nonce: int, target: int = 0) -> Message:
+    """``target``: until-speaking miners echo the Request's target so the
+    scheduler can tell which responders honored the extension (a stock
+    miner drops the key; 0 serializes to reference-identical bytes)."""
+    return Message(type=MsgType.RESULT, hash=hash_value, nonce=nonce,
+                   target=target)

@@ -133,10 +133,19 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
         # the whole SHA body. A skipped step costs a scalar SMEM read and
         # a branch (~µs) vs ~3.3k VPU ops/lane, collapsing the
         # time-to-first-hit of a large dispatch from the full grid to the
-        # hit step, with no host round-trips. The flag read at step 0 is
-        # uninitialized; the `step != 0` conjunct masks it.
+        # hit step, with no host round-trips. Step 0 zeroes the flag
+        # BEFORE the read below — `&` does not short-circuit, so masking
+        # an uninitialized load with a `step != 0` conjunct would still
+        # execute the load and is fragile under lowering changes
+        # (ADVICE r4). The body's step-0 init then overwrites the zero
+        # with this step's own hit count.
         f_ref, flag_ref = extra_refs
-        done = (step != jnp.int32(0)) & (flag_ref[0] != jnp.uint32(0))
+
+        @pl.when(step == jnp.int32(0))
+        def _zero_flag():
+            flag_ref[0] = jnp.uint32(0)
+
+        done = flag_ref[0] != jnp.uint32(0)
 
         @pl.when(jnp.logical_not(done))
         def _work():

@@ -86,7 +86,7 @@ def _pod_timeout_s() -> float:
         return 600.0
 
 
-def bounded_pod_call(fn):
+def bounded_pod_call(fn, timeout_s: Optional[float] = None):
     """Run one pod job with the failure-domain bound (VERDICT r3 task 7).
 
     A host dying mid-job leaves every OTHER host wedged inside a
@@ -109,14 +109,21 @@ def bounded_pod_call(fn):
         except BaseException as exc:  # noqa: BLE001 — re-raised below
             outcome.append(("err", exc))
 
+    bound = _pod_timeout_s() if timeout_s is None else timeout_s
     worker = threading.Thread(target=target, daemon=True)
     worker.start()
-    worker.join(_pod_timeout_s())
+    worker.join(bound)
     if not outcome:
-        logger.error(
-            "pod job exceeded DBM_POD_TIMEOUT_S=%.0fs — a peer host "
-            "likely died mid-collective; exiting so this host leaves the "
-            "pool and the chunk re-executes elsewhere", _pod_timeout_s())
+        if timeout_s is None:
+            logger.error(
+                "pod job exceeded DBM_POD_TIMEOUT_S=%.0fs — a peer host "
+                "likely died mid-collective; exiting so this host leaves "
+                "the pool and the chunk re-executes elsewhere", bound)
+        else:
+            logger.error(
+                "no pod broadcast within DBM_POD_IDLE_TIMEOUT_S=%.0fs — "
+                "the pool is idle past the configured bound (or the owner "
+                "died between jobs); exiting", bound)
         os._exit(17)
     kind, value = outcome[0]
     if kind == "err":
@@ -215,6 +222,15 @@ def run_follower(batch: Optional[int] = None,
     Mirrors the owner's per-message searcher cache (same bound, shared
     constant) so both sides keep the same compiled signatures warm;
     returns the number of jobs executed.
+
+    Failure domain (ADVICE r4): the in-job collectives are bounded by
+    ``bounded_pod_call`` (DBM_POD_TIMEOUT_S), but the BETWEEN-jobs
+    broadcast wait is unbounded by default — an idle pool legitimately
+    sends nothing, so only the distributed runtime's own heartbeat
+    covers an owner that dies between jobs. Deployments that want a hard
+    bound there too set ``DBM_POD_IDLE_TIMEOUT_S`` (seconds): the wait
+    then runs under the same bound machinery and a quiet pool kills the
+    follower (exit 17) when it expires.
     """
     from ..apps.miner import MinerWorker
     from ..models import ShardedNonceSearcher
@@ -222,9 +238,18 @@ def run_follower(batch: Optional[int] = None,
         cache_size = MinerWorker.SEARCHER_CACHE_SIZE
     searchers: OrderedDict[str, ShardedNonceSearcher] = OrderedDict()
     mesh = global_mesh()
+    try:
+        idle_bound = float(os.environ.get("DBM_POD_IDLE_TIMEOUT_S", "0"))
+    except ValueError:
+        # Tolerate a malformed knob like the sibling DBM_POD_TIMEOUT_S
+        # does — a typo must not crash the follower and wedge the pod.
+        logger.warning("ignoring malformed DBM_POD_IDLE_TIMEOUT_S=%r",
+                       os.environ.get("DBM_POD_IDLE_TIMEOUT_S"))
+        idle_bound = 0.0
     jobs = 0
     while True:
-        job = _receive_job()
+        job = (bounded_pod_call(_receive_job, timeout_s=idle_bound)
+               if idle_bound > 0 else _receive_job())
         if job is None:
             return jobs
         data, lower, upper, target = job

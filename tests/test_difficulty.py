@@ -229,11 +229,15 @@ class TestSubmitUntilEndToEnd:
                 assert got == (*scan_min("impossible", 0, 1500), False)
         asyncio.run(scenario())
 
-    def test_stock_miners_still_answer_target_requests(self):
+    def test_stock_miners_still_answer_target_requests(self, caplog):
         """Miners WITHOUT the until mode (the stock-Go-miner shape: the
         Target key is dropped, chunks full-scan) must still produce a valid
         qualifying Result — the chunk arg-min qualifies whenever anything
-        in the chunk does, just not necessarily the first such nonce."""
+        in the chunk does, just not necessarily the first such nonce. The
+        scheduler detects the missing target echo and surfaces the weaker
+        guarantee in its log (ADVICE r4)."""
+        import logging
+
         from distributed_bitcoinminer_tpu.apps.client import submit_until
         from tests.test_apps import Cluster, fast_params, oracle_factory
 
@@ -250,7 +254,10 @@ class TestSubmitUntilEndToEnd:
                 g_hash, g_nonce, found = got
                 assert found and g_hash < target
                 assert g_hash == hash_op(data, g_nonce)
-        asyncio.run(scenario())
+        with caplog.at_level(logging.INFO, logger="dbm.scheduler"):
+            asyncio.run(scenario())
+        assert any("without the target extension" in r.message
+                   for r in caplog.records), "weak-guarantee log missing"
 
     def test_target_chunk_survives_miner_drop(self):
         """A dropped miner's chunk is reassigned WITH its target (the chunk
