@@ -16,20 +16,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from .sha256_host import SHA256_K
-from .sha256_jnp import _compress, digit_positions, ensure_varying, lex_argmin
+from .sha256_jnp import (_compress, digit_contrib, ensure_varying,
+                         lex_argmin)
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 
 
-def _hash_lanes(midstate, template, i, rem: int, k: int, vary_axes=()):
-    """Hash a lane vector of low-digit offsets; returns (hi, lo) uint32."""
-    contrib: dict[tuple[int, int], jax.Array] = {}
-    for j, (blk, word, shift) in enumerate(digit_positions(rem, k)):
-        div = np.uint32(10 ** (k - 1 - j))
-        digit = (i // div) % np.uint32(10) + np.uint32(48)
-        key = (blk, word)
-        add = digit << np.uint32(shift)
-        contrib[key] = contrib[key] + add if key in contrib else add
+def _hash_lanes(midstate, template, i, rem: int, k: int, vary_axes=(),
+                base=None, span: int = 0):
+    """Hash a lane vector of low-digit offsets; returns (hi, lo) uint32.
+
+    ``base``/``span``: the scalar start and static length of the window
+    ``i`` covers, enabling the high-digit hoist (see
+    :func:`sha256_jnp.digit_contrib`)."""
+    contrib = digit_contrib(i, rem, k, base=base, span=span)
 
     state = tuple(jnp.broadcast_to(midstate[r], i.shape) for r in range(8))
     for blk in range(template.shape[0]):
@@ -56,9 +56,10 @@ def span_scan_body(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
     lane = jnp.arange(batch, dtype=jnp.uint32)
 
     def step(j, best):
-        i = i0 + j.astype(jnp.uint32) * np.uint32(batch) + lane
+        base = i0 + j.astype(jnp.uint32) * np.uint32(batch)
+        i = base + lane
         hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
-                                 vary_axes=vary_axes)
+                                 vary_axes=vary_axes, base=base, span=batch)
         valid = (i >= lo_i) & (i <= hi_i)
         hi_h = jnp.where(valid, hi_h, _MAX_U32)
         lo_h = jnp.where(valid, lo_h, _MAX_U32)
@@ -122,9 +123,10 @@ def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
 
     def body(carry):
         j, f_idx, best = carry
-        i = i0 + j.astype(jnp.uint32) * np.uint32(batch) + lane
+        base = i0 + j.astype(jnp.uint32) * np.uint32(batch)
+        i = base + lane
         hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
-                                 vary_axes=vary_axes)
+                                 vary_axes=vary_axes, base=base, span=batch)
         valid = (i >= lo_i) & (i <= hi_i)
         hi_h = jnp.where(valid, hi_h, _MAX_U32)
         lo_h = jnp.where(valid, lo_h, _MAX_U32)
