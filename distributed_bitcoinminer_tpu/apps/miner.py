@@ -183,10 +183,44 @@ class MinerWorker:
             await self.client.close()
 
 
+def _pin_platform_if_backend_wedged(compute: str = "auto") -> None:
+    """Deadlined accelerator probe before the first in-process backend
+    touch; pin CPU when it cannot come up.
+
+    A dead or flapping accelerator tunnel HANGS backend init for minutes
+    (observed live in round 5: bare miners wedged in axon init while the
+    chip endpoint was down, so the pool served nothing — the ambient
+    image env pins JAX_PLATFORMS=axon, so inheriting the environment IS
+    the hang case). The probe runs in a subprocess with a deadline (the
+    bench/chip_e2e mechanism, utils.config.probe_backend); on failure
+    this process is pinned to CPU — a slow miner beats a silent hang.
+    Skipped for an explicit CPU pin (nothing to probe), the host compute
+    tier (the native scan never touches a JAX backend), pod mode
+    (platform choice there is the deployment's concern, and an
+    asymmetric CPU fallback would desync the pod), or with
+    DBM_MINER_PROBE_TIMEOUT_S=0.
+    """
+    import os
+
+    from ..utils.config import probe_backend
+    if compute == "host" or os.environ.get("DBM_COORDINATOR") or \
+            os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return
+    timeout_s = float(os.environ.get("DBM_MINER_PROBE_TIMEOUT_S", "120"))
+    if timeout_s <= 0:
+        return
+    probe = probe_backend(timeout_s)
+    if "error" in probe:
+        logger.warning("accelerator probe failed (%s); pinning this miner "
+                       "to CPU", probe["error"])
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+
 async def _run_miner(hostport: str) -> int:
     from ..utils import from_env
     from ..utils.config import apply_jax_platform_env
     cfg = from_env()
+    _pin_platform_if_backend_wedged(cfg.compute)
 
     # Pod mode (north star: a whole multi-host pod joins as ONE miner).
     # DBM_COORDINATOR et al. select it; unset means plain single-host.

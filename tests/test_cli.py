@@ -117,3 +117,55 @@ def test_client_usage_errors(tmp_path):
     bad = _spawn([f"{pkg}.client", "127.0.0.1:1", "msg", "notanumber"], tmp_path)
     out, _ = bad.communicate(timeout=30)
     assert "notanumber is not a number." in out
+
+
+class TestMinerProbePin:
+    """The CLI miner must not inherit a hang from a dead accelerator
+    tunnel (round 5: bare miners wedged in axon backend init for the
+    whole session): a failed deadlined probe pins the process to CPU."""
+
+    def _pin(self):
+        from distributed_bitcoinminer_tpu.apps.miner import (
+            _pin_platform_if_backend_wedged)
+        return _pin_platform_if_backend_wedged
+
+    def test_failed_probe_pins_cpu(self, monkeypatch):
+        from distributed_bitcoinminer_tpu.utils import config
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")  # the ambient pin
+        monkeypatch.delenv("DBM_COORDINATOR", raising=False)
+        monkeypatch.delenv("DBM_MINER_PROBE_TIMEOUT_S", raising=False)
+        monkeypatch.setattr(
+            config, "probe_backend",
+            lambda t: {"error": "backend init exceeded deadline"})
+        self._pin()()
+        import os
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+    def test_healthy_probe_keeps_platform(self, monkeypatch):
+        from distributed_bitcoinminer_tpu.utils import config
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.delenv("DBM_COORDINATOR", raising=False)
+        monkeypatch.delenv("DBM_MINER_PROBE_TIMEOUT_S", raising=False)
+        monkeypatch.setattr(config, "probe_backend",
+                            lambda t: {"platform": "tpu", "n": 1})
+        self._pin()()
+        import os
+        assert os.environ["JAX_PLATFORMS"] == "axon"
+
+    def test_probe_skipped_for_cpu_pin_and_pod_mode(self, monkeypatch):
+        from distributed_bitcoinminer_tpu.utils import config
+
+        def boom(t):
+            raise AssertionError("probe must not run")
+        monkeypatch.setattr(config, "probe_backend", boom)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.delenv("DBM_COORDINATOR", raising=False)
+        self._pin()()
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("DBM_COORDINATOR", "h0:1234")
+        self._pin()()
+        monkeypatch.delenv("DBM_COORDINATOR")
+        monkeypatch.setenv("DBM_MINER_PROBE_TIMEOUT_S", "0")
+        self._pin()()
+        monkeypatch.delenv("DBM_MINER_PROBE_TIMEOUT_S")
+        self._pin()("host")  # native tier never touches a JAX backend
