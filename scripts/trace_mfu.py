@@ -108,8 +108,13 @@ def census() -> dict:
             "nblocks": template.shape[0]}
 
 
-def parse_xplane(trace_dir: str) -> dict:
-    """Device-plane kernel time out of a jax.profiler trace directory."""
+def parse_xplane(trace_dir: str, host_fallback: bool = False) -> dict:
+    """Device-plane kernel time out of a jax.profiler trace directory.
+
+    ``host_fallback`` (trace-dev only): walk the ``/host:`` planes when
+    no device plane exists — NEVER set on a chip run, where a missing
+    device plane must surface as the unmistakable all-zero report, not
+    as host time dressed up like kernel time."""
     import glob
 
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
@@ -124,6 +129,14 @@ def parse_xplane(trace_dir: str) -> dict:
     device_planes = [p for p in xs.planes
                      if "TPU" in p.name or "/device:" in p.name.lower()]
     out = {"trace_file": sorted(pbs)[-1], "planes": {}}
+    if not device_planes and host_fallback:
+        # CPU runs (the trace-dev tool-validation mode) emit only host
+        # planes; walk those instead so the event aggregation below runs
+        # against real data, and say so — host busy time is NOT a device
+        # kernel measurement.
+        device_planes = [p for p in xs.planes
+                         if p.name.startswith("/host:") and p.lines]
+        out["plane_kind"] = "host-fallback"
     for plane in device_planes:
         per_op: dict[str, int] = {}
         window_lo, window_hi = None, None
@@ -165,23 +178,36 @@ def kernel_busy_ms(planes: dict) -> tuple[float, float, bool]:
     return best_kernel, best_total, matched
 
 
-def trace(span_log2: int = 29) -> dict:
+def trace(span_log2: int = 29, dev_cpu: bool = False) -> dict:
     """One pallas search of 2^span_log2 lanes on the real chip under the
-    profiler; reports census MFU with device-measured step time."""
+    profiler; reports census MFU with device-measured step time.
+
+    ``dev_cpu`` (the ``trace-dev`` CLI mode) is a TOOL-VALIDATION run:
+    it skips the chip gate, pins this process to CPU, and uses the jnp
+    tier on a small span — proving the profiler capture, the xplane
+    proto parse, and the report plumbing end-to-end without hardware
+    (round 5: the trace mode was built during a tunnel outage and must
+    work first try when the chip returns). Its numbers are NOT kernel
+    measurements; ``kernel_events_matched`` is expected False on CPU.
+    """
     import json
     import tempfile
     import time
 
     from distributed_bitcoinminer_tpu.utils.config import (CHIP_PLATFORMS,
                                                            probe_backend)
-    probe = probe_backend(
-        float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300")))
-    if "error" in probe or probe.get("platform") not in CHIP_PLATFORMS:
-        report = {"error": "chip unreachable", "probe": probe}
-        print(json.dumps(report))
-        return report
+    if not dev_cpu:
+        probe = probe_backend(
+            float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300")))
+        if "error" in probe or probe.get("platform") not in CHIP_PLATFORMS:
+            report = {"error": "chip unreachable", "probe": probe}
+            print(json.dumps(report))
+            return report
 
     import jax
+
+    if dev_cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     from distributed_bitcoinminer_tpu.models import NonceSearcher
 
@@ -199,8 +225,9 @@ def trace(span_log2: int = 29) -> dict:
     # The child prints one pretty-printed JSON object; parse the whole
     # stream (a last-line parse would read just the closing brace).
     c = json.loads(proc.stdout)
-    searcher = NonceSearcher("cmu440", batch=1 << 20, tier="pallas")
-    lo = 2_000_000_000
+    searcher = NonceSearcher("cmu440", batch=1 << 13 if dev_cpu else 1 << 20,
+                             tier="jnp" if dev_cpu else "pallas")
+    lo = 10_000_000 if dev_cpu else 2_000_000_000
     hi = lo + (1 << span_log2) - 1
     searcher.search(lo, hi)               # warm every signature
     trace_dir = tempfile.mkdtemp(prefix="dbm_mfu_")
@@ -208,7 +235,7 @@ def trace(span_log2: int = 29) -> dict:
     with jax.profiler.trace(trace_dir):
         got = searcher.search(lo, hi)
     wall = time.time() - t0
-    planes = parse_xplane(trace_dir)
+    planes = parse_xplane(trace_dir, host_fallback=dev_cpu)
     kernel_ms, total_ms, matched = kernel_busy_ms(planes)
     lanes = 1 << span_log2
     report = {
@@ -235,9 +262,17 @@ if __name__ == "__main__":
         if mode == "census":
             import json
             print(json.dumps(census(), indent=2))
-        else:
-            report = trace(int(sys.argv[2]) if len(sys.argv) > 2 else 29)
+        elif mode in ("trace", "trace-dev"):
+            dev = mode == "trace-dev"
+            report = trace(int(sys.argv[2]) if len(sys.argv) > 2
+                           else (17 if dev else 29), dev_cpu=dev)
             rc = 2 if "error" in report else 0  # match chip_e2e's contract
+        else:
+            # A typo must not fall into the expensive real-chip path.
+            print(f"unknown mode {mode!r}; usage: trace_mfu.py "
+                  "census | trace [span_log2] | trace-dev [span_log2]",
+                  file=sys.stderr)
+            rc = 1
     except Exception as exc:  # noqa: BLE001 — every path must reach the
         # hard exit below: an uncaught exception after jax touched the
         # axon backend would hang in interpreter-shutdown finalizers.

@@ -36,3 +36,22 @@ def test_bench_emits_one_valid_json_line_with_contract_fields():
     # bench itself; a failure would surface as until_error instead.
     assert detail.get("until_found") is True, detail
     assert "until_ttfh_s" in detail
+
+
+def test_trace_dev_validates_profiler_pipeline():
+    """`trace_mfu.py trace-dev` proves the profiler capture + xplane
+    parse + report plumbing on CPU (round 5: the trace mode was built
+    during the chip tunnel outage and must work first try on hardware).
+    CPU traces carry no device plane, so the parse walks the host plane
+    and says so."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "trace_mfu.py"),
+         "trace-dev", "15"],
+        cwd=_REPO, capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    out = json.loads(proc.stdout[proc.stdout.index("{"):])
+    assert out["ops_per_nonce_census"] > 3000
+    assert out["trace"]["plane_kind"] == "host-fallback"
+    assert out["trace"]["planes"], "no planes parsed from the trace"
+    assert out["total_device_busy_ms"] > 0
