@@ -90,16 +90,18 @@ def main() -> int:
     ms = np.asarray(midstate, np.uint32)
     tp = template.astype(np.uint32)
 
-    for rows in (8, 16, 32, 64):
-        nsteps = -(-total // (rows * 128))
-        call = functools.partial(
-            pallas_search_span, ms, tp, np.uint32(0), np.uint32(0),
-            np.uint32(total - 1), rem=len(tail), k=k, rows=rows,
-            nsteps=nsteps)
-        jax.block_until_ready(call())
-        best = min(_timed(call) for _ in range(3))
-        print(f"pallas rows={rows:3d}: {total / best / 1e6:8.1f} Mnonce/s",
-              flush=True)
+    def rows_sweep(ms_a, tp_a, rem_a, label):
+        for rows in (8, 16, 32, 64):
+            nst = -(-total // (rows * 128))
+            call = functools.partial(
+                pallas_search_span, ms_a, tp_a, np.uint32(0), np.uint32(0),
+                np.uint32(total - 1), rem=rem_a, k=k, rows=rows, nsteps=nst)
+            jax.block_until_ready(call())
+            best_s = min(_timed(call) for _ in range(3))
+            print(f"pallas {label}rows={rows:3d}: "
+                  f"{total / best_s / 1e6:8.1f} Mnonce/s", flush=True)
+
+    rows_sweep(ms, tp, len(tail), "")
 
     batch = 1 << 20
     nb = -(-total // batch)
@@ -153,18 +155,8 @@ def main() -> int:
     lprefix = long_data.encode() + b" "
     lmid, ltail = sha256_midstate(lprefix)
     ltp = build_tail_template(ltail, k, len(lprefix) + k).astype(np.uint32)
-    lms = np.asarray(lmid, np.uint32)
     assert ltp.shape[0] == 2, f"want a 2-block tail, got {ltp.shape[0]}"
-    for rows in (8, 16, 32, 64):
-        nsteps2 = -(-total // (rows * 128))
-        call = functools.partial(
-            pallas_search_span, lms, ltp, np.uint32(0), np.uint32(0),
-            np.uint32(total - 1), rem=len(ltail), k=k, rows=rows,
-            nsteps=nsteps2)
-        jax.block_until_ready(call())
-        best = min(_timed(call) for _ in range(3))
-        print(f"pallas 2blk rows={rows:3d}: {total / best / 1e6:8.1f} "
-              "Mnonce/s", flush=True)
+    rows_sweep(np.asarray(lmid, np.uint32), ltp, len(ltail), "2blk ")
     return 0
 
 

@@ -1,0 +1,106 @@
+"""Randomized fault soak: sustained mixed load through the whole stack.
+
+The reference grades individual fault scenarios; this soak composes them
+— lossy links, a slow miner, a mid-run miner death, a replacement join,
+and a client that drops mid-request — over a seeded sequence of stock
+and difficulty requests, asserting every completed answer is bit-exact
+against the host oracle. The autouse ``no_task_leaks`` fixture
+(conftest.py) additionally fails the test if any scenario leaves a live
+task behind, which is what makes a soak meaningful as a leak/wedge
+detector rather than just a long test.
+
+Seeded RNG: the schedule is deterministic run-to-run; timings are not,
+which is the point — the assertions hold under any interleaving.
+"""
+
+import asyncio
+import random
+
+from distributed_bitcoinminer_tpu import lspnet
+from distributed_bitcoinminer_tpu.apps.client import submit, submit_until
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min, scan_until
+from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+from distributed_bitcoinminer_tpu.lsp.errors import LspError
+from tests.test_apps import Cluster, fast_params
+from tests.test_difficulty import until_factory
+
+
+def test_randomized_fault_soak():
+    rng = random.Random(0xDB5)
+    params = fast_params(epoch_ms=40, limit=8)
+
+    async def scenario():
+        losses = 0
+
+        async def attempt(submit_coro):
+            """One request; None = spec-legal connection loss under heavy
+            drop (ConnectTimeout in the client's own connect, or a
+            mid-request ConnectionLost) — the soak retries the round
+            instead of failing, but caps total losses so a wedged stack
+            can't hide behind the retry."""
+            nonlocal losses
+            try:
+                got = await asyncio.wait_for(submit_coro, 60)
+            except LspError:
+                got = None
+            if got is None:
+                losses += 1
+                assert losses <= 4, "too many connection losses for 25% drop"
+            return got
+
+        async with Cluster(params) as c:
+            await c.start_miner(factory=until_factory())
+            await c.start_miner(factory=until_factory(delay=0.05))
+            # All miners speak until, so difficulty answers stay
+            # globally-first-exact for the whole soak (a stock miner
+            # would weaken target rounds to "a qualifying nonce").
+            victim = await c.start_miner(factory=until_factory())
+            try:
+                for round_no in range(18):
+                    # Random (bounded) loss on both sides, re-rolled
+                    # every round; knobs are process-global, so set and
+                    # clear around each request.
+                    cdrop = rng.choice((0, 0, 10, 25))
+                    sdrop = rng.choice((0, 0, 10, 25))
+                    lspnet.set_client_write_drop_percent(cdrop)
+                    lspnet.set_server_write_drop_percent(sdrop)
+                    data = f"soak {round_no}"
+                    max_nonce = rng.randrange(2000, 12000)
+                    if round_no == 6:
+                        # Kill a miner mid-soak: its chunks must
+                        # reassign and later rounds run on a 2-pool.
+                        victim.client._conn.abort()
+                        victim.client._ep.close()
+                    if round_no == 12:
+                        # Elasticity: a replacement joins mid-soak.
+                        await c.start_miner(factory=until_factory())
+                    if round_no == 9:
+                        # A client that vanishes mid-request: the
+                        # scheduler must cancel and serve the next
+                        # request untainted.
+                        ghost = await new_async_client(c.hostport, params)
+                        ghost.write(
+                            b'{"Type":1,"Data":"ghost","Lower":0,'
+                            b'"Upper":200000,"Hash":0,"Nonce":0}')
+                        await asyncio.sleep(0.1)
+                        ghost._conn.abort()
+                        ghost._ep.close()
+                    if rng.random() < 0.5:
+                        target = 1 << rng.choice((58, 59))
+                        got = await attempt(submit_until(
+                            c.hostport, data, max_nonce, target, params))
+                        if got is None:
+                            continue
+                        want = scan_until(data, 0, max_nonce + 1, target)
+                        assert got == want, (round_no, got, want)
+                    else:
+                        got = await attempt(submit(
+                            c.hostport, data, max_nonce, params))
+                        if got is None:
+                            continue
+                        want = scan_min(data, 0, max_nonce + 1)
+                        assert got == want, (round_no, got, want)
+            finally:
+                lspnet.set_client_write_drop_percent(0)
+                lspnet.set_server_write_drop_percent(0)
+    asyncio.run(scenario())
