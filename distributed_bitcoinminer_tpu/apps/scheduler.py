@@ -61,6 +61,7 @@ merge rule, one-in-flight FIFO scheduling) is unchanged.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -127,6 +128,7 @@ class Request:
     # guaranteed qualifying, not guaranteed globally first (ADVICE r4 —
     # surfaced in logs, invisible on the reference-shaped wire).
     weak: bool = False
+    started: float = 0.0           # set at dispatch (load_balance)
 
 
 class Scheduler:
@@ -260,6 +262,12 @@ class Scheduler:
         """Answer the client and retire the request. ``early`` = prefix
         release: the job's other chunks are still in flight."""
         self._write(curr.conn_id, new_result(h, nonce))
+        logger.info(
+            "request %d served in %.3fs: [%d, %d) over %d chunks%s%s",
+            curr.job_id, time.monotonic() - curr.started,
+            curr.lower, curr.upper, curr.num_chunks,
+            " (prefix release)" if early else "",
+            " (weak merge)" if curr.weak else "")
         self._retire(cancel=early)
 
     def _retire(self, cancel: bool) -> None:
@@ -290,6 +298,7 @@ class Scheduler:
         self.current = request
         self._next_job_id += 1
         request.job_id = self._next_job_id
+        request.started = time.monotonic()
         num = len(self.miners)
         request.upper += 1  # inclusive -> exclusive
         total = request.upper - request.lower
