@@ -183,7 +183,7 @@ class MinerWorker:
             await self.client.close()
 
 
-def _pin_platform_if_backend_wedged(compute: str = "auto") -> None:
+def _pin_platform_if_backend_wedged(compute: str = "auto") -> bool:
     """Deadlined accelerator probe before the first in-process backend
     touch; pin CPU when it cannot come up.
 
@@ -199,28 +199,53 @@ def _pin_platform_if_backend_wedged(compute: str = "auto") -> None:
     (platform choice there is the deployment's concern, and an
     asymmetric CPU fallback would desync the pod), or with
     DBM_MINER_PROBE_TIMEOUT_S=0.
+
+    Returns True iff the CPU pin was applied here — i.e. the process
+    WOULD have wedged; the caller may then also swap an ``auto`` compute
+    config to the faster host tier (see :func:`_cpu_fallback_config`).
     """
     import os
 
     from ..utils.config import probe_backend
     if compute == "host" or os.environ.get("DBM_COORDINATOR") or \
             os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        return
+        return False
     timeout_s = float(os.environ.get("DBM_MINER_PROBE_TIMEOUT_S", "120"))
     if timeout_s <= 0:
-        return
+        return False
     probe = probe_backend(timeout_s)
     if "error" in probe:
         logger.warning("accelerator probe failed (%s); pinning this miner "
                        "to CPU", probe["error"])
         os.environ["JAX_PLATFORMS"] = "cpu"
+        return True
+    return False
+
+
+def _cpu_fallback_config(cfg):
+    """On a CPU-pinned fallback, swap an ``auto`` compute config to the
+    native host tier when it exists: "auto" means the widest AVAILABLE
+    plane, and with the accelerator unreachable that is the SHA-NI scan
+    (~1.5x the jnp CPU tier, BASELINE.md), not XLA:CPU. ``available()``
+    may g++-build the scan once (cached .so thereafter) — a cost the
+    first chunk would pay anyway, paid here before joining the pool
+    instead. Explicit tier pins are respected unchanged."""
+    if cfg.compute != "auto":
+        return cfg
+    from .. import native
+    if not native.available():
+        return cfg
+    import dataclasses
+    logger.warning("CPU fallback: serving with the native host compute tier")
+    return dataclasses.replace(cfg, compute="host")
 
 
 async def _run_miner(hostport: str) -> int:
     from ..utils import from_env
     from ..utils.config import apply_jax_platform_env
     cfg = from_env()
-    _pin_platform_if_backend_wedged(cfg.compute)
+    if _pin_platform_if_backend_wedged(cfg.compute):
+        cfg = _cpu_fallback_config(cfg)
 
     # Pod mode (north star: a whole multi-host pod joins as ONE miner).
     # DBM_COORDINATOR et al. select it; unset means plain single-host.

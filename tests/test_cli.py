@@ -141,7 +141,7 @@ class TestMinerProbePin:
         monkeypatch.setattr(
             config, "probe_backend",
             lambda t: {"error": "backend init exceeded deadline"})
-        self._pin()()
+        assert self._pin()() is True   # True = CPU pin applied here
         import os
         assert os.environ["JAX_PLATFORMS"] == "cpu"
 
@@ -152,7 +152,7 @@ class TestMinerProbePin:
         monkeypatch.delenv("DBM_MINER_PROBE_TIMEOUT_S", raising=False)
         monkeypatch.setattr(config, "probe_backend",
                             lambda t: {"platform": "tpu", "n": 1})
-        self._pin()()
+        assert self._pin()() is False
         import os
         assert os.environ["JAX_PLATFORMS"] == "axon"
 
@@ -164,7 +164,7 @@ class TestMinerProbePin:
         monkeypatch.setattr(config, "probe_backend", boom)
         monkeypatch.setenv("JAX_PLATFORMS", "cpu")
         monkeypatch.delenv("DBM_COORDINATOR", raising=False)
-        self._pin()()
+        assert self._pin()() is False
         monkeypatch.setenv("JAX_PLATFORMS", "axon")
         monkeypatch.setenv("DBM_COORDINATOR", "h0:1234")
         self._pin()()
@@ -173,3 +173,18 @@ class TestMinerProbePin:
         self._pin()()
         monkeypatch.delenv("DBM_MINER_PROBE_TIMEOUT_S")
         self._pin()("host")  # native tier never touches a JAX backend
+
+    def test_cpu_fallback_config_upgrades_only_auto(self, monkeypatch):
+        from distributed_bitcoinminer_tpu import native
+        from distributed_bitcoinminer_tpu.apps.miner import (
+            _cpu_fallback_config)
+        from distributed_bitcoinminer_tpu.utils.config import FrameworkConfig
+        monkeypatch.setattr(native, "available", lambda: True)
+        assert _cpu_fallback_config(
+            FrameworkConfig(compute="auto")).compute == "host"
+        # Explicit pins are respected; no native toolchain = no upgrade.
+        assert _cpu_fallback_config(
+            FrameworkConfig(compute="jnp")).compute == "jnp"
+        monkeypatch.setattr(native, "available", lambda: False)
+        assert _cpu_fallback_config(
+            FrameworkConfig(compute="auto")).compute == "auto"
