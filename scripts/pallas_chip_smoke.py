@@ -21,7 +21,11 @@ def main() -> int:
 
     print(f"platform={jax.devices()[0].platform}", flush=True)
     data = "cmu440"
-    s = NonceSearcher(data, batch=1 << 20, tier="pallas")
+    # Small batch for the correctness legs: off-chip they run in the
+    # Mosaic simulator, where a 2^20-lane dispatch (512 grid steps,
+    # 99.6% masked overscan for these tiny ranges) costs minutes for
+    # nothing. The on-chip rate leg builds its own wide searcher.
+    s = NonceSearcher(data, batch=8192, tier="pallas")
 
     lo, hi = 2_000_000_000, 2_000_009_999
     t0 = time.time()
@@ -31,9 +35,49 @@ def main() -> int:
     if got != want:
         print(f"MISMATCH: {got} != {want}")
         return 1
-    print("bit-exact vs oracle", flush=True)
+    print("argmin bit-exact vs oracle", flush=True)
 
+    # Until kernel (r4 SMEM-flag early exit + r5 step-0 zeroing): one hit
+    # leg and one miss leg, both vs the oracle. A lowering break in the
+    # newest constructs must fail HERE, not three tools later.
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+    target = 1 << 56
+    got_u = s.search_until(lo, hi, target)
+    want_u = scan_until(data, lo, hi, target)
+    if got_u != want_u or s._until_degraded:
+        print(f"UNTIL MISMATCH/DEGRADED: {got_u} != {want_u} "
+              f"(degraded={s._until_degraded})")
+        return 1
+    got_m = s.search_until(lo, lo + 999, 1)      # unreachable target
+    want_m = scan_until(data, lo, lo + 999, 1)
+    if got_m != want_m or s._until_degraded:
+        # The miss leg is the first dispatch that runs EVERY grid step's
+        # full SHA body; a runtime fault there would silently degrade to
+        # the jnp tier and still answer bit-exactly.
+        print(f"UNTIL MISS MISMATCH/DEGRADED: {got_m} != {want_m} "
+              f"(degraded={s._until_degraded})")
+        return 1
+    print("until bit-exact vs oracle (hit + miss legs)", flush=True)
+
+    # 2-block tail (long data, 2 device compressions/nonce vs 1) with
+    # the r5 digit hoist active — the geometry the rows sweep has not
+    # covered on-chip.
+    s2 = NonceSearcher("x" * 57, batch=8192, tier="pallas")
+    got2 = s2.search(lo, lo + 4095)
+    want2 = scan_min("x" * 57, lo, lo + 4095)
+    if got2 != want2:
+        print(f"2-BLOCK MISMATCH: {got2} != {want2}")
+        return 1
+    print("2-block tail bit-exact vs oracle", flush=True)
+
+    from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
+    if jax.devices()[0].platform not in CHIP_PLATFORMS:
+        # Off-chip the correctness legs above ran in the Mosaic
+        # simulator; a 2^26 rate there takes hours and means nothing.
+        print("rate leg skipped off-chip", flush=True)
+        return 0
     lo, hi = 2_000_000_000, 2_000_000_000 + (1 << 26) - 1
+    s = NonceSearcher(data, batch=1 << 20, tier="pallas")
     s.search(lo, hi)  # warm the big signature
     t0 = time.time()
     s.search(lo, hi)

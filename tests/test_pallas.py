@@ -142,12 +142,12 @@ def test_until_kernel_first_qualifying_vs_oracle():
 
 
 def test_two_block_tail_with_hoist_straddling_boundary():
-    """Long data (2-block tail, 3 compressions/nonce) with the r5 digit
+    """Long data (2-block tail: 2 device compressions/nonce) with the r5 digit
     hoist ACTIVE (k=9, one 1024-lane step => m=4) over a window that
     straddles a 10^4 boundary at lane offset 500 — BOTH candidates of
     the hoist's two-candidate select execute, on the geometry the rows
     sweep has not yet covered on-chip (VERDICT r4 weak 5). Budget note:
-    one rows=8 step at 3 compressions ~ 1.5 plain steps."""
+    one rows=8 step at 2 compressions ~ 1 plain 2048-lane step."""
     long_data = "x" * 57
     prefix = long_data.encode() + b" "
     mid, tail = sha256_midstate(prefix)
