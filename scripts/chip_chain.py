@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Resumable on-chip evidence chain (VERDICT r4 "next round" task 1).
+
+The axon tunnel to the one real chip flaps between live windows and
+blackholes (the round 4-5 outage documented in BASELINE.md): a probe
+can succeed at minute 0 and the same process block forever at minute
+10. This tool turns BASELINE.md's manual validation ritual --
+``pallas_chip_smoke`` -> ``bench.py`` -> ``trace_mfu trace`` ->
+``tpu_tune`` -> ``chip_e2e`` -- into ONE resumable command:
+
+* poll the tunnel with the deadlined subprocess probe
+  (``utils.config.probe_backend`` -- a wedged backend can never hang
+  the chain);
+* each time a window opens, run the next unfinished stage as a child
+  with its own wall-clock budget;
+* validate the stage's OWN output before marking it done: a bench
+  line that degraded to the CPU fallback, or a trace with no device
+  plane, does not count -- the stage stays pending for the next
+  window;
+* persist state + raw stage outputs under ``chipruns/`` so the chain
+  survives restarts and the artifacts are judge-checkable.
+
+Stage order is priority, not cost: the bench headline is the round's
+"Done =" criterion, so it runs right after the cheap smoke gate;
+the long tuning sweep goes last.
+
+Usage:
+  python scripts/chip_chain.py [--poll SECS] [--max-hours H] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_SCRIPTS)
+sys.path.insert(0, _REPO)
+
+RUN_DIR = os.path.join(_REPO, "chipruns")
+STATE = os.path.join(RUN_DIR, "chain_state.json")
+
+
+def _validate_smoke(out: str, rc: int) -> str | None:
+    if rc != 0:
+        return f"exit {rc}"
+    # The smoke runs its correctness legs happily in the Mosaic
+    # simulator if the tunnel flapped between our probe and its start;
+    # an off-chip pass must NOT mark the hardware gate done.
+    from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
+    if not any(f"platform={p}" in out for p in CHIP_PLATFORMS):
+        return "ran off-chip (platform line not a chip)"
+    for leg in ("argmin bit-exact", "until bit-exact", "2-block tail",
+                "wide-batch"):
+        if leg not in out:
+            return f"missing leg: {leg}"
+    return None
+
+
+def _validate_bench(out: str, rc: int) -> str | None:
+    if rc != 0:
+        return f"exit {rc}"
+    line = next((ln for ln in reversed(out.splitlines())
+                 if ln.startswith("{")), None)
+    if line is None:
+        return "no JSON line"
+    obj = json.loads(line)
+    # bench.py nests platform under "detail" (bench.py _emit).
+    platform = obj.get("detail", {}).get("platform")
+    from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
+    if platform not in CHIP_PLATFORMS:
+        return f"platform={platform} (CPU fallback does not count)"
+    return None
+
+
+def _last_json_object(out: str) -> dict | None:
+    """The last parseable JSON object in a merged stdout+stderr stream.
+
+    The trace report is pretty-printed over many lines, and chip stderr
+    noise may contain stray braces before it — anchor at each line that
+    *starts* an object, last first, and take the first that parses."""
+    decoder = json.JSONDecoder()
+    lines = out.splitlines()
+    starts = [i for i, ln in enumerate(lines)
+              if ln.lstrip().startswith("{")]
+    for i in reversed(starts):
+        try:
+            obj, _ = decoder.raw_decode("\n".join(lines[i:]).lstrip())
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def _validate_trace(out: str, rc: int) -> str | None:
+    if rc != 0:
+        return f"exit {rc}"
+    obj = _last_json_object(out)
+    if obj is None:
+        return "no JSON report"
+    if "error" in obj:
+        return str(obj["error"])
+    if not obj.get("kernel_device_ms"):
+        return "no device kernel time in trace"
+    return None
+
+
+def _validate_tune(out: str, rc: int) -> str | None:
+    if rc != 0:
+        return f"exit {rc}"
+    from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
+    if not any(f"platform={p}" in out for p in CHIP_PLATFORMS):
+        return "ran off-chip (device line not a chip)"
+    for leg in ("vpu_u32_ceiling", "until hit@step0", "2blk rows="):
+        if leg not in out:
+            return f"missing leg: {leg}"
+    return None
+
+
+def _validate_e2e(out: str, rc: int) -> str | None:
+    if rc != 0:
+        return f"exit {rc}"
+    if out.count("MATCH") < 2:
+        return "missing MATCH (argmin + target legs)"
+    return None
+
+
+PY = sys.executable
+STAGES = [
+    # (name, argv, budget_s, validator)
+    ("smoke", [PY, os.path.join(_SCRIPTS, "pallas_chip_smoke.py")],
+     900, _validate_smoke),
+    ("bench", [PY, os.path.join(_REPO, "bench.py")], 2400, _validate_bench),
+    ("trace", [PY, os.path.join(_SCRIPTS, "trace_mfu.py"), "trace", "29"],
+     2400, _validate_trace),
+    ("tune", [PY, os.path.join(_SCRIPTS, "tpu_tune.py"), "29"],
+     3600, _validate_tune),
+    ("e2e", [PY, os.path.join(_SCRIPTS, "chip_e2e.py")], 1800, _validate_e2e),
+]
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_state(state: dict) -> None:
+    os.makedirs(RUN_DIR, exist_ok=True)
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh, indent=2)
+    os.replace(tmp, STATE)
+
+
+def _window_open(deadline_s: float) -> bool:
+    from distributed_bitcoinminer_tpu.utils.config import (CHIP_PLATFORMS,
+                                                           probe_backend)
+    probe = probe_backend(deadline_s, _REPO)
+    ok = probe.get("platform") in CHIP_PLATFORMS
+    print(f"[chain] probe: {probe if not ok else probe['platform']}",
+          flush=True)
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--poll", type=float, default=180.0,
+                    help="seconds between tunnel probes while closed")
+    ap.add_argument("--probe-deadline", type=float, default=150.0)
+    ap.add_argument("--max-hours", type=float, default=9.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single pass: probe once, run what fits, exit")
+    args = ap.parse_args()
+
+    t_end = time.time() + args.max_hours * 3600
+    state = _load_state()
+    while time.time() < t_end:
+        pending = [s for s in STAGES if not state.get(s[0], {}).get("done")]
+        if not pending:
+            print("[chain] all stages done", flush=True)
+            return 0
+        if not _window_open(args.probe_deadline):
+            if args.once:
+                return 3
+            time.sleep(args.poll)
+            continue
+        name, argv, budget, validate = pending[0]
+        print(f"[chain] window open -> stage {name} "
+              f"(budget {budget}s)", flush=True)
+        t0 = time.time()
+        # Own process group per stage: chip_e2e spawns a server + miner
+        # and kills them in its finally block, which a SIGKILL on
+        # timeout would skip — killpg reaps the whole tree so a wedged
+        # stage can't leave an orphan bound to the e2e port poisoning
+        # every later retry.
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                cwd=_REPO, start_new_session=True)
+        try:
+            out, _ = proc.communicate(timeout=budget)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            import signal
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            out, _ = proc.communicate()
+            out = (out or "") + f"\n[chain] TIMEOUT after {budget}s"
+            rc = -1
+        wall = time.time() - t0
+        os.makedirs(RUN_DIR, exist_ok=True)
+        # Timestamped, append-only — a later (possibly off-chip-flap)
+        # retry must not destroy the artifact of an earlier attempt.
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        log = os.path.join(RUN_DIR, f"{name}_{stamp}.log")
+        with open(log, "w") as fh:
+            fh.write(out)
+        if rc == -1:
+            err = "timeout"
+        else:
+            try:
+                err = validate(out, rc)
+            except Exception as exc:  # malformed stage output = not done
+                err = f"validator: {exc!r}"
+        if err is None:
+            state[name] = {"done": True, "wall_s": round(wall, 1),
+                           "log": os.path.basename(log),
+                           "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())}
+            print(f"[chain] stage {name} DONE in {wall:.0f}s -> {log}",
+                  flush=True)
+        else:
+            state.setdefault(name, {})["last_error"] = err
+            print(f"[chain] stage {name} FAILED ({err}) after {wall:.0f}s; "
+                  "will retry next window", flush=True)
+            if not args.once:
+                time.sleep(args.poll)
+        _save_state(state)
+        if args.once and (err is not None or
+                          all(state.get(s[0], {}).get("done")
+                              for s in STAGES)):
+            return 0 if err is None else 4
+    print("[chain] max-hours budget exhausted", flush=True)
+    return 5
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

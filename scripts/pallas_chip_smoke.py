@@ -78,10 +78,29 @@ def main() -> int:
         return 0
     lo, hi = 2_000_000_000, 2_000_000_000 + (1 << 26) - 1
     s = NonceSearcher(data, batch=1 << 20, tier="pallas")
-    s.search(lo, hi)  # warm the big signature
+    warm = s.search(lo, hi)  # warm the big signature
     t0 = time.time()
-    s.search(lo, hi)
+    timed = s.search(lo, hi)
     dt = time.time() - t0
+    # The wide-batch geometry is the one bench and the miner actually
+    # run; its masking/overscan handling must be checked here too, not
+    # only at the 8192-batch correctness legs above. Oracle: the native
+    # scan (2^26 via Python hashlib would take minutes); if the native
+    # toolchain is somehow absent, at least pin warm == timed.
+    from distributed_bitcoinminer_tpu import native
+    if native.available():
+        want = native.scan_min_native(data, lo, hi)  # inclusive bounds
+        if warm != want or timed != want:
+            print(f"WIDE-BATCH MISMATCH: warm={warm} timed={timed} "
+                  f"!= {want}")
+            return 1
+        print("wide-batch (2^20) bit-exact vs native oracle", flush=True)
+    elif warm != timed:
+        print(f"WIDE-BATCH NONDETERMINISM: {warm} != {timed}")
+        return 1
+    else:
+        print("wide-batch (2^20) warm==timed (native oracle unavailable)",
+              flush=True)
     print(f"rate={(hi - lo + 1) / dt / 1e6:.1f}M nonces/s ({dt:.2f}s)",
           flush=True)
     return 0
