@@ -72,6 +72,19 @@ def interpret_on(platform: str) -> bool:
     return platform not in CHIP_PLATFORMS
 
 
+def peel_enabled() -> bool:
+    """Whether dispatch wrappers build the peeled-compression kernel.
+
+    Default OFF until the peeled structure has passed an on-chip smoke:
+    the rolled kernel is the chip-validated one, and a Mosaic layout
+    regression in an unvalidated variant must never cost a scarce
+    tunnel window (round-5 outage). Flip with ``DBM_PEEL=1`` (e.g. via
+    ``scripts/pallas_chip_smoke.py`` under the chain) and make it the
+    default here once validated."""
+    import os
+    return os.environ.get("DBM_PEEL", "0") == "1"
+
+
 def pallas_argmin(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
                   total: int, platform: str, vma: tuple = ()):
     """THE dispatch wrapper for the argmin kernel: geometry + interpret
@@ -80,7 +93,8 @@ def pallas_argmin(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
     rows, nsteps = pallas_geometry(total)
     return pallas_search_span(
         midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
-        nsteps=nsteps, interpret=interpret_on(platform), vma=vma)
+        nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
+        peel=peel_enabled())
 
 
 def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
@@ -91,7 +105,8 @@ def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
     rows, nsteps = pallas_geometry(total)
     return pallas_search_span_until(
         midstate, template, i0, lo_i, hi_i, t_hi, t_lo, rem=rem, k=k,
-        rows=rows, nsteps=nsteps, interpret=interpret_on(platform), vma=vma)
+        rows=rows, nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
+        peel=peel_enabled())
 
 
 def pallas_geometry(total: int) -> tuple[int, int]:
@@ -123,8 +138,35 @@ def _round(a, b, c, d, e, f, g, h, kw):
     return t1 + s0 + maj, a, b, c, d + t1, e, f, g
 
 
+def _make_block16(scal_ref, koff: int, guard_first: bool):
+    """The 16-round schedule-block fori body, built ONCE for both kernel
+    shapes: ``guard_first=True`` is the rolled kernel (fori over blocks
+    0-3, block 0 keeps the window untouched via the ``where`` guard);
+    ``guard_first=False`` is the peeled kernel (fori over blocks 1-3
+    only — rounds 0-15 ran straight-line, so the expansion is
+    unconditional). One copy keeps the layout-sensitive round/schedule
+    body from diverging between the two paths."""
+    def block16(bi, carry):
+        a, b, c, d, e, f, g, h = carry[:8]
+        w = list(carry[8:])
+        first = (bi == 0) if guard_first else None
+        for j in range(16):
+            s0 = (_rotr(w[(j + 1) % 16], 7) ^ _rotr(w[(j + 1) % 16], 18)
+                  ^ (w[(j + 1) % 16] >> np.uint32(3)))
+            s1 = (_rotr(w[(j + 14) % 16], 17) ^ _rotr(w[(j + 14) % 16], 19)
+                  ^ (w[(j + 14) % 16] >> np.uint32(10)))
+            upd = w[j] + s0 + w[(j + 9) % 16] + s1
+            w[j] = jnp.where(first, w[j], upd) if guard_first else upd
+            kj = scal_ref[koff + bi * 16 + j]
+            a, b, c, d, e, f, g, h = _round(
+                a, b, c, d, e, f, g, h, w[j] + kj)
+        return (a, b, c, d, e, f, g, h, *w)
+    return block16
+
+
 def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
-            nblocks: int, rows: int, until: bool = False):
+            nblocks: int, rows: int, until: bool = False,
+            peel: bool = False):
     step = pl.program_id(0)
     if until:
         # In-kernel early exit (VERDICT r3 task 2): the grid is sequential
@@ -151,14 +193,16 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
         def _work():
             _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref,
                          rem=rem, k=k, nblocks=nblocks, rows=rows,
-                         until=True)
+                         until=True, peel=peel)
     else:
         _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, None, None,
-                     rem=rem, k=k, nblocks=nblocks, rows=rows, until=False)
+                     rem=rem, k=k, nblocks=nblocks, rows=rows, until=False,
+                     peel=peel)
 
 
 def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
-                 rem: int, k: int, nblocks: int, rows: int, until: bool):
+                 rem: int, k: int, nblocks: int, rows: int, until: bool,
+                 peel: bool = False):
     step = pl.program_id(0)
     i0 = scal_ref[0]
     lo = scal_ref[1]
@@ -177,10 +221,19 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
     # (sha256_jnp.digit_contrib, VERDICT r4 task 3).
     contrib = digit_contrib(i, rem, k, base=step_base, span=rows * _LANES)
 
+    # Every carry entering a fori_loop must already have the plain
+    # {0,0} vector register layout: jnp.full broadcasts of SMEM scalars
+    # get the *replicated* {*,*} layout, the loop body yields {0,0}
+    # vectors, and Mosaic rejects the back-edge relayout ("Invalid
+    # relayout: Non-singleton logical dimension is replicated in
+    # destination but not in source" — the round-3 on-chip failure).
+    # ``nz`` is an iota-derived zero (lane < 2^31 always) that layout
+    # inference cannot fold away, de-replicating each init for one
+    # shift + add per carried tile per grid step.
+    nz = lane >> np.uint32(31)
     state = tuple(scal_ref[3 + r] for r in range(8))
-    a, b, c, d, e, f, g, h = (jnp.full((rows, _LANES), s, jnp.uint32)
-                              for s in state)
-    for blk in range(nblocks):
+
+    def w_tiles(blk):
         w = []
         for word in range(16):
             base = scal_ref[_TMPL_OFF + blk * 16 + word]
@@ -188,53 +241,71 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
                 wv = contrib[(blk, word)] | base
             else:
                 wv = jnp.full((rows, _LANES), base, jnp.uint32)
-            w.append(wv)
-        sa, sb, sc, sd, se, sf, sg, sh = a, b, c, d, e, f, g, h
+            w.append(wv + nz)
+        return w
 
-        # Every carry entering the fori_loop must already have the plain
-        # {0,0} vector register layout: jnp.full broadcasts of SMEM scalars
-        # get the *replicated* {*,*} layout, the loop body yields {0,0}
-        # vectors, and Mosaic rejects the back-edge relayout ("Invalid
-        # relayout: Non-singleton logical dimension is replicated in
-        # destination but not in source" — the round-3 on-chip failure).
-        # ``nz`` is an iota-derived zero (lane < 2^31 always) that layout
-        # inference cannot fold away, de-replicating each init for one
-        # shift + add per carried tile per grid step.
-        nz = lane >> np.uint32(31)
-        w = [wv + nz for wv in w]
-        a, b, c, d = a + nz, b + nz, c + nz, d + nz
-        e, f, g, h = e + nz, f + nz, g + nz, h + nz
+    if not peel:
+        a, b, c, d, e, f, g, h = (jnp.full((rows, _LANES), s, jnp.uint32)
+                                  + nz for s in state)
+        for blk in range(nblocks):
+            w = w_tiles(blk)
+            sa, sb, sc, sd, se, sf, sg, sh = a, b, c, d, e, f, g, h
 
-        # All 64 rounds as ONE fori_loop over four 16-round schedule
-        # blocks; block 0 keeps the window untouched via a cheap ``where``
-        # guard. The rolled form keeps the traced graph ~16x smaller than
-        # a full unroll, which is what keeps the interpret/test path
-        # viable: XLA:CPU's pass pipeline blows up super-linearly on an
-        # unrolled SHA graph (round-2 finding, reconfirmed in round 3 —
-        # one unrolled interpret step exceeded 240 s). K rides in SMEM via
-        # the scalar-prefetch ref (dynamic per-round reads).
-        def block16(bi, carry):
+            # All 64 rounds as ONE fori_loop over four 16-round schedule
+            # blocks; block 0 keeps the window untouched via a cheap
+            # ``where`` guard. The rolled form keeps the traced graph
+            # ~16x smaller than a full unroll, which is what keeps the
+            # interpret/test path viable: XLA:CPU's pass pipeline blows
+            # up super-linearly on an unrolled SHA graph (round-2
+            # finding, reconfirmed in round 3 — one unrolled interpret
+            # step exceeded 240 s). K rides in SMEM via the
+            # scalar-prefetch ref (dynamic per-round reads).
+            carry = jax.lax.fori_loop(
+                0, 4, _make_block16(scal_ref, koff, guard_first=True),
+                (a, b, c, d, e, f, g, h, *w))
             a, b, c, d, e, f, g, h = carry[:8]
-            w = list(carry[8:])
-            first = bi == 0
-            for j in range(16):
-                s0 = (_rotr(w[(j + 1) % 16], 7) ^ _rotr(w[(j + 1) % 16], 18)
-                      ^ (w[(j + 1) % 16] >> np.uint32(3)))
-                s1 = (_rotr(w[(j + 14) % 16], 17)
-                      ^ _rotr(w[(j + 14) % 16], 19)
-                      ^ (w[(j + 14) % 16] >> np.uint32(10)))
-                w[j] = jnp.where(first, w[j],
-                                 w[j] + s0 + w[(j + 9) % 16] + s1)
-                kj = scal_ref[koff + bi * 16 + j]
+            a, b, c, d = sa + a, sb + b, sc + c, sd + d
+            e, f, g, h = se + e, sf + f, sg + g, sh + h
+    else:
+        # Peeled compression (round 5): rounds 0-15 of each compression
+        # run as STATIC straight-line code with no schedule expansion —
+        # the rolled loop's block-0 ``where`` guard computes and
+        # discards ~21 ops/round of σ0/σ1 schedule math (the VPU
+        # executes both sides of a select), ~16% of the kernel's vector
+        # ops. Straight-line, so the round-3 negative result on
+        # ``lax.cond`` (branchy skip benched 3% slower) does not apply.
+        # On top, rounds before the first digit-carrying word of the
+        # FIRST compression see lane-invariant state AND schedule, and
+        # ride the scalar plane entirely (state enters as SMEM scalars;
+        # ``rem//4`` rounds — up to 15 for long 2-block data). Static
+        # graph cost: +16 traced rounds per compression, far below the
+        # full-unroll blowup documented above.
+        vec = None                       # vector state, once broadcast
+        cur = state                      # scalar state until broadcast
+        for blk in range(nblocks):
+            digit_words = sorted(wd for (b, wd) in contrib if b == blk)
+            scalar_entry = vec is None
+            t_star = digit_words[0] if scalar_entry and digit_words else 0
+            ff = cur if scalar_entry else vec    # feed-forward base
+            if scalar_entry:
+                for j in range(t_star):          # scalar-plane rounds
+                    wj = scal_ref[_TMPL_OFF + blk * 16 + j]
+                    cur = _round(*cur, wj + scal_ref[koff + j])
+                vec = tuple(jnp.full((rows, _LANES), s, jnp.uint32) + nz
+                            for s in cur)
+            a, b, c, d, e, f, g, h = vec
+            w = w_tiles(blk)
+            for j in range(t_star, 16):          # peeled vector rounds
                 a, b, c, d, e, f, g, h = _round(
-                    a, b, c, d, e, f, g, h, w[j] + kj)
-            return (a, b, c, d, e, f, g, h, *w)
+                    a, b, c, d, e, f, g, h, w[j] + scal_ref[koff + j])
 
-        carry = jax.lax.fori_loop(0, 4, block16,
-                                  (a, b, c, d, e, f, g, h, *w))
-        a, b, c, d, e, f, g, h = carry[:8]
-        a, b, c, d = sa + a, sb + b, sc + c, sd + d
-        e, f, g, h = se + e, sf + f, sg + g, sh + h
+            carry = jax.lax.fori_loop(   # rounds 16-63, rolled
+                1, 4, _make_block16(scal_ref, koff, guard_first=False),
+                (a, b, c, d, e, f, g, h, *w))
+            a, b, c, d, e, f, g, h = carry[:8]
+            vec = (ff[0] + a, ff[1] + b, ff[2] + c, ff[3] + d,
+                   ff[4] + e, ff[5] + f, ff[6] + g, ff[7] + h)
+        a, b, c, d, e, f, g, h = vec
 
     valid = (i >= lo) & (i <= hi)
     hi_h = jnp.where(valid, a, _MAX_U32)
@@ -286,10 +357,12 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("rem", "k", "rows", "nsteps", "interpret", "vma"))
+    static_argnames=("rem", "k", "rows", "nsteps", "interpret", "vma",
+                     "peel"))
 def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
                        k: int, rows: int, nsteps: int,
-                       interpret: bool = False, vma: tuple = ()):
+                       interpret: bool = False, vma: tuple = (),
+                       peel: bool = False):
     """Scan lanes ``i0 + [0, nsteps*rows*128)`` masked to [lo_i, hi_i].
 
     Same contract as :func:`ops.search.search_span`; ``rows`` is the sublane
@@ -308,16 +381,18 @@ def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
     """
     hi_h, lo_h, idx = _run_kernel(
         midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
-        nsteps=nsteps, interpret=interpret, vma=vma)
+        nsteps=nsteps, interpret=interpret, vma=vma, peel=peel)
     return lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("rem", "k", "rows", "nsteps", "interpret", "vma"))
+    static_argnames=("rem", "k", "rows", "nsteps", "interpret", "vma",
+                     "peel"))
 def pallas_search_span_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
                              *, rem: int, k: int, rows: int, nsteps: int,
-                             interpret: bool = False, vma: tuple = ()):
+                             interpret: bool = False, vma: tuple = (),
+                             peel: bool = False):
     """Difficulty-target span scan on the Mosaic kernel.
 
     Same lane coverage as :func:`pallas_search_span` plus a 4th in-VMEM
@@ -338,7 +413,8 @@ def pallas_search_span_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
     """
     hi_h, lo_h, idx, f, flag = _run_kernel(
         midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
-        nsteps=nsteps, interpret=interpret, vma=vma, target=(t_hi, t_lo))
+        nsteps=nsteps, interpret=interpret, vma=vma, target=(t_hi, t_lo),
+        peel=peel)
     f_idx = jnp.min(f.ravel())
     found = (flag[0] != 0).astype(jnp.uint32)
     b_hi, b_lo, b_idx = lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
@@ -346,7 +422,7 @@ def pallas_search_span_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
 
 
 def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
-                interpret, vma, target=None):
+                interpret, vma, target=None, peel=False):
     """Shared pallas_call builder for the argmin and difficulty variants."""
     midstate = jnp.asarray(midstate, dtype=jnp.uint32).reshape(8)
     template = jnp.asarray(template, dtype=jnp.uint32)
@@ -387,7 +463,7 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
     )
     return pl.pallas_call(
         functools.partial(_kernel, rem=rem, k=k, nblocks=nblocks, rows=rows,
-                          until=target is not None),
+                          until=target is not None, peel=peel),
         out_shape=out_shapes,
         grid_spec=grid_spec,
         interpret=pltpu.InterpretParams() if interpret else False,

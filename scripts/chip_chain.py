@@ -64,11 +64,21 @@ def _validate_smoke(out: str, rc: int) -> str | None:
 def _validate_bench(out: str, rc: int) -> str | None:
     if rc != 0:
         return f"exit {rc}"
-    line = next((ln for ln in reversed(out.splitlines())
-                 if ln.startswith("{")), None)
-    if line is None:
-        return "no JSON line"
-    obj = json.loads(line)
+    # Last parseable bench line (metric key required): stray braces in
+    # the merged stderr stream must not shadow or break the real line.
+    obj = None
+    for ln in reversed(out.splitlines()):
+        if not ln.startswith("{"):
+            continue
+        try:
+            cand = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand:
+            obj = cand
+            break
+    if obj is None:
+        return "no bench JSON line"
     # bench.py nests platform under "detail" (bench.py _emit).
     platform = obj.get("detail", {}).get("platform")
     from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
@@ -125,7 +135,10 @@ def _validate_tune(out: str, rc: int) -> str | None:
 def _validate_e2e(out: str, rc: int) -> str | None:
     if rc != 0:
         return f"exit {rc}"
-    if out.count("MATCH") < 2:
+    # Whole-line match: "MISMATCH" contains "MATCH", so a substring
+    # count would pass an all-mismatch transcript.
+    matches = sum(1 for ln in out.splitlines() if ln.strip() == "MATCH")
+    if matches < 2:
         return "missing MATCH (argmin + target legs)"
     return None
 

@@ -18,6 +18,18 @@ def main() -> int:
 
     from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
     from distributed_bitcoinminer_tpu.models import NonceSearcher
+    from distributed_bitcoinminer_tpu.utils.config import (
+        apply_jax_platform_env)
+
+    # Honor JAX_PLATFORMS=cpu for off-chip runs: this image's
+    # sitecustomize overrides the env var, and with the tunnel
+    # blackholed a bare jax.devices() would hang forever (utils.config).
+    apply_jax_platform_env()
+
+    # The baseline legs must measure the DEFAULT kernel config: an
+    # inherited DBM_PEEL pin would silently turn the 'vs rolled' delta
+    # of the candidate leg below into peel-vs-peel. Restored on exit.
+    prior_peel = os.environ.pop("DBM_PEEL", None)
 
     print(f"platform={jax.devices()[0].platform}", flush=True)
     data = "cmu440"
@@ -103,6 +115,45 @@ def main() -> int:
               flush=True)
     print(f"rate={(hi - lo + 1) / dt / 1e6:.1f}M nonces/s ({dt:.2f}s)",
           flush=True)
+
+    # r5 peeled-compression CANDIDATE (sha256_pallas.peel_enabled):
+    # bit-exactness + rate on the same wide geometry, plus a tiny until
+    # leg, purely informational — the flip to default-on is decided from
+    # this log, and a candidate failure must NOT block the validated
+    # default kernel's evidence chain. (If the peel default ever flips
+    # to on, the legs above already cover it and this block should be
+    # retired or inverted to measure the rolled kernel instead.)
+    try:
+        os.environ["DBM_PEEL"] = "1"      # dispatch wrappers read per call
+        sp = NonceSearcher(data, batch=1 << 20, tier="pallas")
+        pwarm = sp.search(lo, hi)
+        t0 = time.time()
+        ptimed = sp.search(lo, hi)
+        pdt = time.time() - t0
+        ref = want if native.available() else warm
+        if pwarm != ref or ptimed != ref:
+            print(f"peel candidate MISMATCH: warm={pwarm} timed={ptimed} "
+                  f"!= {ref}")
+        else:
+            su = NonceSearcher(data, batch=8192, tier="pallas")
+            tgt = 1 << 56
+            gu = su.search_until(2_000_000_000, 2_000_009_999, tgt)
+            wu = scan_until(data, 2_000_000_000, 2_000_009_999, tgt)
+            if gu != wu or su._until_degraded:
+                print(f"peel candidate UNTIL MISMATCH: {gu} != {wu} "
+                      f"(degraded={su._until_degraded})")
+            else:
+                print(f"peel candidate ok: "
+                      f"rate={(hi - lo + 1) / pdt / 1e6:.1f}M nonces/s "
+                      f"({pdt:.2f}s) vs rolled {(hi - lo + 1) / dt / 1e6:.1f}M",
+                      flush=True)
+    except Exception as exc:  # noqa: BLE001 — candidate only, never gate
+        print(f"peel candidate error: {exc!r}"[:400], flush=True)
+    finally:
+        if prior_peel is None:
+            os.environ.pop("DBM_PEEL", None)
+        else:
+            os.environ["DBM_PEEL"] = prior_peel
     return 0
 
 

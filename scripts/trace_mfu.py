@@ -36,7 +36,7 @@ _VECTOR_ARITH = {
 }
 
 
-def _count_jaxpr(jaxpr, lane_shape) -> int:
+def _count_jaxpr(jaxpr, lane_shape, while_trip: int = 4) -> int:
     """Vector-op eqns per grid step, weighting loop bodies by trip count.
 
     Scalar eqns (SMEM reads, index math) are excluded by the lane-shape
@@ -47,25 +47,29 @@ def _count_jaxpr(jaxpr, lane_shape) -> int:
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         if prim == "pallas_call":
-            total += _count_jaxpr(eqn.params["jaxpr"], lane_shape)
+            total += _count_jaxpr(eqn.params["jaxpr"], lane_shape,
+                                  while_trip)
             continue
         if prim in ("closed_call", "custom_jvp_call", "pjit", "jit"):
             inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
             if inner is not None:
-                total += _count_jaxpr(inner, lane_shape)
+                total += _count_jaxpr(inner, lane_shape, while_trip)
             continue
         if prim == "while":
-            # The 4x16-round schedule fori_loop lowers to while when the
-            # trip count is dynamic; here it is static 4.
-            total += 4 * _count_jaxpr(eqn.params["body_jaxpr"], lane_shape)
+            # The 16-round schedule fori_loop lowers to while; its trip
+            # count is static but not recoverable from the jaxpr, so the
+            # caller supplies it (4 for the rolled kernel's fori(0,4),
+            # 3 for the peeled kernel's fori(1,4)).
+            total += while_trip * _count_jaxpr(
+                eqn.params["body_jaxpr"], lane_shape, while_trip)
             continue
         if prim == "scan":
             total += eqn.params["length"] * _count_jaxpr(
-                eqn.params["jaxpr"], lane_shape)
+                eqn.params["jaxpr"], lane_shape, while_trip)
             continue
         if prim == "cond":
             # pl.when branches: count the taken (non-trivial) branch.
-            total += max(_count_jaxpr(b, lane_shape)
+            total += max(_count_jaxpr(b, lane_shape, while_trip)
                          for b in eqn.params["branches"])
             continue
         if prim in _VECTOR_ARITH and any(
@@ -88,24 +92,32 @@ def census() -> dict:
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import (
         _LANES, _ROWS_MAX, pallas_search_span)
 
+    from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
+
     prefix = b"cmu440 2"          # d=10, k=9 block: the bench geometry
     midstate, tail = sha256_midstate(prefix)
     template = build_tail_template(tail, 9, len(prefix) + 9)
     rows = _ROWS_MAX
+    peel = peel_enabled()         # DBM_PEEL: census the peeled variant
 
     def one_step():
         return pallas_search_span(
             np.asarray(midstate, dtype=np.uint32), template,
             np.uint32(0), np.uint32(0), np.uint32(rows * _LANES - 1),
-            rem=len(tail), k=9, rows=rows, nsteps=1, interpret=True)
+            rem=len(tail), k=9, rows=rows, nsteps=1, interpret=True,
+            peel=peel)
 
     jaxpr = jax.make_jaxpr(one_step)()
-    per_step = _count_jaxpr(jaxpr.jaxpr, (rows, _LANES))
+    # The schedule fori_loop's static trip count: 4 blocks rolled, or 3
+    # with block 0 peeled into straight-line rounds (sha256_pallas).
+    per_step = _count_jaxpr(jaxpr.jaxpr, (rows, _LANES),
+                            while_trip=3 if peel else 4)
     lanes = rows * _LANES
     return {"vector_ops_per_step": per_step,
             "lanes_per_step": lanes,
             "ops_per_nonce": per_step,  # one (rows,128) eqn = 1 op/lane
-            "nblocks": template.shape[0]}
+            "nblocks": template.shape[0],
+            "peel": peel}
 
 
 def parse_xplane(trace_dir: str, host_fallback: bool = False) -> dict:

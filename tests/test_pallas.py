@@ -28,7 +28,7 @@ from distributed_bitcoinminer_tpu.ops.sha256_pallas import pallas_search_span
 
 
 def _kernel_span(data: str, i0: int, lo: int, hi: int, k: int,
-                 rows: int, nsteps: int, top: str = ""):
+                 rows: int, nsteps: int, top: str = "", peel: bool = False):
     """Call the kernel the way the searcher does: every VALID nonce in
     [lo, hi] must have exactly ``k`` decimal digits (the searcher plans one
     dispatch per digit class — miner_model._digit_classes). Round 2's
@@ -41,7 +41,8 @@ def _kernel_span(data: str, i0: int, lo: int, hi: int, k: int,
     hi_h, lo_h, idx = pallas_search_span(
         np.asarray(midstate, np.uint32), template.astype(np.uint32),
         np.uint32(i0), np.uint32(lo), np.uint32(hi),
-        rem=len(tail), k=k, rows=rows, nsteps=nsteps, interpret=True)
+        rem=len(tail), k=k, rows=rows, nsteps=nsteps, interpret=True,
+        peel=peel)
     return (int(hi_h) << 32) | int(lo_h), int(idx)
 
 
@@ -95,12 +96,13 @@ def test_kernel_lowers_for_tpu_platform():
     import jax
     import jax.numpy as jnp
 
-    f = functools.partial(pallas_search_span, rem=8, k=9, rows=8,
-                          nsteps=16384)
     args = (jnp.zeros(8, jnp.uint32), jnp.zeros((1, 16), jnp.uint32),
             jnp.uint32(0), jnp.uint32(0), jnp.uint32(0))
-    exported = jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
-    assert len(exported.mlir_module()) > 0
+    for peel in (False, True):   # peeled variant must lower too (r5)
+        f = functools.partial(pallas_search_span, rem=8, k=9, rows=8,
+                              nsteps=16384, peel=peel)
+        exported = jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+        assert len(exported.mlir_module()) > 0
 
 
 def test_default_tier_env(monkeypatch):
@@ -166,3 +168,48 @@ def test_two_block_tail_with_hoist_straddling_boundary():
     # The straddle premise itself, so a future constant change can't
     # silently turn this back into a single-candidate test.
     assert lo < (lo // 10_000 + 1) * 10_000 <= hi
+
+
+def test_peeled_kernel_exact_masked_and_two_block():
+    """Round-5 peeled compression: rounds 0-15 run as straight-line code
+    (no block-0 schedule ``where`` waste) and rounds before the first
+    digit-carrying word ride the scalar plane. Must be bit-exact on a
+    masked multi-step window and on the 2-block tail, where the scalar
+    prefix is deepest (rem=61 -> 15 scalar rounds). Budget: 2 steps + 1
+    double-compression step."""
+    got = _kernel_span("peel", i0=0, lo=130, hi=255, k=3, rows=1, nsteps=2,
+                       peel=True)
+    assert got == scan_min("peel", 130, 255)
+    data = "x" * 60
+    got = _kernel_span(data, i0=100, lo=100, hi=227, k=3, rows=1, nsteps=1,
+                       peel=True)
+    assert got == scan_min(data, 100, 227)
+
+
+def test_peeled_until_kernel_vs_oracle():
+    """The until variant of the peeled kernel: first-qualifying semantics
+    and the argmin fallback both intact (the SMEM flag plumbing wraps the
+    same peeled body). Budget: 2 steps x 2 legs."""
+    from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op
+    from distributed_bitcoinminer_tpu.ops.sha256_pallas import (
+        pallas_search_span_until)
+    data, lo, hi = "untilpeel", 128, 383
+    prefix = data.encode() + b" "
+    mid, tail = sha256_midstate(prefix)
+    tp = build_tail_template(tail, 3, len(prefix) + 3).astype(np.uint32)
+    hashes = {n: hash_op(data, n) for n in range(lo, hi + 1)}
+    target = sorted(hashes.values())[3] + 1     # a few qualifying nonces
+    first = next(n for n in range(lo, hi + 1) if hashes[n] < target)
+
+    def run(t):
+        return tuple(int(x) for x in pallas_search_span_until(
+            np.asarray(mid, np.uint32), tp, np.uint32(128), np.uint32(lo),
+            np.uint32(hi), np.uint32(t >> 32), np.uint32(t & 0xFFFFFFFF),
+            rem=len(tail), k=3, rows=1, nsteps=2, interpret=True,
+            peel=True))
+
+    found, f_idx, _, _, _ = run(target)
+    assert (found, f_idx) == (1, first)
+    wh, wn = scan_min(data, lo, hi)
+    found, _, b_hi, b_lo, b_idx = run(min(hashes.values()))  # unreachable
+    assert found == 0 and ((b_hi << 32) | b_lo, b_idx) == (wh, wn)
