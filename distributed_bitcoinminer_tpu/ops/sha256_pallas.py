@@ -296,8 +296,16 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
             a, b, c, d, e, f, g, h = vec
             w = w_tiles(blk)
             for j in range(t_star, 16):          # peeled vector rounds
+                if (blk, j) in contrib:
+                    kw = w[j] + scal_ref[koff + j]
+                else:
+                    # Constant word: K[j]+W[j] on the scalar plane; it
+                    # broadcasts inside _round's existing t1 add, saving
+                    # the per-lane add on the materialized tile.
+                    kw = (scal_ref[_TMPL_OFF + blk * 16 + j]
+                          + scal_ref[koff + j])
                 a, b, c, d, e, f, g, h = _round(
-                    a, b, c, d, e, f, g, h, w[j] + scal_ref[koff + j])
+                    a, b, c, d, e, f, g, h, kw)
 
             carry = jax.lax.fori_loop(   # rounds 16-63, rolled
                 1, 4, _make_block16(scal_ref, koff, guard_first=False),
