@@ -250,10 +250,15 @@ def main() -> int:
     except Exception as exc:  # noqa: BLE001
         until_detail = {"until_error": repr(exc)[:200]}
 
+    from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
+
     _emit(best["rate"], {
         "tier": best_tier,
         "devices": len(devices),
         "platform": devices[0].platform,
+        # Self-describing artifact: which pallas kernel shape ran
+        # (chip_chain's bench-peel stage sets DBM_PEEL=1).
+        **({"peel": True} if peel_enabled() else {}),
         "range": best["range"],
         "batch": batch,
         "repeats": best["reps"],

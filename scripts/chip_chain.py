@@ -61,12 +61,9 @@ def _validate_smoke(out: str, rc: int) -> str | None:
     return None
 
 
-def _validate_bench(out: str, rc: int) -> str | None:
-    if rc != 0:
-        return f"exit {rc}"
-    # Last parseable bench line (metric key required): stray braces in
-    # the merged stderr stream must not shadow or break the real line.
-    obj = None
+def _bench_obj(out: str) -> dict | None:
+    """Last parseable bench JSON line (metric key required): stray
+    braces in the merged stderr stream must not shadow or break it."""
     for ln in reversed(out.splitlines()):
         if not ln.startswith("{"):
             continue
@@ -75,8 +72,14 @@ def _validate_bench(out: str, rc: int) -> str | None:
         except ValueError:
             continue
         if isinstance(cand, dict) and "metric" in cand:
-            obj = cand
-            break
+            return cand
+    return None
+
+
+def _validate_bench(out: str, rc: int) -> str | None:
+    if rc != 0:
+        return f"exit {rc}"
+    obj = _bench_obj(out)
     if obj is None:
         return "no bench JSON line"
     # bench.py nests platform under "detail" (bench.py _emit).
@@ -84,6 +87,22 @@ def _validate_bench(out: str, rc: int) -> str | None:
     from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
     if platform not in CHIP_PLATFORMS:
         return f"platform={platform} (CPU fallback does not count)"
+    return None
+
+
+def _validate_bench_peel(out: str, rc: int) -> str | None:
+    """bench-peel is rate evidence for the peeled PALLAS kernel: a run
+    where the pallas tier errored out and a fallback tier won would
+    still pass the platform check, so require the pallas tier to be the
+    winner with no recorded pallas error."""
+    err = _validate_bench(out, rc)
+    if err is not None:
+        return err
+    detail = _bench_obj(out).get("detail", {})
+    if detail.get("tier") != "pallas":
+        return f"best tier {detail.get('tier')!r}, not the peeled pallas"
+    if "pallas" in detail.get("tier_errors", {}):
+        return f"pallas tier errored: {detail['tier_errors']['pallas']}"
     return None
 
 
@@ -143,17 +162,44 @@ def _validate_e2e(out: str, rc: int) -> str | None:
     return None
 
 
+def _peel_validated_on_chip() -> str | None:
+    """Precondition for bench-peel: the latest smoke artifact must show
+    the peel candidate bit-exact on hardware. Returns a skip reason, or
+    None to run."""
+    import glob
+    logs = sorted(glob.glob(os.path.join(RUN_DIR, "smoke_*.log")))
+    if not logs:
+        return "no smoke artifact yet"
+    with open(logs[-1]) as fh:
+        out = fh.read()
+    if "peel candidate ok" not in out:
+        return "smoke's peel candidate leg did not validate"
+    return None
+
+
 PY = sys.executable
+# Plain stages pin DBM_PEEL=0 so an ambient operator pin can't silently
+# turn the headline artifacts into peel measurements (the smoke manages
+# the variable itself, but the pin is harmless there too).
+_DEFAULT_ENV = {"DBM_PEEL": "0"}
 STAGES = [
-    # (name, argv, budget_s, validator)
+    # (name, argv, budget_s, validator[, env, precondition])
     ("smoke", [PY, os.path.join(_SCRIPTS, "pallas_chip_smoke.py")],
-     900, _validate_smoke),
-    ("bench", [PY, os.path.join(_REPO, "bench.py")], 2400, _validate_bench),
+     900, _validate_smoke, _DEFAULT_ENV),
+    ("bench", [PY, os.path.join(_REPO, "bench.py")], 2400, _validate_bench,
+     _DEFAULT_ENV),
     ("trace", [PY, os.path.join(_SCRIPTS, "trace_mfu.py"), "trace", "29"],
-     2400, _validate_trace),
+     2400, _validate_trace, _DEFAULT_ENV),
     ("tune", [PY, os.path.join(_SCRIPTS, "tpu_tune.py"), "29"],
-     3600, _validate_tune),
-    ("e2e", [PY, os.path.join(_SCRIPTS, "chip_e2e.py")], 1800, _validate_e2e),
+     3600, _validate_tune, _DEFAULT_ENV),
+    ("e2e", [PY, os.path.join(_SCRIPTS, "chip_e2e.py")], 1800,
+     _validate_e2e, _DEFAULT_ENV),
+    # The peel-candidate bench: only after the smoke proved the peeled
+    # kernel bit-exact ON CHIP (skipped — recorded as such — otherwise).
+    # Its artifact is the rate evidence for flipping peel_enabled's
+    # default; the plain bench above stays the round's headline.
+    ("bench-peel", [PY, os.path.join(_REPO, "bench.py")], 2400,
+     _validate_bench_peel, {"DBM_PEEL": "1"}, _peel_validated_on_chip),
 ]
 
 
@@ -200,12 +246,24 @@ def main() -> int:
         if not pending:
             print("[chain] all stages done", flush=True)
             return 0
+        stage = pending[0]
+        name, argv, budget, validate = stage[:4]
+        env_extra = stage[4] if len(stage) > 4 else None
+        precond = stage[5] if len(stage) > 5 else None
+        if precond is not None:
+            # Decided from local files only — never burn (or wait for) a
+            # chip window on a stage that is going to be skipped.
+            reason = precond()
+            if reason is not None:
+                state[name] = {"done": True, "skipped": reason}
+                _save_state(state)
+                print(f"[chain] stage {name} SKIPPED: {reason}", flush=True)
+                continue
         if not _window_open(args.probe_deadline):
             if args.once:
                 return 3
             time.sleep(args.poll)
             continue
-        name, argv, budget, validate = pending[0]
         print(f"[chain] window open -> stage {name} "
               f"(budget {budget}s)", flush=True)
         t0 = time.time()
@@ -216,7 +274,9 @@ def main() -> int:
         # every later retry.
         proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True,
-                                cwd=_REPO, start_new_session=True)
+                                cwd=_REPO, start_new_session=True,
+                                env=(dict(os.environ, **env_extra)
+                                     if env_extra else None))
         try:
             out, _ = proc.communicate(timeout=budget)
             rc = proc.returncode
