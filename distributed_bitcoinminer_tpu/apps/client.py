@@ -7,12 +7,18 @@ write Request(message, 0, maxNonce), block on Read, report
 
 from __future__ import annotations
 
+import asyncio
+import logging
+import os
 from typing import Optional, Tuple
 
 from ..bitcoin.message import Message, MsgType, new_request
 from ..lsp.client import new_async_client
 from ..lsp.errors import LspError
 from ..lsp.params import Params
+from ..utils.config import RetryParams
+
+logger = logging.getLogger("dbm.client")
 
 
 async def submit(hostport: str, message: str, max_nonce: int,
@@ -109,6 +115,79 @@ async def stream_until(hostport: str, message: str, target: int,
         await client.close()
 
 
+async def submit_with_retry(hostport: str, message: str, max_nonce: int,
+                            target: int = 0,
+                            params: Optional[Params] = None,
+                            retry: Optional[RetryParams] = None,
+                            ) -> Optional[Tuple[int, int, bool]]:
+    """Idempotent submit with timeout + exponential backoff + reconnect.
+
+    The reference submitter is one-shot: a lost connection, a scheduler
+    restart, or a Result that never comes (e.g. the request was in flight
+    when the coordinator state was lost) all surface as ``Disconnected``
+    or a hang. Here each attempt is a FRESH LSP connection carrying the
+    same Request; on transport death or a per-attempt ``timeout_s``
+    expiring, the attempt's connection is closed — the scheduler sees the
+    drop and cancels any in-flight work for it (client-drop path), so the
+    resubmission cannot double-deliver — and the next attempt reconnects
+    and resubmits after an exponential backoff. A scheduler restart
+    therefore degrades to latency, not a hang.
+
+    Idempotency argument: the search is a pure function of
+    ``(message, range, target)``, so re-executing it is harmless, and at
+    most one Result reaches the caller because every attempt but the
+    returning one has its connection closed before the next begins.
+
+    Returns ``(hash, nonce, found)`` like :func:`submit_until`, or None
+    once every attempt is exhausted.
+    """
+    retry = retry if retry is not None else RetryParams()
+    delay = retry.backoff_s
+    for attempt in range(max(1, retry.attempts)):
+        if attempt:
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, retry.backoff_cap_s)
+        try:
+            client = await new_async_client(hostport, params)
+        except LspError as exc:
+            logger.info("attempt %d: connect failed (%s); will retry",
+                        attempt + 1, exc)
+            continue
+        try:
+            client.write(
+                new_request(message, 0, max_nonce, target).to_json())
+            if retry.timeout_s > 0:
+                payload = await asyncio.wait_for(client.read(),
+                                                 retry.timeout_s)
+            else:
+                payload = await client.read()
+        except (LspError, asyncio.TimeoutError) as exc:
+            logger.info("attempt %d: no Result (%r); will retry",
+                        attempt + 1, exc)
+            continue
+        finally:
+            # Close on EVERY exit — retry paths, success, and
+            # cancellation from an outer deadline (which would otherwise
+            # leak the endpoint). NOTE the close is only a local flush:
+            # classic LSP has no close handshake, so the scheduler learns
+            # of this conn's death from its epoch timer (epoch_limit *
+            # epoch_millis later) and only then cancels the abandoned
+            # request. A resubmission arriving before that queues behind
+            # the zombie — extra latency and one duplicated scan, never a
+            # wrong or doubled answer (the dead conn can't deliver).
+            # Budget timeout_s/backoff_s above the epoch death window
+            # when tuning tight-latency retries.
+            await client.close()
+        try:
+            msg = Message.from_json(payload)
+        except ValueError:
+            continue
+        if msg.type != MsgType.RESULT:
+            continue
+        return msg.hash, msg.nonce, bool(target) and msg.hash < target
+    return None
+
+
 def printable_result(result: Optional[Tuple[int, int]]) -> str:
     """Exact stdout contract of the reference (client.go:61-68)."""
     if result is None:
@@ -122,7 +201,6 @@ def main(argv=None) -> int:
     trailing ``[target]`` selecting difficulty mode (:func:`submit_until`;
     stdout contract unchanged — the printed Result is the first qualifying
     nonce, or the exact arg-min when no nonce beats the target)."""
-    import asyncio
     import sys
     argv = sys.argv if argv is None else argv
     if len(argv) not in (4, 5):
@@ -149,14 +227,29 @@ def main(argv=None) -> int:
         if target is None:
             return 1
     from ..utils import from_env
+    cfg = from_env()
+    # Retry is an explicit opt-in with more than one attempt: the retry
+    # path changes the reference CLI contract (a transport death becomes
+    # reconnect+resubmit, and a connect failure prints "Disconnected"
+    # instead of "Failed to connect"). A missing, unparsable, 0, or 1
+    # value keeps the reference behavior.
+    raw_attempts = os.environ.get("DBM_RETRY_ATTEMPTS", "")
     try:
-        if target:
+        want_retry = int(raw_attempts) > 1
+    except ValueError:
+        want_retry = False
+    try:
+        if want_retry:
+            until = asyncio.run(submit_with_retry(
+                argv[1], argv[2], max_nonce, target, cfg.params, cfg.retry))
+            result = until if until is None else until[:2]
+        elif target:
             until = asyncio.run(submit_until(argv[1], argv[2], max_nonce,
-                                             target, from_env().params))
+                                             target, cfg.params))
             result = until if until is None else until[:2]
         else:
             result = asyncio.run(submit(argv[1], argv[2], max_nonce,
-                                        from_env().params))
+                                        cfg.params))
     except LspError as exc:
         print("Failed to connect to server:", exc)
         return 1

@@ -42,6 +42,21 @@ channel-coupled goroutines:
   (ref: server.go:326-376, 222-244, 285-304).
 - Client drop: the in-flight request is cancelled immediately — miners are
   freed, parked chunks cleared, the next queued request starts.
+- Robustness plane (no reference analog; PNPCoin-style lease discipline,
+  PAPERS.md arxiv 2208.12628): every assigned chunk carries a LEASE whose
+  deadline derives from its nonce-range size and an EWMA of the assigned
+  miner's observed per-chunk throughput (pool-wide EWMA, then a flat grace,
+  when unobserved). The reference's only fault trigger is the LSP
+  epoch-limit drop; a miner whose transport still heartbeats but whose
+  compute is wedged (hung device dispatch, stalled worker thread) passes
+  that check forever. On lease expiry the chunk is speculatively RE-ISSUED
+  to an available miner — first Result wins; the loser's late Result pops
+  from its FIFO as answered/stale and is dropped by the existing
+  ``job_id``/``answered[idx]`` machinery. A miner that blows
+  ``quarantine_after`` consecutive leases is QUARANTINED: excluded from new
+  assignments until it answers again (any Result pop lifts it). Leases and
+  quarantine change scheduling latency under faults only — never the
+  answer: re-issued chunks scan the same range, so the merge is idempotent.
 
 Bookkeeping divergence from the reference (deliberate): the reference tracks
 one recorded chunk per miner plus a positional ``responsibleMiners`` list,
@@ -60,6 +75,7 @@ merge rule, one-in-flight FIFO scheduling) is unchanged.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from dataclasses import dataclass, field
@@ -69,6 +85,7 @@ from ..bitcoin.hash import MAX_U64
 from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
+from ..utils.config import LeaseParams
 
 logger = logging.getLogger("dbm.scheduler")
 
@@ -85,6 +102,20 @@ class Chunk:
     # pending FIFO (its Result must still pop in order) but no longer
     # counts against the miner's availability.
     cancelled: bool = False
+    # Lease plane. Each FIFO entry is one ASSIGNMENT: a speculative
+    # re-issue pushes a fresh Chunk object (same job/idx/range) onto the
+    # takeover miner's FIFO with its own lease, while the blown original
+    # stays in its miner's FIFO awaiting the in-order pop.
+    assigned_at: float = 0.0   # monotonic stamp set by _assign_chunk
+    deadline: float = 0.0      # lease expiry (monotonic); 0 = no lease
+    lease_blown: bool = False  # expiry observed (counted once per entry)
+    reissued: bool = False     # a speculative copy is already in flight
+
+    @property
+    def size(self) -> int:
+        """Nonce count the miner actually scans (``Upper`` read inclusive —
+        the reference bound quirk, see module docstring)."""
+        return self.upper - self.lower + 1
 
 
 @dataclass
@@ -92,6 +123,13 @@ class MinerState:
     conn_id: int
     # Every Request written to this miner, in write order (see module doc).
     pending: list = field(default_factory=list)
+    # Lease plane: observed per-chunk throughput (nonces/sec EWMA; None
+    # until the first Result), consecutive blown leases, and the
+    # quarantine latch (set at quarantine_after blown leases, cleared by
+    # any Result pop from this miner).
+    rate_ewma: Optional[float] = None
+    blown_streak: int = 0
+    quarantined: bool = False
 
     @property
     def available(self) -> bool:
@@ -134,36 +172,60 @@ class Request:
 class Scheduler:
     """Single-actor scheduler over an :class:`AsyncServer`."""
 
-    def __init__(self, server: AsyncServer):
+    def __init__(self, server: AsyncServer,
+                 lease: Optional[LeaseParams] = None):
         self.server = server
+        self.lease = lease if lease is not None else LeaseParams()
         self.miners: list[MinerState] = []      # join order, like minersArray
         self.parked: list[Chunk] = []           # chunks of dropped miners
         self.queue: list[Request] = []
         self.current: Optional[Request] = None
         self._next_job_id = 0
+        self._pool_rate: Optional[float] = None   # pool-wide throughput EWMA
+        self._dispatching = False                 # _maybe_dispatch guard
+        # Observability for tests/ops; never drives behavior.
+        self.stats = {"results_sent": 0, "dup_results": 0,
+                      "leases_blown": 0, "reissues": 0, "quarantines": 0}
 
     # ------------------------------------------------------------- main loop
 
     async def run(self) -> None:
         """Serve until the LSP server is closed."""
+        lease_task: Optional[asyncio.Task] = None
+        if self.lease.enabled:
+            lease_task = asyncio.get_running_loop().create_task(
+                self._lease_loop())
+        try:
+            while True:
+                try:
+                    conn_id, payload = await self.server.read()
+                except LspError:
+                    return
+                if isinstance(payload, Exception):
+                    self._on_drop(conn_id)
+                    continue
+                try:
+                    msg = Message.from_json(payload)
+                except ValueError:
+                    continue
+                if msg.type == MsgType.JOIN:
+                    self._on_join(conn_id)
+                elif msg.type == MsgType.REQUEST:
+                    self._on_request(conn_id, msg)
+                elif msg.type == MsgType.RESULT:
+                    self._on_result(conn_id, msg)
+        finally:
+            if lease_task is not None:
+                lease_task.cancel()
+
+    async def _lease_loop(self) -> None:
+        """Periodic lease sweep; the only timer the scheduler owns."""
         while True:
+            await asyncio.sleep(self.lease.tick_s)
             try:
-                conn_id, payload = await self.server.read()
-            except LspError:
-                return
-            if isinstance(payload, Exception):
-                self._on_drop(conn_id)
-                continue
-            try:
-                msg = Message.from_json(payload)
-            except ValueError:
-                continue
-            if msg.type == MsgType.JOIN:
-                self._on_join(conn_id)
-            elif msg.type == MsgType.REQUEST:
-                self._on_request(conn_id, msg)
-            elif msg.type == MsgType.RESULT:
-                self._on_result(conn_id, msg)
+                self._check_leases()
+            except Exception:   # noqa: BLE001 — the sweep must never die
+                logger.exception("lease sweep failed; continuing")
 
     # ---------------------------------------------------------------- events
 
@@ -171,34 +233,48 @@ class Scheduler:
         request = Request(conn_id=conn_id, data=msg.data,
                           lower=msg.lower, upper=msg.upper,
                           target=msg.target)
-        if not self.queue and self.current is None and self.miners:
-            self._load_balance(request)
-        else:
-            self.queue.append(request)
+        self.queue.append(request)
+        self._maybe_dispatch()
 
     def _on_join(self, conn_id: int) -> None:
         miner = MinerState(conn_id=conn_id)
         # A joining miner immediately absorbs one parked chunk, if any
         # (ref: server.go:222-244).
-        if self.parked:
-            self._assign_chunk(miner, self.parked.pop(0))
+        chunk = self._next_parked()
+        if chunk is not None:
+            self._assign_chunk(miner, chunk)
         self.miners.append(miner)
-        if self.current is None and self.queue:
-            self._load_balance(self.queue.pop(0))
+        self._maybe_dispatch()
 
     def _on_result(self, conn_id: int, msg: Message) -> None:
         miner = self._find_miner(conn_id)
         if miner is None or not miner.pending:
             return
         chunk = miner.pending.pop(0)   # the Result answers the oldest Request
+        self._observe_result(miner, chunk)
         # A freed miner immediately absorbs one parked chunk
         # (ref: server.go:285-304) — BEFORE the stale-Result return, so a
-        # miner freed by a stale answer still rescues parked work.
+        # miner freed by a stale answer still rescues parked work. The
+        # just-popped (job, idx) is excluded: this very Result is about to
+        # answer it, so a parked speculative copy of it is garbage — not
+        # work to hand back to the miner that just did it.
         if self.parked and miner.available:
-            self._assign_chunk(miner, self.parked.pop(0))
+            parked = self._next_parked(skip_key=(chunk.job_id, chunk.idx))
+            if parked is not None:
+                self._assign_chunk(miner, parked)
         curr = self.current
         if curr is None or chunk.job_id != curr.job_id:
             return  # stale Result for a cancelled/finished request
+        if curr.answered[chunk.idx]:
+            # Loser of a speculative re-issue race: another assignment of
+            # this same (job, idx) already merged. Re-issued copies scan
+            # the identical range, so dropping the duplicate changes
+            # nothing but the stats.
+            self.stats["dup_results"] += 1
+            logger.info("duplicate Result for job %d chunk %d from miner %d "
+                        "(speculation loser)", curr.job_id, chunk.idx,
+                        conn_id)
+            return
         if msg.hash < curr.min_hash:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
@@ -236,11 +312,16 @@ class Scheduler:
             if curr is None:
                 return
             # Recover every unanswered chunk of the current request
-            # (ref: server.go:326-376, single-chunk version).
+            # (ref: server.go:326-376, single-chunk version). Chunks whose
+            # idx already merged (speculation winner landed first) and
+            # chunks with a live speculative copy in another FIFO need no
+            # recovery — the copy is tracked independently.
             for chunk in miner.pending:
-                if chunk.job_id != curr.job_id:
+                if chunk.job_id != curr.job_id or chunk.cancelled:
                     continue
-                takeover = next((m for m in self.miners if m.available), None)
+                if curr.answered[chunk.idx] or chunk.reissued:
+                    continue
+                takeover = next((m for m in self._eligible()), None)
                 if takeover is not None:
                     self._assign_chunk(takeover, chunk)
                 else:
@@ -253,7 +334,7 @@ class Scheduler:
             curr = self.current
             if curr is not None and curr.conn_id == conn_id:
                 # Cancel immediately (divergence, see module docstring).
-                self._retire(cancel=True)
+                self._retire()
 
     # -------------------------------------------------------------- internal
 
@@ -262,30 +343,33 @@ class Scheduler:
         """Answer the client and retire the request. ``early`` = prefix
         release: the job's other chunks are still in flight."""
         self._write(curr.conn_id, new_result(h, nonce))
+        self.stats["results_sent"] += 1
         logger.info(
             "request %d served in %.3fs: [%d, %d) over %d chunks%s%s",
             curr.job_id, time.monotonic() - curr.started,
             curr.lower, curr.upper, curr.num_chunks,
             " (prefix release)" if early else "",
             " (weak merge)" if curr.weak else "")
-        self._retire(cancel=early)
+        self._retire()
 
-    def _retire(self, cancel: bool) -> None:
-        """Retire the in-flight request and start the next. ``cancel``
-        (prefix release and client drop) marks its unanswered chunks
-        cancelled: the pool frees immediately (availability is derived),
-        the FIFO pop discipline for their late Results is preserved (they
-        drop at the job_id check), and parked chunks — which can only
-        belong to the job in flight — are discarded."""
-        if cancel:
-            for m in self.miners:
-                for c in m.pending:
-                    if c.job_id == self.current.job_id:
-                        c.cancelled = True
-            self.parked.clear()
+    def _retire(self) -> None:
+        """Retire the in-flight request and start the next.
+
+        Any still-pending chunks of the retiring job (prefix release,
+        client drop, or the unanswered losers of speculative re-issues at
+        a full-barrier finish) are marked cancelled: the pool frees
+        immediately (availability is derived), the FIFO pop discipline for
+        their late Results is preserved (they drop at the job_id check),
+        and parked chunks — which can only belong to the job in flight —
+        are discarded."""
+        curr = self.current
+        for m in self.miners:
+            for c in m.pending:
+                if c.job_id == curr.job_id:
+                    c.cancelled = True
+        self.parked.clear()
         self.current = None
-        if self.queue and self.miners:
-            self._load_balance(self.queue.pop(0))
+        self._maybe_dispatch()
 
     def _find_miner(self, conn_id: int) -> Optional[MinerState]:
         for m in self.miners:
@@ -293,13 +377,61 @@ class Scheduler:
                 return m
         return None
 
+    def _next_parked(self, skip_key=None) -> Optional[Chunk]:
+        """Pop the next parked chunk that still NEEDS executing, discarding
+        stale ones: a parked chunk whose idx was meanwhile answered by a
+        speculation winner (its copy blew a lease, was re-issued, and the
+        re-issue landed first) — or whose ``(job_id, idx)`` matches
+        ``skip_key``, the assignment the caller is answering right now —
+        would only burn a full scan to pop as a duplicate."""
+        curr = self.current
+        while self.parked:
+            chunk = self.parked.pop(0)
+            if curr is None or chunk.job_id != curr.job_id or \
+                    curr.answered[chunk.idx]:
+                continue
+            if skip_key is not None and \
+                    (chunk.job_id, chunk.idx) == skip_key:
+                continue
+            return chunk
+        return None
+
+    def _eligible(self) -> list[MinerState]:
+        """Miners that may take new work: available and not quarantined."""
+        return [m for m in self.miners
+                if m.available and not m.quarantined]
+
+    def _maybe_dispatch(self) -> None:
+        """Start the next queued request when the pool can take one.
+
+        Re-entrancy guard: an empty-range request finishes INSIDE its own
+        dispatch (_load_balance -> _finish -> _retire -> here), so without
+        the guard a burst of empty-range requests would recurse one stack
+        frame set per request and overflow; with it, the inner call
+        returns immediately and the OUTER while loop drains the queue
+        iteratively."""
+        if self._dispatching:
+            return
+        self._dispatching = True
+        try:
+            while self.current is None and self.queue and self._eligible():
+                self._load_balance(self.queue.pop(0))
+        finally:
+            self._dispatching = False
+
     def _load_balance(self, request: Request) -> None:
-        """Split the range over ALL miners (they must all be available)."""
+        """Split the range over every eligible miner.
+
+        Without faults this is ALL miners (the reference invariant: one
+        request in flight, so every miner is free at dispatch); quarantined
+        or still-busy miners (wedged compute holding a live lease-blown
+        chunk) are excluded."""
+        pool = self._eligible()
         self.current = request
         self._next_job_id += 1
         request.job_id = self._next_job_id
         request.started = time.monotonic()
-        num = len(self.miners)
+        num = len(pool)
         request.upper += 1  # inclusive -> exclusive
         total = request.upper - request.lower
         if total <= 0:
@@ -317,16 +449,116 @@ class Scheduler:
         for i in range(num):
             end = start + individual + (leftover if i == 0 else 0)
             self._assign_chunk(
-                self.miners[i],
+                pool[i],
                 Chunk(request.job_id, request.data, start, end,
                       target=request.target, idx=i))
             start = end
 
     def _assign_chunk(self, miner: MinerState, chunk: Chunk) -> None:
+        now = time.monotonic()
+        chunk.assigned_at = now
+        chunk.deadline = now + self._lease_for(miner, chunk)
+        chunk.lease_blown = False
+        chunk.reissued = False
         miner.pending.append(chunk)
         self._write(miner.conn_id,
                     new_request(chunk.data, chunk.lower, chunk.upper,
                                 chunk.target))
+
+    # ---------------------------------------------------------- lease plane
+
+    def _observe_result(self, miner: MinerState, chunk: Chunk) -> None:
+        """Per-pop bookkeeping: throughput EWMA, streak reset, quarantine
+        lift. Runs for EVERY pop — stale and cancelled chunks were computed
+        too, so they are valid throughput samples, and an answer is an
+        answer for quarantine purposes ("until it answers again")."""
+        alpha = self.lease.ewma_alpha
+        if chunk.assigned_at and not chunk.lease_blown and not chunk.target:
+            # Two exclusions keep the sample set honest. Blown-lease
+            # answers: a wedged miner's eventual 60s "sample" would
+            # inflate its (and the pool's) lease to minutes and blunt
+            # re-wedge detection. Difficulty chunks: an in-kernel early
+            # exit may scan 1% of the range, so size/elapsed would
+            # overestimate throughput ~100x and starve every later
+            # stock chunk's lease.
+            elapsed = max(time.monotonic() - chunk.assigned_at, 1e-6)
+            rate = chunk.size / elapsed
+            miner.rate_ewma = rate if miner.rate_ewma is None else \
+                alpha * rate + (1 - alpha) * miner.rate_ewma
+            self._pool_rate = rate if self._pool_rate is None else \
+                alpha * rate + (1 - alpha) * self._pool_rate
+        miner.blown_streak = 0
+        if miner.quarantined:
+            miner.quarantined = False
+            logger.info("miner %d answered; quarantine lifted",
+                        miner.conn_id)
+            self._maybe_dispatch()
+
+    def _lease_for(self, miner: MinerState, chunk: Chunk) -> float:
+        """Lease duration for assigning ``chunk`` to ``miner``: headroom
+        over the EWMA-predicted scan time, clamped below; a flat grace when
+        nothing has been observed yet (cold pool)."""
+        if not self.lease.enabled:
+            return float("inf")
+        rate = miner.rate_ewma if miner.rate_ewma is not None \
+            else self._pool_rate
+        if rate is None or rate <= 0:
+            return self.lease.grace_s
+        return max(self.lease.floor_s, chunk.size / rate * self.lease.factor)
+
+    def _check_leases(self) -> None:
+        """One lease sweep: blow expired leases (quarantining repeat
+        offenders) and speculatively re-issue each blown chunk to an
+        eligible miner — first Result wins, the loser pops as a duplicate
+        (``_on_result``). A blown chunk with no taker stays watched and is
+        re-issued on a later sweep once a miner frees up or joins."""
+        curr = self.current
+        if curr is None:
+            return
+        now = time.monotonic()
+        for miner in list(self.miners):
+            for chunk in list(miner.pending):
+                if chunk.cancelled or chunk.job_id != curr.job_id:
+                    continue
+                if curr.answered[chunk.idx]:
+                    continue
+                if not chunk.lease_blown:
+                    if now < chunk.deadline:
+                        continue
+                    chunk.lease_blown = True
+                    self.stats["leases_blown"] += 1
+                    miner.blown_streak += 1
+                    logger.warning(
+                        "miner %d blew the lease on job %d chunk %d "
+                        "[%d, %d) after %.2fs (streak %d)",
+                        miner.conn_id, chunk.job_id, chunk.idx,
+                        chunk.lower, chunk.upper, now - chunk.assigned_at,
+                        miner.blown_streak)
+                    if (miner.blown_streak >= self.lease.quarantine_after
+                            and not miner.quarantined):
+                        miner.quarantined = True
+                        self.stats["quarantines"] += 1
+                        logger.warning(
+                            "miner %d quarantined after %d consecutive "
+                            "blown leases; no new assignments until it "
+                            "answers", miner.conn_id, miner.blown_streak)
+                if chunk.reissued:
+                    continue
+                takeover = next(
+                    (m for m in self._eligible() if m is not miner), None)
+                if takeover is None:
+                    continue   # retry next sweep
+                chunk.reissued = True
+                self.stats["reissues"] += 1
+                logger.warning(
+                    "speculatively re-issuing job %d chunk %d [%d, %d) "
+                    "from miner %d to miner %d",
+                    chunk.job_id, chunk.idx, chunk.lower, chunk.upper,
+                    miner.conn_id, takeover.conn_id)
+                self._assign_chunk(
+                    takeover,
+                    Chunk(chunk.job_id, chunk.data, chunk.lower,
+                          chunk.upper, target=chunk.target, idx=chunk.idx))
 
     def _write(self, conn_id: int, msg: Message) -> None:
         try:

@@ -12,13 +12,15 @@ import sys
 
 from ..lsp.params import Params
 from ..lsp.server import new_async_server
+from ..utils.config import LeaseParams
 from .scheduler import Scheduler
 
 
-async def serve(port: int, params: Params | None = None) -> None:
+async def serve(port: int, params: Params | None = None,
+                lease: LeaseParams | None = None) -> None:
     server = await new_async_server(port, params or Params())
     print("Server listening on port", server.port, flush=True)
-    scheduler = Scheduler(server)
+    scheduler = Scheduler(server, lease=lease)
     try:
         await scheduler.run()
     finally:
@@ -37,8 +39,9 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     from ..utils import configure_logging, from_env
     configure_logging(logging.INFO, logfile="log.txt")
+    cfg = from_env()
     try:
-        asyncio.run(serve(port, from_env().params))
+        asyncio.run(serve(port, cfg.params, cfg.lease))
     except KeyboardInterrupt:
         pass
     return 0

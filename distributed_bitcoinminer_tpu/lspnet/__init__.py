@@ -15,6 +15,7 @@ from .faults import (
     set_msg_shortening_percent, set_msg_lengthening_percent,
     set_msg_corrupted, set_delay_message_percent,
     reset_drop_percent, reset_all_faults, enable_debug_logs,
+    partition_conn, heal_conn, heal_all_partitions,
 )
 from .sniff import start_sniff, stop_sniff, SniffResult
 from .net import (UDPEndpoint, listen_udp, dial_udp, join_host_port,
@@ -27,6 +28,7 @@ __all__ = [
     "set_msg_shortening_percent", "set_msg_lengthening_percent",
     "set_msg_corrupted", "set_delay_message_percent",
     "reset_drop_percent", "reset_all_faults", "enable_debug_logs",
+    "partition_conn", "heal_conn", "heal_all_partitions",
     "start_sniff", "stop_sniff", "SniffResult",
     "UDPEndpoint", "listen_udp", "dial_udp",
     "join_host_port", "split_host_port",

@@ -1,0 +1,320 @@
+"""Seeded chaos harness: scripted faults over the lspnet knobs + app plane.
+
+The ``faults`` knobs mirror the reference staff harness — global packet
+drop/delay/corruption percentages. Real outages are rarely that symmetric:
+a miner process dies and comes back, a device wedges while its transport
+keeps heartbeating, one direction of one flow blackholes. This module adds
+those primitives and a deterministic, seeded schedule runner over all of
+them, so the property suite in ``tests/test_chaos.py`` can replay the same
+storm on every run:
+
+- :class:`WedgeableSearcher` — compute that can be remotely hung and
+  released, modeling a stuck device dispatch behind a healthy LSP
+  connection (the failure the scheduler's chunk leases exist for);
+- :class:`ChaosMiner` — a restartable miner handle with crash-kill,
+  wedge/unwedge, and restart;
+- one-sided partitions of a single connection
+  (:func:`lspnet.partition_conn`), driven here by miner name;
+- :func:`generate_schedule` — a seeded list of self-healing fault
+  episodes (every kill gets a restart, every wedge an unwedge, every
+  partition a heal, every knob flip a clear);
+- :func:`run_schedule` — applies a schedule on the event loop clock and
+  restores a clean network/pool state in its ``finally``, so an
+  interrupted run cannot leak faults into the next test.
+
+Determinism: schedule CONTENT is fully determined by the seed.
+Packet-level coin flips (``faults.sometimes``) ride Python's global
+``random``; call :func:`seed_packet_faults` to pin those too. Event
+TIMING rides the event-loop clock, so cross-run interleavings may differ
+— the invariants tested (eventual correct answer, no double delivery,
+pool convergence) hold for every interleaving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import faults
+
+logger = logging.getLogger("lspnet.chaos")
+
+
+def seed_packet_faults(seed: int) -> None:
+    """Pin the global RNG behind ``faults.sometimes`` drop/delay flips."""
+    random.seed(seed)
+
+
+# --------------------------------------------------------------- app plane
+
+class WedgeableSearcher:
+    """Wrap a searcher so its compute can be hung and released at will.
+
+    While wedged, ``search``/``search_until`` block in the miner's worker
+    thread — the asyncio loop keeps serving LSP heartbeats, so the
+    scheduler's epoch-limit drop detection never fires. That is exactly
+    the straggler the chunk-lease plane speculates around.
+    """
+
+    def __init__(self, inner, gate: Optional[threading.Event] = None):
+        self._inner = inner
+        if gate is None:
+            gate = threading.Event()
+            gate.set()
+        # A caller-owned gate keeps ITS state: the searcher is built
+        # lazily on the first Request, possibly after wedge() was called.
+        self.gate = gate
+        # Expose search_until ONLY when the inner searcher speaks it: the
+        # miner echoes the Request's target iff the attribute exists
+        # (apps/miner._search), and the scheduler trusts that echo to
+        # claim first-qualifying semantics — a fabricated until wrapper
+        # around a plain-argmin searcher would masquerade as
+        # extension-speaking and break the weak-merge detection. A None
+        # instance attribute shadows the class method, and the miner's
+        # `getattr(searcher, "search_until", None) is not None` check
+        # then takes the stock path (no echo), exactly like a real
+        # Target-dropping miner.
+        if not hasattr(inner, "search_until"):
+            self.search_until = None
+
+    def search(self, lower: int, upper: int):
+        self.gate.wait()
+        return self._inner.search(lower, upper)
+
+    def search_until(self, lower: int, upper: int, target: int):
+        self.gate.wait()
+        return self._inner.search_until(lower, upper, target)
+
+
+class ChaosMiner:
+    """A restartable miner with crash-kill and compute-wedge controls.
+
+    One handle models one miner "process" across restarts: each
+    :meth:`start` joins the pool as a fresh LSP connection, and the wedge
+    gate is shared across restarts (an operator unwedges a host, not a
+    process incarnation).
+    """
+
+    def __init__(self, hostport: str, params=None,
+                 searcher_factory: Optional[Callable] = None,
+                 name: str = "miner"):
+        from ..apps.miner import MinerWorker  # lazy: keep lspnet app-free
+        self._worker_cls = MinerWorker
+        self.hostport = hostport
+        self.params = params
+        self.name = name
+        self.gate = threading.Event()
+        self.gate.set()
+        inner = searcher_factory
+        if inner is None:
+            from ..apps.miner import HostSearcher
+            inner = lambda data, batch: HostSearcher(data)  # noqa: E731
+        self._factory = lambda data, batch: WedgeableSearcher(
+            inner(data, batch), self.gate)
+        self.worker = None
+        self.task: Optional[asyncio.Task] = None
+        self.restarts = 0
+
+    async def start(self) -> None:
+        assert not self.alive, f"{self.name} already running"
+        self.worker = self._worker_cls(self.hostport, params=self.params,
+                                       searcher_factory=self._factory)
+        await self.worker.join()
+        self.task = asyncio.get_running_loop().create_task(self.worker.run())
+
+    @property
+    def alive(self) -> bool:
+        return self.task is not None and not self.task.done()
+
+    @property
+    def conn_id(self) -> int:
+        """Server-side conn id of the CURRENT incarnation (0 when dead)."""
+        if self.worker is None or self.worker.client is None:
+            return 0
+        return self.worker.client.conn_id()
+
+    def wedge(self) -> None:
+        """Hang the next compute dispatch (LSP stays alive)."""
+        logger.info("chaos: wedging %s", self.name)
+        self.gate.clear()
+
+    def unwedge(self) -> None:
+        logger.info("chaos: unwedging %s", self.name)
+        self.gate.set()
+
+    @property
+    def wedged(self) -> bool:
+        return not self.gate.is_set()
+
+    async def kill(self) -> None:
+        """Crash, not close: abort the conn and drop the socket so the
+        scheduler only learns of the death from its epoch timer."""
+        if self.worker is None:
+            return
+        logger.info("chaos: killing %s (conn %d)", self.name, self.conn_id)
+        client = self.worker.client
+        if client is not None:
+            if client._conn is not None:
+                client._conn.abort()
+            if client._ep is not None:
+                client._ep.close()
+        if self.task is not None:
+            # A wedged compute thread never finishes its read loop; give
+            # the task a moment, then cancel — the to_thread compute is
+            # released by unwedge (run_schedule and tests do so in their
+            # cleanup paths).
+            try:
+                await asyncio.wait_for(asyncio.shield(self.task), 1.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self.task.cancel()
+                try:
+                    await self.task
+                except asyncio.CancelledError:
+                    pass
+            self.task = None
+        self.worker = None
+
+    async def restart(self) -> None:
+        self.restarts += 1
+        logger.info("chaos: restarting %s", self.name)
+        # Unconditional: a worker whose run() already returned (transport
+        # death) still owns an open endpoint + recv task until kill().
+        await self.kill()
+        await self.start()
+
+    async def close(self) -> None:
+        """Teardown for tests: release any wedged thread, then kill."""
+        self.unwedge()
+        await self.kill()
+
+
+# ---------------------------------------------------------------- schedule
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    at: float          # seconds from schedule start
+    action: str        # see _apply_event
+    subject: str = ""  # miner name for app-plane actions
+    value: int = 0     # percentage for knob actions
+
+
+#: Episode kinds the generator draws from; each expands to a fault event
+#: plus its healing event, so every generated schedule is self-healing.
+EPISODES = ("drop_read", "drop_write", "delay", "kill", "wedge",
+            "partition_in", "partition_out")
+
+
+def generate_schedule(seed: int, duration_s: float,
+                      miner_names: Sequence[str], *,
+                      episodes: int = 6, max_percent: int = 30,
+                      kinds: Sequence[str] = EPISODES,
+                      ) -> List[ChaosEvent]:
+    """Deterministic self-healing fault schedule for one seed.
+
+    Each episode opens a fault at a seeded time and closes it a seeded
+    interval later, always inside ``duration_s``; knob episodes draw a
+    percentage in ``[5, max_percent]``. The same (seed, duration, names,
+    kwargs) always yields the identical event list.
+    """
+    rng = random.Random(seed)
+    events: List[ChaosEvent] = []
+    # Each kind heals ITSELF only (its own knob / its own miner's conn):
+    # episodes of different kinds routinely overlap, and a global reset
+    # here would silently close another episode's still-open fault,
+    # making the applied storm weaker than the schedule claims. (Two
+    # overlapping episodes of the SAME kind still share one global knob —
+    # the first heal closes both; inherent to the reference knob set.)
+    heal_of = {"drop_read": "clear_drop_read",
+               "drop_write": "clear_drop_write",
+               "delay": "clear_delay", "kill": "restart",
+               "wedge": "unwedge", "partition_in": "heal_in",
+               "partition_out": "heal_out"}
+    for _ in range(episodes):
+        kind = rng.choice(list(kinds))
+        start = rng.uniform(0.05, duration_s * 0.6)
+        span = rng.uniform(duration_s * 0.15, duration_s * 0.35)
+        subject = rng.choice(list(miner_names)) if miner_names else ""
+        pct = rng.randint(5, max_percent)
+        events.append(ChaosEvent(round(start, 3), kind, subject, pct))
+        events.append(ChaosEvent(round(min(start + span, duration_s), 3),
+                                 heal_of[kind], subject, 0))
+    return sorted(events, key=lambda e: (e.at, e.action))
+
+
+async def _apply_event(ev: ChaosEvent,
+                       miners: Dict[str, "ChaosMiner"]) -> None:
+    m = miners.get(ev.subject)
+    if ev.action == "drop_read":
+        faults.set_read_drop_percent(ev.value)
+    elif ev.action == "drop_write":
+        faults.set_write_drop_percent(ev.value)
+    elif ev.action == "delay":
+        faults.set_delay_message_percent(ev.value)
+    elif ev.action == "clear_drop_read":
+        faults.set_read_drop_percent(0)
+    elif ev.action == "clear_drop_write":
+        faults.set_write_drop_percent(0)
+    elif ev.action == "clear_delay":
+        faults.set_delay_message_percent(0)
+    elif ev.action == "kill":
+        if m is not None and m.alive:
+            await m.kill()
+    elif ev.action == "restart":
+        if m is not None and not m.alive:
+            await m.restart()
+    elif ev.action == "wedge":
+        if m is not None:
+            m.wedge()
+    elif ev.action == "unwedge":
+        if m is not None:
+            m.unwedge()
+    elif ev.action == "partition_in":
+        if m is not None and m.alive:
+            faults.partition_conn(m.conn_id, inbound=True, outbound=False)
+    elif ev.action == "partition_out":
+        if m is not None and m.alive:
+            faults.partition_conn(m.conn_id, inbound=False, outbound=True)
+    elif ev.action in ("heal", "heal_in", "heal_out"):
+        # Heal THIS miner's current conn only, in THIS episode's
+        # direction only (see generate_schedule's heal_of note —
+        # overlapping in/out episodes must not close each other). A
+        # partition of an earlier, now-dead incarnation may linger in
+        # the sets; run_schedule's final reset clears it.
+        if m is not None:
+            faults.heal_conn(m.conn_id,
+                             inbound=ev.action != "heal_out",
+                             outbound=ev.action != "heal_in")
+    else:
+        raise ValueError(f"unknown chaos action {ev.action!r}")
+
+
+async def run_schedule(schedule: Sequence[ChaosEvent],
+                       miners: Dict[str, "ChaosMiner"]) -> int:
+    """Apply ``schedule`` on the event-loop clock; heal everything after.
+
+    Returns the number of events applied. The ``finally`` block restores
+    a fault-free network, releases every wedge, and restarts every dead
+    miner, so callers can assert post-storm convergence unconditionally.
+    """
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    applied = 0
+    try:
+        for ev in sorted(schedule, key=lambda e: (e.at, e.action)):
+            await asyncio.sleep(max(0.0, t0 + ev.at - loop.time()))
+            logger.info("chaos: t+%.2fs %s %s %s", loop.time() - t0,
+                        ev.action, ev.subject, ev.value or "")
+            await _apply_event(ev, miners)
+            applied += 1
+    finally:
+        faults.reset_all_faults()
+        for m in miners.values():
+            m.unwedge()
+        for m in miners.values():
+            if not m.alive:
+                await m.restart()
+    return applied

@@ -28,6 +28,15 @@ class _Knobs:
     delay_percent = 0
     corrupted = False
     debug = False
+    # One-sided partitions of single connections (no reference analog;
+    # chaos plane). Conn ids are server-scoped, so both sets are applied
+    # at SERVER endpoints only: ``partition_read`` drops every inbound
+    # packet whose ConnID is in the set (the server goes deaf to that
+    # peer), ``partition_write`` drops every outbound packet addressed to
+    # it (the peer goes deaf to the server). Membership in exactly one
+    # set is a one-sided partition: traffic flows the other way untouched.
+    partition_read: frozenset = frozenset()
+    partition_write: frozenset = frozenset()
 
 
 knobs = _Knobs()
@@ -87,12 +96,40 @@ def reset_drop_percent() -> None:
     set_write_drop_percent(0)
 
 
+def partition_conn(conn_id: int, *, inbound: bool = True,
+                   outbound: bool = True) -> None:
+    """Partition one connection at the server endpoint: ``inbound`` drops
+    what the server would receive from it, ``outbound`` what the server
+    would send to it. One flag = a one-sided partition (the LSP layer
+    keeps heartbeating into the void, which is exactly the asymmetric
+    failure the chaos suite wants)."""
+    if inbound:
+        knobs.partition_read = knobs.partition_read | {conn_id}
+    if outbound:
+        knobs.partition_write = knobs.partition_write | {conn_id}
+
+
+def heal_conn(conn_id: int, *, inbound: bool = True,
+              outbound: bool = True) -> None:
+    """Undo :func:`partition_conn`, per direction (defaults to both)."""
+    if inbound:
+        knobs.partition_read = knobs.partition_read - {conn_id}
+    if outbound:
+        knobs.partition_write = knobs.partition_write - {conn_id}
+
+
+def heal_all_partitions() -> None:
+    knobs.partition_read = frozenset()
+    knobs.partition_write = frozenset()
+
+
 def reset_all_faults() -> None:
     reset_drop_percent()
     knobs.shorten_percent = 0
     knobs.lengthen_percent = 0
     knobs.delay_percent = 0
     knobs.corrupted = False
+    heal_all_partitions()
 
 
 def enable_debug_logs(enable: bool) -> None:

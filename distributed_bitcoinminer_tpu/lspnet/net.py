@@ -100,6 +100,15 @@ def _parse_packet(data: bytes) -> tuple[int, dict | None]:
         return -1, None
 
 
+def _packet_conn_id(data: bytes) -> int | None:
+    """ConnID of an LSP packet, or None for non-LSP traffic."""
+    obj = _parse_packet(data)[1]
+    try:
+        return int(obj["ConnID"]) if obj is not None else None
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
 class _Protocol(asyncio.DatagramProtocol):
     """Binds to its UDPEndpoint after construction (the endpoint wraps the
     transport, which only exists once the protocol has been created)."""
@@ -119,6 +128,12 @@ class _Protocol(asyncio.DatagramProtocol):
 
     def _deliver(self, data: bytes, addr) -> None:
         ep = self._ep
+        if ep.is_server and knobs.partition_read and \
+                _packet_conn_id(data) in knobs.partition_read:
+            if knobs.debug:
+                log.info("PARTITION dropping read packet of length %d",
+                         len(data))
+            return
         drop = knobs.server_read_drop if ep.is_server else knobs.client_read_drop
         if sometimes(drop):
             if knobs.debug:
@@ -179,6 +194,12 @@ class UDPEndpoint:
             self._send_now(data, addr)
 
     def _send_now(self, data: bytes, addr) -> None:
+        if self.is_server and knobs.partition_write and \
+                _packet_conn_id(data) in knobs.partition_write:
+            if knobs.debug:
+                log.info("PARTITION dropping written packet of length %d",
+                         len(data))
+            return
         # Only pay the JSON parse when a knob or the sniffer needs the type.
         inspect = (sniff.is_sniffing() or knobs.shorten_percent
                    or knobs.lengthen_percent or knobs.corrupted)

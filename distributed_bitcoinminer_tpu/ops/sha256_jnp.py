@@ -130,7 +130,13 @@ def ensure_varying(x, axes):
     """Type ``x`` as device-varying over ``axes`` (no-op for axes it already
     varies over) so shard_map loop carries have uniform varying-axis types."""
     x = jnp.asarray(x)
-    vma = getattr(jax.typeof(x), "vma", frozenset())
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        # jax 0.4.x (jax.experimental.shard_map): no vma type system —
+        # replication is tracked by check_rep without annotations, so
+        # there is nothing to cast.
+        return x
+    vma = getattr(typeof(x), "vma", frozenset())
     missing = tuple(a for a in axes if a not in vma)
     if not missing:
         return x

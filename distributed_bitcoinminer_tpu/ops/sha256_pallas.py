@@ -191,19 +191,23 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
 
         @pl.when(jnp.logical_not(done))
         def _work():
+            # ``step`` rides in from the enclosing scope (a cond operand):
+            # calling pl.program_id INSIDE the when-branch would put the
+            # primitive in the cond's branch jaxpr, which jax 0.4.x's
+            # generic pallas interpreter cannot substitute (chip lowering
+            # is identical either way — the grid is sequential).
             _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref,
-                         rem=rem, k=k, nblocks=nblocks, rows=rows,
-                         until=True, peel=peel)
+                         step=step, rem=rem, k=k, nblocks=nblocks,
+                         rows=rows, until=True, peel=peel)
     else:
         _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, None, None,
-                     rem=rem, k=k, nblocks=nblocks, rows=rows, until=False,
-                     peel=peel)
+                     step=step, rem=rem, k=k, nblocks=nblocks, rows=rows,
+                     until=False, peel=peel)
 
 
 def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
-                 rem: int, k: int, nblocks: int, rows: int, until: bool,
-                 peel: bool = False):
-    step = pl.program_id(0)
+                 step, rem: int, k: int, nblocks: int, rows: int,
+                 until: bool, peel: bool = False):
     i0 = scal_ref[0]
     lo = scal_ref[1]
     hi = scal_ref[2]
@@ -429,6 +433,20 @@ def pallas_search_span_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
     return found, f_idx, b_hi, b_lo, b_idx
 
 
+def _out_struct(shape, vma):
+    """Output ShapeDtypeStruct, typed device-varying over ``vma`` when this
+    jax HAS vma typing (shard_map's varying-axis checker requires it); on
+    jax 0.4.x the kwarg does not exist and replication is check_rep's job,
+    so the plain struct is the correct spelling."""
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, jnp.uint32,
+                                        vma=frozenset(vma))
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(shape, jnp.uint32)
+
+
 def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
                 interpret, vma, target=None, peel=False):
     """Shared pallas_call builder for the argmin and difficulty variants."""
@@ -449,9 +467,7 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
     # in VMEM across the entire sequential grid.
     acc_spec = pl.BlockSpec((rows, _LANES), lambda s, scal: (0, 0),
                             memory_space=pltpu.VMEM)
-    acc_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32,
-                                     **({"vma": frozenset(vma)} if vma
-                                        else {}))
+    acc_shape = _out_struct((rows, _LANES), vma)
     n_out = 3 if target is None else 4
     out_specs = (acc_spec,) * n_out
     out_shapes = (acc_shape,) * n_out
@@ -460,9 +476,7 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
         # kernel reads at every step start to skip work after a hit.
         out_specs += (pl.BlockSpec((1,), lambda s, scal: (0,),
                                    memory_space=pltpu.SMEM),)
-        out_shapes += (jax.ShapeDtypeStruct((1,), jnp.uint32,
-                                            **({"vma": frozenset(vma)}
-                                               if vma else {})),)
+        out_shapes += (_out_struct((1,), vma),)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nsteps,),
@@ -474,5 +488,9 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
                           until=target is not None, peel=peel),
         out_shape=out_shapes,
         grid_spec=grid_spec,
-        interpret=pltpu.InterpretParams() if interpret else False,
+        # Mosaic TPU simulator where this jax has it; jax 0.4.x predates
+        # pltpu.InterpretParams and interprets via the boolean flag.
+        interpret=(pltpu.InterpretParams()
+                   if interpret and hasattr(pltpu, "InterpretParams")
+                   else bool(interpret)),
     )(scal)

@@ -27,8 +27,22 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map as _shard_map
+    _SHARD_MAP_KW = {}
+except ImportError:  # jax 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+    # 0.4.x check_rep has no replication rule for while_loop (the until
+    # tier's per-device early-exit loop). Disabling it is sound here: the
+    # staged pmin/pmax merges make every output replicated by
+    # construction, which is exactly what the P() out_specs declare.
+    _SHARD_MAP_KW = {"check_rep": False}
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def shard_map(f, **kw):
+    """Version-portable ``jax.shard_map`` (see _SHARD_MAP_KW above)."""
+    return _shard_map(f, **kw, **_SHARD_MAP_KW)
 
 from ..ops.search import span_scan_body, span_until_body
 

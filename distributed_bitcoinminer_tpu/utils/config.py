@@ -15,6 +15,15 @@ Environment variables:
 - ``DBM_EPOCH_LIMIT`` / ``DBM_EPOCH_MILLIS`` / ``DBM_WINDOW`` /
   ``DBM_MAX_BACKOFF``: transport parameters (defaults 5/2000/1/0, matching
   lsp/params.go:29-36).
+- ``DBM_LEASE`` (0 disables) / ``DBM_LEASE_GRACE_S`` / ``DBM_LEASE_FACTOR``
+  / ``DBM_LEASE_FLOOR_S`` / ``DBM_LEASE_TICK_S`` / ``DBM_LEASE_QUARANTINE``:
+  scheduler chunk-lease plane (apps/scheduler.py): a chunk whose lease
+  expires is speculatively re-issued, and a miner that blows
+  ``DBM_LEASE_QUARANTINE`` consecutive leases is quarantined from new
+  assignments until it answers again.
+- ``DBM_RETRY_ATTEMPTS`` / ``DBM_RETRY_TIMEOUT_S`` / ``DBM_RETRY_BACKOFF_S``
+  / ``DBM_RETRY_BACKOFF_CAP_S``: client submit-with-retry plane
+  (apps/client.py submit_with_retry).
 """
 
 from __future__ import annotations
@@ -145,11 +154,52 @@ def host_cache_dir(root: str) -> str:
     return os.path.join(root, ".jax_cache", host_fingerprint())
 
 
+@dataclass(frozen=True)
+class LeaseParams:
+    """Chunk-lease knobs for the scheduler's robustness plane.
+
+    A chunk's lease is ``max(floor_s, factor * size / rate)`` where ``rate``
+    is the assigned miner's observed per-chunk throughput EWMA (falling back
+    to the pool-wide EWMA, then to the flat ``grace_s`` when no throughput
+    has been observed yet). ``quarantine_after`` consecutive blown leases
+    quarantine a miner from new assignments until it answers again.
+    """
+    enabled: bool = True
+    grace_s: float = 30.0          # lease with no throughput history
+    factor: float = 4.0            # headroom multiplier over the estimate
+    floor_s: float = 2.0           # lower clamp on any computed lease
+    tick_s: float = 1.0            # lease-check cadence
+    quarantine_after: int = 3      # consecutive blown leases -> quarantine
+    ewma_alpha: float = 0.3        # weight of the newest throughput sample
+
+
+@dataclass(frozen=True)
+class RetryParams:
+    """Client submit-with-retry knobs (apps/client.py submit_with_retry).
+
+    ``attempts`` counts total tries (1 = the reference one-shot submit);
+    ``timeout_s`` is the per-attempt Result deadline (0 = wait forever —
+    transport death still triggers a retry); backoff between attempts is
+    exponential from ``backoff_s`` capped at ``backoff_cap_s``. Budget
+    ``timeout_s``/``backoff_s`` above the scheduler's epoch death window
+    (``epoch_limit * epoch_millis``): LSP close is a local flush with no
+    wire handshake, so an abandoned attempt's request is only cancelled
+    once the scheduler's epoch timer declares the conn lost, and a faster
+    resubmission queues behind it (latency, never a wrong answer).
+    """
+    attempts: int = 3
+    timeout_s: float = 0.0
+    backoff_s: float = 0.5
+    backoff_cap_s: float = 8.0
+
+
 @dataclass
 class FrameworkConfig:
     params: Params = field(default_factory=Params)
     compute: str = "auto"          # auto | host | jax
     batch: int | None = None       # None -> platform default
+    lease: LeaseParams = field(default_factory=LeaseParams)
+    retry: RetryParams = field(default_factory=RetryParams)
 
     def make_searcher(self, data: str):
         """Build the configured searcher for one message string.
@@ -181,6 +231,38 @@ def _int_env(name: str, default: int) -> int:
         return default
 
 
+def _float_env(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def lease_from_env() -> LeaseParams:
+    d = LeaseParams()
+    return LeaseParams(
+        enabled=_int_env("DBM_LEASE", 1) != 0,
+        grace_s=_float_env("DBM_LEASE_GRACE_S", d.grace_s),
+        factor=_float_env("DBM_LEASE_FACTOR", d.factor),
+        floor_s=_float_env("DBM_LEASE_FLOOR_S", d.floor_s),
+        tick_s=_float_env("DBM_LEASE_TICK_S", d.tick_s),
+        quarantine_after=_int_env("DBM_LEASE_QUARANTINE", d.quarantine_after),
+    )
+
+
+def retry_from_env() -> RetryParams:
+    d = RetryParams()
+    return RetryParams(
+        attempts=max(1, _int_env("DBM_RETRY_ATTEMPTS", d.attempts)),
+        timeout_s=_float_env("DBM_RETRY_TIMEOUT_S", d.timeout_s),
+        backoff_s=_float_env("DBM_RETRY_BACKOFF_S", d.backoff_s),
+        backoff_cap_s=_float_env("DBM_RETRY_BACKOFF_CAP_S", d.backoff_cap_s),
+    )
+
+
 def from_env() -> FrameworkConfig:
     params = Params(
         epoch_limit=_int_env("DBM_EPOCH_LIMIT", Params().epoch_limit),
@@ -196,4 +278,6 @@ def from_env() -> FrameworkConfig:
         # default_searcher_factory, models.default_tier) sees one casing.
         compute=os.environ.get("DBM_COMPUTE", "auto").lower(),
         batch=int(batch) if batch else None,
+        lease=lease_from_env(),
+        retry=retry_from_env(),
     )

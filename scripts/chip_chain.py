@@ -99,6 +99,14 @@ def _validate_bench_peel(out: str, rc: int) -> str | None:
     if err is not None:
         return err
     detail = _bench_obj(out).get("detail", {})
+    # bench.py emits the self-describing detail.peel flag exactly so this
+    # stage can prove the peeled kernel actually ran: if DBM_PEEL were
+    # stripped from the child env (this image's sitecustomize already
+    # overrides env vars), a rolled-kernel rate would otherwise be
+    # recorded as peel evidence and could drive the default flip
+    # (ADVICE r5).
+    if not detail.get("peel"):
+        return "bench did not run the peeled kernel (peel flag absent)"
     if detail.get("tier") != "pallas":
         return f"best tier {detail.get('tier')!r}, not the peeled pallas"
     if "pallas" in detail.get("tier_errors", {}):
@@ -172,6 +180,14 @@ def _peel_validated_on_chip() -> str | None:
         return "no smoke artifact yet"
     with open(logs[-1]) as fh:
         out = fh.read()
+    # The same log must show the run was ON CHIP (mirrors _validate_smoke):
+    # today the smoke returns before the candidate leg when off-chip, but
+    # this gate must not depend on that ordering surviving a refactor
+    # (ADVICE r5) — a simulator 'peel candidate ok' is not hardware
+    # evidence.
+    from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
+    if not any(f"platform={p}" in out for p in CHIP_PLATFORMS):
+        return "latest smoke artifact ran off-chip"
     if "peel candidate ok" not in out:
         return "smoke's peel candidate leg did not validate"
     return None

@@ -142,6 +142,16 @@ def main() -> int:
             if gu != wu or su._until_degraded:
                 print(f"peel candidate UNTIL MISMATCH: {gu} != {wu} "
                       f"(degraded={su._until_degraded})")
+            elif not native.available():
+                # Without the native oracle, `ref` is only the rolled
+                # kernel's warm result — consistency, not correctness. A
+                # shared miscompare would sail through, so print a marker
+                # chip_chain's bench-peel precondition does NOT accept
+                # (ADVICE r5: 'ok' must mean oracle-verified).
+                print(f"peel candidate consistent (no oracle): "
+                      f"rate={(hi - lo + 1) / pdt / 1e6:.1f}M nonces/s "
+                      f"({pdt:.2f}s) vs rolled {(hi - lo + 1) / dt / 1e6:.1f}M",
+                      flush=True)
             else:
                 print(f"peel candidate ok: "
                       f"rate={(hi - lo + 1) / pdt / 1e6:.1f}M nonces/s "

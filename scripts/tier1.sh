@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Tier-1 verify gate — the ONE command builders, CI, and the driver run.
+#
+# Byte-identical to the ROADMAP.md "Tier-1 verify" line (keep them in
+# sync): CPU-pinned pytest over tests/, not-slow only, collection errors
+# surfaced but non-fatal, 870s wall budget, and a DOTS_PASSED count
+# (passing-test dots in the -q progress lines) printed at the end so runs
+# that time out mid-suite still yield a comparable score.
+#
+# Usage: scripts/tier1.sh            (from anywhere; cd's to the repo root)
+# Exit code is pytest's (or timeout's 124/143 on budget exhaustion).
+
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -1,0 +1,344 @@
+"""Chaos property suite: end-to-end invariants under scripted fault storms.
+
+Exercises the robustness plane across all four layers at once — scheduler
+chunk leases + speculative re-issue + quarantine, client submit-with-retry,
+the lspnet per-conn partition primitive, and the seeded schedule runner in
+``lspnet/chaos.py`` — over real localhost UDP.
+
+Invariants asserted (module docstring of lspnet/chaos.py):
+- every submitted request is eventually answered with the TRUE arg-min
+  (checked against the host oracle);
+- no Result is delivered twice on any client connection;
+- after the storm heals, the pool converges back to all-available with
+  nothing queued, parked, or in flight.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.client import (submit, submit_until,
+                                                      submit_with_retry)
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+from distributed_bitcoinminer_tpu.bitcoin.message import (Message, MsgType,
+                                                          new_request)
+from distributed_bitcoinminer_tpu.lsp import Params
+from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+from distributed_bitcoinminer_tpu.lspnet import chaos, partition_conn
+from distributed_bitcoinminer_tpu.utils.config import (LeaseParams,
+                                                       RetryParams)
+
+
+def chaos_params(epoch_ms=40, limit=4, window=5):
+    return Params(epoch_limit=limit, epoch_millis=epoch_ms,
+                  window_size=window, max_backoff_interval=2)
+
+
+def tight_lease(quarantine_after=3):
+    """Sub-second leases so a wedged miner is caught within a test run."""
+    return LeaseParams(grace_s=0.6, factor=4.0, floor_s=0.3, tick_s=0.05,
+                      quarantine_after=quarantine_after, ewma_alpha=0.5)
+
+
+class OracleSearcher:
+    """Pure-host oracle with a small fixed delay (creates race windows)."""
+
+    def __init__(self, data: str, delay: float = 0.0):
+        self.data = data
+        self.delay = delay
+
+    def search(self, lower: int, upper: int):
+        if self.delay:
+            time.sleep(self.delay)
+        return scan_min(self.data, lower, upper)
+
+
+def oracle_factory(delay: float = 0.0):
+    return lambda data, batch: OracleSearcher(data, delay)
+
+
+def expected(data, max_nonce):
+    # The system scans [0, maxNonce+1] (reference bound quirk).
+    return scan_min(data, 0, max_nonce + 1)
+
+
+class ChaosCluster:
+    """Scheduler + ChaosMiner pool wired for fault-injection tests."""
+
+    def __init__(self, params=None, lease=None):
+        self.params = params or chaos_params()
+        self.lease = lease or tight_lease()
+        self.server = None
+        self.scheduler = None
+        self.miners = {}
+        self._sched_task = None
+
+    async def __aenter__(self):
+        self.server = await new_async_server(0, self.params)
+        self.scheduler = Scheduler(self.server, lease=self.lease)
+        self._sched_task = asyncio.create_task(self.scheduler.run())
+        return self
+
+    async def __aexit__(self, *exc):
+        for m in self.miners.values():
+            await m.close()
+        self._sched_task.cancel()
+        await self.server.close()
+
+    @property
+    def hostport(self):
+        return f"127.0.0.1:{self.server.port}"
+
+    async def add_miner(self, name, delay=0.02):
+        m = chaos.ChaosMiner(self.hostport, params=self.params,
+                             searcher_factory=oracle_factory(delay),
+                             name=name)
+        await m.start()
+        # The JOIN rides an async datagram; wait until the scheduler has
+        # registered the miner so tests split work deterministically.
+        for _ in range(200):
+            if self.scheduler._find_miner(m.conn_id) is not None:
+                break
+            await asyncio.sleep(0.01)
+        self.miners[name] = m
+        return m
+
+    def miner_state(self, name):
+        """Scheduler-side MinerState of a named miner (None if dropped)."""
+        return self.scheduler._find_miner(self.miners[name].conn_id)
+
+    async def settle(self, timeout=8.0):
+        """Wait until the pool is quiescent: nothing in flight, queued, or
+        parked, and every tracked miner is available again."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            s = self.scheduler
+            if (s.current is None and not s.queue and not s.parked
+                    and s.miners and all(m.available for m in s.miners)):
+                return True
+            await asyncio.sleep(0.02)
+        return False
+
+
+def test_wedged_miner_lease_reissue_completes():
+    """ISSUE acceptance: a miner whose LSP heartbeats but whose compute is
+    hung stalls its chunk; the lease expires, the chunk is speculatively
+    re-issued, and the client still gets the true arg-min — the scenario
+    the reference's epoch-limit-only fault detection can never resolve."""
+    async def scenario():
+        async with ChaosCluster() as c:
+            wedged = await c.add_miner("wedged")
+            await c.add_miner("healthy")
+            wedged.wedge()                     # compute hangs; LSP lives
+            result = await asyncio.wait_for(
+                submit(c.hostport, "straggler", 799, c.params), 20)
+            assert result == expected("straggler", 799)
+            assert c.scheduler.stats["reissues"] >= 1
+            assert c.scheduler.stats["leases_blown"] >= 1
+            # The wedged miner was NEVER dropped: its transport is healthy,
+            # only its compute is stuck — epoch detection alone could not
+            # have saved this request.
+            assert c.miner_state("wedged") is not None
+            wedged.unwedge()                   # release the stale compute
+            assert await c.settle()
+    asyncio.run(scenario())
+
+
+def test_wedged_miner_quarantined_then_lifted_on_answer():
+    """A repeat offender is excluded from new assignments; its eventual
+    (stale) answer lifts the quarantine."""
+    async def scenario():
+        async with ChaosCluster(lease=tight_lease(quarantine_after=1)) as c:
+            wedged = await c.add_miner("wedged")
+            await c.add_miner("healthy")
+            wedged.wedge()
+            r1 = await asyncio.wait_for(
+                submit(c.hostport, "first storm", 399, c.params), 20)
+            assert r1 == expected("first storm", 399)
+            ms = c.miner_state("wedged")
+            assert ms is not None and ms.quarantined
+            assert c.scheduler.stats["quarantines"] >= 1
+            # The next request must be served WITHOUT the quarantined
+            # miner: its pool split excludes it.
+            r2 = await asyncio.wait_for(
+                submit(c.hostport, "second wind", 299, c.params), 20)
+            assert r2 == expected("second wind", 299)
+            assert all(ch.job_id != c.scheduler._next_job_id
+                       for ch in ms.pending)
+            wedged.unwedge()
+            # The stale compute now finishes and its Result pops: any
+            # answer lifts the quarantine.
+            for _ in range(300):
+                ms = c.miner_state("wedged")
+                if ms is not None and not ms.quarantined:
+                    break
+                await asyncio.sleep(0.02)
+            assert ms is not None and not ms.quarantined
+            assert await c.settle()
+    asyncio.run(scenario())
+
+
+def test_no_double_result_on_speculation_race():
+    """Both the wedged original and the re-issued copy eventually answer;
+    the client must see exactly ONE Result (the loser pops as a stale
+    duplicate inside the scheduler)."""
+    async def scenario():
+        async with ChaosCluster() as c:
+            wedged = await c.add_miner("wedged")
+            await c.add_miner("healthy")
+            wedged.wedge()
+            client = await new_async_client(c.hostport, c.params)
+            client.write(new_request("race", 0, 599).to_json())
+            reply = Message.from_json(await asyncio.wait_for(
+                client.read(), 20))
+            assert reply.type == MsgType.RESULT
+            assert (reply.hash, reply.nonce) == expected("race", 599)
+            wedged.unwedge()    # the loser now computes and answers
+            # Poll until the loser's Result pops server-side (its FIFO
+            # drains; the pop is identified as stale/duplicate and
+            # dropped), keeping the client conn open the whole time...
+            try:
+                for _ in range(300):
+                    ms = c.miner_state("wedged")
+                    if ms is not None and not ms.pending:
+                        break
+                    await asyncio.sleep(0.02)
+                assert ms is not None and not ms.pending
+                # ...and assert NOTHING ELSE was delivered on this conn.
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(client.read(), 0.7)
+            finally:
+                await client.close()
+            assert await c.settle()
+            assert c.scheduler.stats["results_sent"] == 1
+    asyncio.run(scenario())
+
+
+def test_one_sided_partition_declares_miner_lost_and_recovers():
+    """Server goes deaf to one miner (inbound partition) while the miner
+    still hears the server: the epoch timer declares it lost, its chunk is
+    recovered, and the request completes."""
+    async def scenario():
+        async with ChaosCluster() as c:
+            doomed = await c.add_miner("doomed", delay=0.5)
+            await c.add_miner("healthy")
+            pending = asyncio.create_task(
+                submit(c.hostport, "split brain", 399, c.params))
+            await asyncio.sleep(0.25)          # both miners hold chunks
+            partition_conn(doomed.conn_id, inbound=True, outbound=False)
+            result = await asyncio.wait_for(pending, 20)
+            assert result == expected("split brain", 399)
+            # The one-sided victim's conn was dropped server-side.
+            assert c.miner_state("doomed") is None
+            await doomed.close()
+            del c.miners["doomed"]
+            assert await c.settle()
+    asyncio.run(scenario())
+
+
+def test_client_retry_across_scheduler_restart():
+    """submit_with_retry reconnects and resubmits after the scheduler dies
+    mid-request and a fresh one takes over the same port: the restart
+    degrades to latency, not a hang or Disconnected."""
+    async def scenario():
+        params = chaos_params()
+        server1 = await new_async_server(0, params)
+        port = server1.port
+        sched1 = Scheduler(server1, lease=tight_lease())
+        t1 = asyncio.create_task(sched1.run())
+        m1 = chaos.ChaosMiner(f"127.0.0.1:{port}", params=params,
+                              searcher_factory=oracle_factory(0.4),
+                              name="m1")
+        await m1.start()
+        pending = asyncio.create_task(submit_with_retry(
+            f"127.0.0.1:{port}", "nine lives", 499, params=params,
+            retry=RetryParams(attempts=6, timeout_s=5.0, backoff_s=0.2,
+                              backoff_cap_s=1.0)))
+        await asyncio.sleep(0.25)              # request is in flight
+        t1.cancel()
+        await server1.close()                  # coordinator crash
+        await m1.close()                       # its pool dies with it
+        server2 = await new_async_server(port, params)   # same port
+        sched2 = Scheduler(server2, lease=tight_lease())
+        t2 = asyncio.create_task(sched2.run())
+        m2 = chaos.ChaosMiner(f"127.0.0.1:{port}", params=params,
+                              searcher_factory=oracle_factory(0.02),
+                              name="m2")
+        await m2.start()
+        try:
+            result = await asyncio.wait_for(pending, 30)
+            assert result is not None
+            h, n, found = result
+            assert (h, n) == expected("nine lives", 499)
+            assert not found                   # no target requested
+            assert sched2.stats["results_sent"] == 1
+        finally:
+            await m2.close()
+            t2.cancel()
+            await server2.close()
+    asyncio.run(scenario())
+
+
+def test_client_retry_difficulty_target_mode():
+    """Retry path preserves submit_until semantics: found iff the answer
+    beats the target."""
+    async def scenario():
+        async with ChaosCluster() as c:
+            await c.add_miner("solo")
+            target = 1 << 60                   # loose: guaranteed hit
+            got = await asyncio.wait_for(submit_with_retry(
+                c.hostport, "difficulty", 2999, target, c.params,
+                RetryParams(attempts=3, timeout_s=10.0)), 30)
+            assert got is not None
+            h, n, found = got
+            assert found and h < target
+            ref = await asyncio.wait_for(
+                submit_until(c.hostport, "difficulty", 2999, target,
+                             c.params), 30)
+            assert ref is not None and ref[2]
+            assert await c.settle()
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_seeded_chaos_schedule_invariants(seed):
+    """The headline property test: a seeded self-healing fault storm
+    (kills, wedges, one-sided partitions, drop/delay knobs) rides over a
+    3-miner pool while clients keep submitting; every request must come
+    back with the oracle arg-min, exactly one Result per request, and the
+    pool must converge to all-available after the storm."""
+    async def scenario():
+        chaos.seed_packet_faults(seed)
+        async with ChaosCluster(lease=tight_lease(quarantine_after=3)) as c:
+            for name in ("alpha", "beta", "gamma"):
+                await c.add_miner(name, delay=0.02)
+            schedule = chaos.generate_schedule(
+                seed, 3.0, list(c.miners), episodes=5, max_percent=25)
+            assert schedule == chaos.generate_schedule(
+                seed, 3.0, list(c.miners), episodes=5,
+                max_percent=25)        # determinism: same seed, same storm
+            storm = asyncio.create_task(
+                chaos.run_schedule(schedule, c.miners))
+            jobs = [("storm one", 399), ("storm two", 499),
+                    ("storm three", 299), ("storm four", 449)]
+            retry = RetryParams(attempts=8, timeout_s=2.5, backoff_s=0.1,
+                                backoff_cap_s=0.5)
+            try:
+                for data, max_nonce in jobs:
+                    got = await asyncio.wait_for(submit_with_retry(
+                        c.hostport, data, max_nonce, 0, c.params, retry),
+                        40)
+                    # Eventual answer, and the TRUE arg-min: re-issued and
+                    # retried work never changes the merge.
+                    assert got is not None, f"{data} never answered"
+                    assert got[:2] == expected(data, max_nonce)
+            finally:
+                await asyncio.wait_for(storm, 20)
+            # Post-storm convergence: all healed, nothing in flight.
+            assert await c.settle(timeout=12.0)
+            assert c.scheduler.queue == []
+            assert c.scheduler.parked == []
+    asyncio.run(scenario())

@@ -94,6 +94,9 @@ def test_kernel_lowers_for_tpu_platform():
     import functools
 
     import jax
+    import jax.export  # noqa: F401 — on jax 0.4.x the submodule is lazy:
+    #                    bare `jax.export.export` raises AttributeError
+    #                    until explicitly imported
     import jax.numpy as jnp
 
     args = (jnp.zeros(8, jnp.uint32), jnp.zeros((1, 16), jnp.uint32),

@@ -1,0 +1,248 @@
+"""Deterministic scheduler-level tests of the drop-recovery state machine.
+
+The chaos suite (test_chaos.py) exercises these paths over real UDP with
+real timing; here the same transitions are driven synchronously through the
+Scheduler's event handlers against a recording fake server, so each
+interleaving — parked-chunk absorption on join and on free, stale-Result
+pop ordering, client-drop racing a miner drop, lease expiry bookkeeping —
+is pinned exactly, with no sleeps and no races.
+
+These are the recovery paths the lease machinery extends (ISSUE 1 satellite):
+regressions here historically hid behind timing luck in the e2e tests.
+"""
+
+from distributed_bitcoinminer_tpu.apps.scheduler import Request, Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.hash import MAX_U64
+from distributed_bitcoinminer_tpu.bitcoin.message import (
+    Message, MsgType, new_join, new_request, new_result)
+from distributed_bitcoinminer_tpu.utils.config import LeaseParams
+
+
+class FakeServer:
+    """Records every write; the scheduler never reads from it directly."""
+
+    def __init__(self):
+        self.writes = []   # (conn_id, Message)
+
+    def write(self, conn_id, payload):
+        self.writes.append((conn_id, Message.from_json(payload)))
+
+    def sent_to(self, conn_id, mtype=None):
+        return [m for c, m in self.writes
+                if c == conn_id and (mtype is None or m.type == mtype)]
+
+
+def make_scheduler(**lease_kw):
+    lease = LeaseParams(**lease_kw) if lease_kw else LeaseParams()
+    server = FakeServer()
+    return Scheduler(server, lease=lease), server
+
+
+def join(sched, conn_id):
+    sched._on_join(conn_id)
+
+
+def request(sched, conn_id, data, max_nonce, target=0):
+    sched._on_request(conn_id, new_request(data, 0, max_nonce, target))
+
+
+def result(sched, conn_id, h=1, nonce=0, target=0):
+    sched._on_result(conn_id, new_result(h, nonce, target))
+
+
+MINER_A, MINER_B, MINER_C = 1, 2, 3
+CLIENT_X, CLIENT_Y = 10, 11
+
+
+def test_parked_chunk_absorbed_on_join():
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "park me", 99)
+    assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 1
+    sched._on_drop(MINER_A)            # no spare: the chunk parks
+    assert len(sched.parked) == 1
+    join(sched, MINER_B)               # joiner absorbs it immediately
+    assert sched.parked == []
+    reqs = server.sent_to(MINER_B, MsgType.REQUEST)
+    assert len(reqs) == 1
+    assert (reqs[0].lower, reqs[0].upper) == (0, 100)
+    result(sched, MINER_B)
+    assert len(server.sent_to(CLIENT_X, MsgType.RESULT)) == 1
+
+
+def test_parked_chunk_absorbed_on_free():
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "two chunks", 199)
+    assert sched.current.num_chunks == 2
+    sched._on_drop(MINER_B)            # A is busy -> B's chunk parks
+    assert len(sched.parked) == 1
+    result(sched, MINER_A)             # A frees and must absorb the park
+    assert sched.parked == []
+    reqs = server.sent_to(MINER_A, MsgType.REQUEST)
+    assert len(reqs) == 2              # its own chunk + the rescued one
+    result(sched, MINER_A)             # answers the rescued chunk
+    assert len(server.sent_to(CLIENT_X, MsgType.RESULT)) == 1
+    assert all(m.available for m in sched.miners)
+
+
+def test_stale_result_pops_in_fifo_order():
+    """A cancelled chunk still occupies its slot in the miner's pending
+    FIFO: the miner answers sequentially, so the first Result after a
+    cancellation answers the CANCELLED chunk (dropped as stale) and only
+    the next one answers the live assignment."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "doomed", 99)
+    sched._on_drop(CLIENT_X)           # client gone: chunk cancelled
+    old_chunk = sched.miners[0].pending[0]
+    assert old_chunk.cancelled and sched.current is None
+    request(sched, CLIENT_Y, "live", 199)
+    assert [c.data for c in sched.miners[0].pending] == ["doomed", "live"]
+    result(sched, MINER_A, h=7, nonce=3)   # answers "doomed": stale, dropped
+    assert server.sent_to(CLIENT_Y) == []
+    assert [c.data for c in sched.miners[0].pending] == ["live"]
+    result(sched, MINER_A, h=9, nonce=5)   # answers "live": released
+    replies = server.sent_to(CLIENT_Y, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(9, 5)]
+    assert sched.miners[0].pending == []
+
+
+def test_client_drop_then_miner_drop_does_not_resurrect_chunks():
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "racing", 199)
+    sched._on_drop(CLIENT_X)           # cancel first
+    assert sched.current is None
+    assert all(c.cancelled for m in sched.miners for c in m.pending)
+    sched._on_drop(MINER_A)            # then the miner dies
+    # Its cancelled chunk must NOT be reassigned or parked.
+    assert sched.parked == []
+    assert len(server.sent_to(MINER_B, MsgType.REQUEST)) == 1  # only its own
+    request(sched, CLIENT_Y, "fresh", 99)   # pool still serves
+    result(sched, MINER_B)                  # stale pop for "racing"
+    result(sched, MINER_B)                  # answers "fresh"
+    assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
+
+
+def test_miner_drop_then_client_drop_clears_parked():
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "racing", 199)
+    sched._on_drop(MINER_B)            # A busy -> B's chunk parks
+    assert len(sched.parked) == 1
+    sched._on_drop(CLIENT_X)           # the requester dies too
+    assert sched.parked == []          # parked work of a dead client: gone
+    assert sched.current is None
+    request(sched, CLIENT_Y, "fresh", 99)
+    result(sched, MINER_A)             # stale pop for "racing"
+    result(sched, MINER_A)             # answers "fresh"
+    assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
+
+
+def test_lease_expiry_reissues_and_quarantines():
+    """Unit-level lease sweep: expiry re-issues to an eligible miner once,
+    repeat offenses quarantine, and the answer lifts the quarantine
+    (timing-free complement to the chaos e2e)."""
+    sched, server = make_scheduler(grace_s=30.0, quarantine_after=1,
+                                   floor_s=0.1, factor=4.0, tick_s=0.01)
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    join(sched, MINER_C)
+    request(sched, CLIENT_X, "slow pool", 299)
+    assert sched.current.num_chunks == 3
+    a = sched._find_miner(MINER_A)
+    stuck = a.pending[0]
+    result(sched, MINER_C)             # C frees: an eligible takeover
+    stuck.deadline = 0.0               # force A's lease into the past
+    sched._check_leases()
+    assert sched.stats["leases_blown"] == 1
+    assert sched.stats["reissues"] == 1
+    assert stuck.lease_blown and stuck.reissued
+    assert a.quarantined               # quarantine_after=1
+    copies = [m for m in server.sent_to(MINER_C, MsgType.REQUEST)
+              if (m.lower, m.upper) == (stuck.lower, stuck.upper)]
+    assert len(copies) == 1            # C's own chunk + exactly one copy
+    # A second sweep must not double-issue the same chunk.
+    sched._check_leases()
+    assert sched.stats["reissues"] == 1
+    # First Result wins; the request completes without A.
+    result(sched, MINER_B)
+    result(sched, MINER_C)
+    assert len(server.sent_to(CLIENT_X, MsgType.RESULT)) == 1
+    # Retire cancelled A's stale copy, so A is available but quarantined:
+    # it gets no part of the next request.
+    assert a.available and a.quarantined
+    request(sched, CLIENT_Y, "without A", 199)
+    assert sched.current.num_chunks == 2
+    assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 1  # nothing new
+    # A's eventual stale answer lifts the quarantine.
+    result(sched, MINER_A)
+    assert not a.quarantined and a.blown_streak == 0
+    result(sched, MINER_B)
+    result(sched, MINER_C)
+    assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
+
+
+def test_duplicate_result_in_flight_is_dropped_and_counted():
+    """The speculation loser answers while the job is STILL in flight
+    (another chunk unanswered): merged idx pops as a duplicate, the client
+    sees exactly one Result at the barrier."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    join(sched, MINER_C)
+    request(sched, CLIENT_X, "dup race", 299)
+    a = sched._find_miner(MINER_A)
+    stuck = a.pending[0]
+    result(sched, MINER_C, h=50, nonce=7)  # C frees (its chunk answered)
+    stuck.deadline = 0.0
+    sched._check_leases()                  # re-issue A's chunk to C
+    assert sched.stats["reissues"] == 1
+    result(sched, MINER_C, h=40, nonce=2)  # the COPY answers chunk 0
+    assert sched.current.answered[stuck.idx]
+    result(sched, MINER_A, h=40, nonce=2)  # the loser answers: duplicate
+    assert sched.stats["dup_results"] == 1
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []  # barrier holds
+    result(sched, MINER_B, h=60, nonce=9)  # last live chunk
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(40, 2)]
+
+
+def test_empty_range_burst_drains_iteratively():
+    """Regression: each empty-range request finishes inside its own
+    dispatch, so a burst of them must drain through _maybe_dispatch's
+    re-entrancy guard iteratively — not one recursion frame set per
+    request (a ~250-deep burst used to overflow the stack and kill the
+    scheduler actor)."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    bad = Message(type=MsgType.REQUEST, data="void", lower=5, upper=3)
+    for _ in range(2000):
+        sched.queue.append(Request(conn_id=CLIENT_X, data="void",
+                                   lower=5, upper=3))
+    sched._on_request(CLIENT_X, bad)   # triggers the drain
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert len(replies) == 2001
+    assert all((m.hash, m.nonce) == (MAX_U64, 0) for m in replies)
+    assert sched.queue == [] and sched.current is None
+
+
+def test_empty_range_still_answers_with_quarantined_miner_present():
+    """_load_balance must split over ELIGIBLE miners only; a quarantined
+    straggler neither blocks dispatch nor receives work."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    sched._find_miner(MINER_A).quarantined = True
+    request(sched, CLIENT_X, "one lane", 99)
+    assert sched.current.num_chunks == 1
+    assert server.sent_to(MINER_A, MsgType.REQUEST) == []
+    bad = Message(type=MsgType.REQUEST, data="void", lower=5, upper=3)
+    sched._on_request(CLIENT_Y, bad)       # queued behind the live job
+    result(sched, MINER_B)
+    replies = server.sent_to(CLIENT_Y, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(MAX_U64, 0)]
