@@ -144,14 +144,27 @@ def main() -> int:
     data = "cmu440"
     tier_req = os.environ.get("DBM_COMPUTE", "auto").lower()
 
-    def build(tier: str):
+    def build(tier: str, hoist: bool | None = None):
         if tier == "host":
             from distributed_bitcoinminer_tpu.apps.miner import HostSearcher
             return HostSearcher(data)
         if len(devices) > 1:
             return ShardedNonceSearcher(
-                data, batch=batch, mesh=make_mesh(len(devices)), tier=tier)
-        return NonceSearcher(data, batch=batch, tier=tier)
+                data, batch=batch, mesh=make_mesh(len(devices)), tier=tier,
+                hoist=hoist)
+        return NonceSearcher(data, batch=batch, tier=tier, hoist=hoist)
+
+    def hoist_counters(searcher, lo, hi):
+        """Hoist telemetry of the measured range's (single) block plan."""
+        plans = list(searcher.plan(lo, hi)) if hasattr(searcher, "plan") \
+            else []
+        if not plans or plans[0].hoist is None:
+            return {"enabled": False}
+        h = plans[0].hoist
+        return {"enabled": True, "rem": plans[0].rem, "k": plans[0].k,
+                "hoisted_rounds": h.hoisted_rounds,
+                "schedule_terms_hoisted": h.schedule_terms_hoisted,
+                "const_schedule_blocks": sum(h.full_const)}
 
     if tier_req in ("jnp", "pallas", "host"):
         tiers = [tier_req]
@@ -191,6 +204,19 @@ def main() -> int:
             results[tier] = {"rate": rate, "secs": secs, "reps": reps,
                              "range": t_upper - lower + 1,
                              "warmup_s": round(warm_s, 3)}
+            if tier == "jnp":
+                # Before/after evidence for the hoist (the BENCH_r*
+                # trajectory tracks the win): one cheap re-measure of the
+                # same geometry with DBM_HOIST forced off. Isolated like
+                # the overlap number — its failure never marks the tier.
+                try:
+                    plain = build(tier, hoist=False)
+                    plain.search(lower, t_upper)   # warm its signature
+                    no_rate, _, _ = _measure(plain, lower, t_upper,
+                                             min_time_s / 2, Timer)
+                    results[tier]["no_hoist_rate"] = round(no_rate, 1)
+                except Exception as exc:  # noqa: BLE001
+                    results[tier]["no_hoist_error"] = repr(exc)[:200]
             if hasattr(searcher, "dispatch"):
                 # Isolated: a failed overlap measurement must not mark a
                 # tier whose sequential number already succeeded as failed.
@@ -250,12 +276,44 @@ def main() -> int:
     except Exception as exc:  # noqa: BLE001
         until_detail = {"until_error": repr(exc)[:200]}
 
+    # rem-sweep micro-bench (DBM_BENCH_REM_SWEEP=1): the hoist depth is a
+    # function of rem = len(prefix) % 64, so sweep message lengths across
+    # the word/block-boundary cases and record hoisted vs plain jnp rates
+    # at a small fixed geometry. Opt-in: the default artifact is
+    # unchanged and the driver's timing budget untouched.
+    sweep_detail = {}
+    if os.environ.get("DBM_BENCH_REM_SWEEP", "0") == "1":
+        try:
+            from distributed_bitcoinminer_tpu.utils.profiling import Timer
+            sweep = []
+            s_lo, s_count = 1_000_000, 1 << 20   # one 7-digit block, k=7
+            for rem in (0, 4, 7, 31, 55, 62):
+                s_data = "a" * (rem - 1) if rem >= 1 else "a" * 63
+                entry = {"rem": rem}
+                for label, hflag in (("hoist", True), ("plain", False)):
+                    s = NonceSearcher(s_data, batch=batch, tier="jnp",
+                                      hoist=hflag)
+                    s.search(s_lo, s_lo + s_count - 1)   # warm
+                    r, _, _ = _measure(s, s_lo, s_lo + s_count - 1,
+                                       min_time_s / 2, Timer)
+                    entry[label] = round(r, 1)
+                entry.update(hoist_counters(
+                    NonceSearcher(s_data, batch=batch, tier="jnp"),
+                    s_lo, s_lo + s_count - 1))
+                sweep.append(entry)
+            sweep_detail = {"rem_sweep": sweep}
+        except Exception as exc:  # noqa: BLE001
+            sweep_detail = {"rem_sweep_error": repr(exc)[:200]}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
 
     _emit(best["rate"], {
         "tier": best_tier,
         "devices": len(devices),
         "platform": devices[0].platform,
+        # Hoist telemetry of the measured block (jnp-tier counters; the
+        # pallas peel shape consumes the same plan).
+        "hoist": hoist_counters(build("jnp"), lower, best_upper),
         # Self-describing artifact: which pallas kernel shape ran
         # (chip_chain's bench-peel stage sets DBM_PEEL=1).
         **({"peel": True} if peel_enabled() else {}),
@@ -265,10 +323,15 @@ def main() -> int:
         "timed_s": round(best["secs"], 3),
         "warmup_s": best["warmup_s"],
         "all_tiers": {t: round(r["rate"], 1) for t, r in results.items()},
+        # Before/after evidence for the hoist (DBM_HOIST=0 re-measure).
+        **({"no_hoist": {t: r["no_hoist_rate"] for t, r in results.items()
+                         if "no_hoist_rate" in r}}
+           if any("no_hoist_rate" in r for r in results.values()) else {}),
         # The SURVEY §7 waterfall: sequential vs dispatch-pipelined rates.
         "overlapped": {t: r["overlapped_rate"] for t, r in results.items()
                        if "overlapped_rate" in r},
         **until_detail,
+        **sweep_detail,
         **({"tier_errors": errors} if errors else {}),
         **({"probe": probe} if force_cpu else {}),
     })

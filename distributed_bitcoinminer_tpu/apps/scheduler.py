@@ -85,9 +85,43 @@ from ..bitcoin.hash import MAX_U64
 from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
-from ..utils.config import LeaseParams
+from ..utils.config import CacheParams, LeaseParams
 
 logger = logging.getLogger("dbm.scheduler")
+
+
+class ResultCache:
+    """Bounded LRU of finished Results, keyed on the full request
+    identity ``(data, lower, upper, target)``.
+
+    submit_with_retry re-submits the identical request after a lost
+    Result; without memoization every retry re-ran the whole search. A
+    hit replays the recorded answer in O(1) — sound because the answer
+    is a pure function of the key: the arg-min (and the
+    first-qualifying-nonce difficulty answer) of a fixed range is
+    deterministic. The one non-deterministic case — a WEAK difficulty
+    merge, where a stock Target-dropping miner answered a chunk — is
+    never stored (see Scheduler._finish).
+    """
+
+    def __init__(self, size: int):
+        self.size = size
+        self._d: dict = {}     # insertion order == LRU order (py3.7+)
+
+    def get(self, key):
+        hit = self._d.pop(key, None)
+        if hit is not None:
+            self._d[key] = hit          # refresh recency
+        return hit
+
+    def put(self, key, value) -> None:
+        self._d.pop(key, None)
+        self._d[key] = value
+        while len(self._d) > self.size:
+            self._d.pop(next(iter(self._d)))
+
+    def __len__(self):
+        return len(self._d)
 
 
 @dataclass
@@ -167,15 +201,23 @@ class Request:
     # surfaced in logs, invisible on the reference-shaped wire).
     weak: bool = False
     started: float = 0.0           # set at dispatch (load_balance)
+    # Memoization / observability plane.
+    cache_key: Optional[tuple] = None  # (data, lower, upper, target) as received
+    queued_at: float = 0.0         # monotonic stamp set at _on_request
+    last_alarm: float = 0.0        # last queue-age warning for this request
 
 
 class Scheduler:
     """Single-actor scheduler over an :class:`AsyncServer`."""
 
     def __init__(self, server: AsyncServer,
-                 lease: Optional[LeaseParams] = None):
+                 lease: Optional[LeaseParams] = None,
+                 cache: Optional[CacheParams] = None):
         self.server = server
         self.lease = lease if lease is not None else LeaseParams()
+        self.cache = cache if cache is not None else CacheParams()
+        self.results: Optional[ResultCache] = (
+            ResultCache(self.cache.size) if self.cache.enabled else None)
         self.miners: list[MinerState] = []      # join order, like minersArray
         self.parked: list[Chunk] = []           # chunks of dropped miners
         self.queue: list[Request] = []
@@ -183,18 +225,21 @@ class Scheduler:
         self._next_job_id = 0
         self._pool_rate: Optional[float] = None   # pool-wide throughput EWMA
         self._dispatching = False                 # _maybe_dispatch guard
+        self._starved = False                     # no-eligible-miner latch
         # Observability for tests/ops; never drives behavior.
         self.stats = {"results_sent": 0, "dup_results": 0,
-                      "leases_blown": 0, "reissues": 0, "quarantines": 0}
+                      "leases_blown": 0, "reissues": 0, "quarantines": 0,
+                      "cache_hits": 0, "cache_stores": 0,
+                      "queue_alarms": 0, "no_eligible_miner": 0}
 
     # ------------------------------------------------------------- main loop
 
     async def run(self) -> None:
         """Serve until the LSP server is closed."""
-        lease_task: Optional[asyncio.Task] = None
-        if self.lease.enabled:
-            lease_task = asyncio.get_running_loop().create_task(
-                self._lease_loop())
+        # The sweep runs even with leases disabled: the queue-age alarm
+        # (an observability plane, not a scheduling one) rides it.
+        lease_task = asyncio.get_running_loop().create_task(
+            self._lease_loop())
         try:
             while True:
                 try:
@@ -219,20 +264,39 @@ class Scheduler:
                 lease_task.cancel()
 
     async def _lease_loop(self) -> None:
-        """Periodic lease sweep; the only timer the scheduler owns."""
+        """Periodic sweep; the only timer the scheduler owns. Checks
+        chunk leases (when enabled) and the queued-request age alarm."""
         while True:
             await asyncio.sleep(self.lease.tick_s)
             try:
-                self._check_leases()
+                if self.lease.enabled:
+                    self._check_leases()
+                self._check_queue_age()
             except Exception:   # noqa: BLE001 — the sweep must never die
                 logger.exception("lease sweep failed; continuing")
 
     # ---------------------------------------------------------------- events
 
     def _on_request(self, conn_id: int, msg: Message) -> None:
+        key = (msg.data, msg.lower, msg.upper, msg.target)
+        if self.results is not None:
+            hit = self.results.get(key)
+            if hit is not None:
+                # O(1) replay: a retried/resubmitted request after a lost
+                # Result answers from the memo without touching the pool
+                # (and without queueing behind the in-flight request).
+                h, nonce = hit
+                self._write(conn_id, new_result(h, nonce))
+                self.stats["results_sent"] += 1
+                self.stats["cache_hits"] += 1
+                logger.info("request %r [%d, %d] target=%d answered from "
+                            "the result cache", msg.data, msg.lower,
+                            msg.upper, msg.target)
+                return
         request = Request(conn_id=conn_id, data=msg.data,
                           lower=msg.lower, upper=msg.upper,
-                          target=msg.target)
+                          target=msg.target, cache_key=key,
+                          queued_at=time.monotonic())
         self.queue.append(request)
         self._maybe_dispatch()
 
@@ -344,6 +408,12 @@ class Scheduler:
         release: the job's other chunks are still in flight."""
         self._write(curr.conn_id, new_result(h, nonce))
         self.stats["results_sent"] += 1
+        if self.results is not None and curr.cache_key is not None \
+                and not curr.weak:
+            # Weak merges excluded: "a qualifying nonce" from a stock
+            # miner is not a deterministic function of the key.
+            self.results.put(curr.cache_key, (h, nonce))
+            self.stats["cache_stores"] += 1
         logger.info(
             "request %d served in %.3fs: [%d, %d) over %d chunks%s%s",
             curr.job_id, time.monotonic() - curr.started,
@@ -415,9 +485,44 @@ class Scheduler:
         self._dispatching = True
         try:
             while self.current is None and self.queue and self._eligible():
-                self._load_balance(self.queue.pop(0))
+                req = self.queue.pop(0)
+                if self.results is not None and req.cache_key is not None:
+                    hit = self.results.get(req.cache_key)
+                    if hit is not None:
+                        # A duplicate that queued BEHIND its original
+                        # (retry raced the still-in-flight first copy)
+                        # replays at pop time: the original finished and
+                        # stored while this one waited.
+                        self._write(req.conn_id, new_result(*hit))
+                        self.stats["results_sent"] += 1
+                        self.stats["cache_hits"] += 1
+                        logger.info(
+                            "queued request %r [%d, %d] answered from "
+                            "the result cache at dispatch", req.data,
+                            req.lower, req.upper)
+                        continue
+                self._load_balance(req)
+                self._starved = False
         finally:
             self._dispatching = False
+        if self.current is None and self.queue and not self._eligible():
+            # A dispatch pass found work but no taker: latch so the
+            # condition logs once per starvation episode (every later
+            # event re-enters here until a miner joins/frees/answers),
+            # while the sweep's queue-age alarm keeps counting time.
+            if not self._starved:
+                self._starved = True
+                self.stats["no_eligible_miner"] += 1
+                quarantined = sum(1 for m in self.miners if m.quarantined)
+                logger.warning(
+                    "no eligible miner for %d queued request(s): pool=%d "
+                    "quarantined=%d busy=%d — queue is stalled until a "
+                    "miner joins, frees, or answers",
+                    len(self.queue), len(self.miners), quarantined,
+                    sum(1 for m in self.miners
+                        if not m.available and not m.quarantined))
+        elif not self.queue:
+            self._starved = False
 
     def _load_balance(self, request: Request) -> None:
         """Split the range over every eligible miner.
@@ -505,6 +610,30 @@ class Scheduler:
         if rate is None or rate <= 0:
             return self.lease.grace_s
         return max(self.lease.floor_s, chunk.size / rate * self.lease.factor)
+
+    def _check_queue_age(self) -> None:
+        """Queue-age alarm (ROADMAP open item): a request still queued
+        past ``lease.queue_alarm_s`` emits a structured warning — once
+        per bound interval per request — so an operator sees a stalled
+        queue (empty pool, everything quarantined, or a wedged in-flight
+        request ahead of it) instead of silence. Observability only:
+        never changes scheduling."""
+        bound = self.lease.queue_alarm_s
+        if bound <= 0:
+            return
+        now = time.monotonic()
+        for req in self.queue:
+            age = now - req.queued_at
+            if age < bound or now - req.last_alarm < bound:
+                continue
+            req.last_alarm = now
+            self.stats["queue_alarms"] += 1
+            logger.warning(
+                "request %r [%d, %d] from client %d queued for %.1fs "
+                "(bound %.1fs): pool=%d eligible=%d in_flight=%s",
+                req.data, req.lower, req.upper, req.conn_id, age, bound,
+                len(self.miners), len(self._eligible()),
+                self.current is not None)
 
     def _check_leases(self) -> None:
         """One lease sweep: blow expired leases (quarantining repeat

@@ -12,15 +12,16 @@ import sys
 
 from ..lsp.params import Params
 from ..lsp.server import new_async_server
-from ..utils.config import LeaseParams
+from ..utils.config import CacheParams, LeaseParams
 from .scheduler import Scheduler
 
 
 async def serve(port: int, params: Params | None = None,
-                lease: LeaseParams | None = None) -> None:
+                lease: LeaseParams | None = None,
+                cache: CacheParams | None = None) -> None:
     server = await new_async_server(port, params or Params())
     print("Server listening on port", server.port, flush=True)
-    scheduler = Scheduler(server, lease=lease)
+    scheduler = Scheduler(server, lease=lease, cache=cache)
     try:
         await scheduler.run()
     finally:
@@ -41,7 +42,7 @@ def main(argv: list[str] | None = None) -> int:
     configure_logging(logging.INFO, logfile="log.txt")
     cfg = from_env()
     try:
-        asyncio.run(serve(port, cfg.params, cfg.lease))
+        asyncio.run(serve(port, cfg.params, cfg.lease, cfg.cache))
     except KeyboardInterrupt:
         pass
     return 0

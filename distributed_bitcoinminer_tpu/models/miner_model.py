@@ -26,7 +26,7 @@ import numpy as np
 from ..bitcoin.hash import MAX_U64
 from ..ops.search import search_span, search_span_until
 from ..ops.sha256_host import sha256_midstate
-from ..ops.sha256_jnp import build_tail_template
+from ..ops.sha256_jnp import build_hoist, build_tail_template
 
 _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
 
@@ -71,6 +71,15 @@ class _BlockPlan:
     template: np.ndarray
     rem: int
     k: int
+    #: Lane-invariant precompute (ops.sha256_jnp.HoistPlan): deep midstate
+    #: after the constant head rounds, precombined K+W, constant schedule
+    #: terms. None when DBM_HOIST=0 pins the original entry path.
+    hoist: object = None
+
+    @property
+    def hoist_ops(self):
+        """jit-operand dict of the hoist (None when disabled)."""
+        return self.hoist.ops if self.hoist is not None else None
 
 
 class NonceSearcher:
@@ -83,7 +92,7 @@ class NonceSearcher:
     """
 
     def __init__(self, data: str, batch: int = 1 << 20,
-                 tier: str | None = None):
+                 tier: str | None = None, hoist: bool | None = None):
         self.data = data
         self.batch = batch
         self.tier = tier if tier is not None else default_tier()
@@ -94,6 +103,19 @@ class NonceSearcher:
         #: Sticky fallback: pallas until-tier failed to lower/run once ->
         #: this searcher serves difficulty mode from the jnp tier.
         self._until_degraded = False
+        #: Lane-invariant hoist (deep midstate + constant schedule terms);
+        #: DBM_HOIST=0 is the safety valve back to the original entry path.
+        self.use_hoist = (hoist if hoist is not None
+                          else os.environ.get("DBM_HOIST", "1") != "0")
+        #: Difficulty-mode sub-dispatch lookahead: with DBM_UNTIL_PIPELINE=1
+        #: (default) sub k+1 is dispatched BEFORE sub k's result is forced,
+        #: hiding dispatch+fetch latency behind compute; 0 restores the
+        #: strictly serial force order. Either way results are FORCED in
+        #: ascending order, so first-hit-wins semantics are untouched — a
+        #: speculatively dispatched later sub is simply discarded when an
+        #: earlier sub hits (its scan is idempotent).
+        self._until_lookahead = (
+            1 if os.environ.get("DBM_UNTIL_PIPELINE", "1") != "0" else 0)
 
     def _plan_block(self, d: int, k: int, block_base: int, lo: int, hi: int) -> _BlockPlan:
         top = str(block_base)[: d - k] if d > k else ""
@@ -103,14 +125,20 @@ class NonceSearcher:
             prefix = self._prefix + top.encode("ascii")
             midstate, tail = sha256_midstate(prefix)
             template = build_tail_template(tail, k, len(prefix) + k)
-            cached = (midstate, template, len(tail))
+            # The hoist is part of the cache entry: its scalar-numpy round
+            # extension + schedule precombination run once per midstate,
+            # not once per dispatched block.
+            hoist = (build_hoist(midstate, template, len(tail), k)
+                     if self.use_hoist else None)
+            cached = (midstate, template, len(tail), hoist)
             self._midstate_cache[key] = cached
-        midstate, template, rem = cached
+        midstate, template, rem, hoist = cached
         return _BlockPlan(
             base=block_base,
             lo_i=max(lo, block_base) - block_base,
             hi_i=min(hi, block_base + 10 ** k - 1) - block_base,
-            midstate=midstate, template=template, rem=rem, k=k)
+            midstate=midstate, template=template, rem=rem, k=k,
+            hoist=hoist)
 
     def plan(self, lower: int, upper: int):
         """All aligned blocks covering [lower, upper], ascending."""
@@ -175,11 +203,12 @@ class NonceSearcher:
                 np.asarray(plan.midstate, dtype=np.uint32), plan.template,
                 np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
                 rem=plan.rem, k=plan.k, total=self.batch * nbatches,
-                platform=self._platform())
+                platform=self._platform(), hoist=plan.hoist_ops)
                 for i0, nbatches in self._sub_dispatches(plan)]
         return [search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+            plan.hoist_ops,
             rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
             for i0, nbatches in self._sub_dispatches(plan)]
 
@@ -227,10 +256,27 @@ class NonceSearcher:
         """Exact (min_hash, argmin_nonce) over the inclusive range."""
         return self.finalize(self.dispatch(lower, upper), lower)
 
+    def _degrade_until(self) -> None:
+        """Sticky pallas->jnp until-tier degradation: a Mosaic lowering or
+        runtime regression in the until kernel (its SMEM-flag skip is a
+        newer construct than the battle-tested argmin kernel) must not
+        take difficulty mode down with it — the jnp tier answers the
+        identical contract. Sticky per searcher so one sub's failure
+        doesn't retry the broken lowering for every sub of every later
+        block."""
+        import logging
+        logging.getLogger("dbm.model").exception(
+            "pallas until tier failed; degrading this searcher "
+            "to the jnp until tier")
+        self._until_degraded = True
+
     def _until_sub(self, plan: _BlockPlan, i0: int, nbatches: int,
                    t_hi: int, t_lo: int):
-        """One difficulty-target sub-dispatch; overridden by the
-        mesh-sharded model. Returns the 5-tuple
+        """Dispatch one difficulty-target sub WITHOUT forcing the result;
+        overridden by the mesh-sharded model. Returns an opaque handle for
+        :meth:`_until_force` — splitting dispatch from force is what lets
+        ``_until_block`` pipeline sub k+1's dispatch behind sub k's fetch.
+        The handle resolves to the 5-tuple
         ``(found, f_idx, best_hi, best_lo, best_idx)`` of
         :func:`ops.search.search_span_until` (the qualifying HASH is
         recomputed by ``_until_block`` with the host oracle — one shared
@@ -239,60 +285,81 @@ class NonceSearcher:
         grid step via the SMEM found-flag skip (r4), so even the largest
         pow2 sub costs only ~one step of compute past the first hit."""
         if self.tier == "pallas" and not self._until_degraded:
-            import jax
-
             from ..ops.sha256_pallas import pallas_until
 
             try:
-                # Forced HERE, not in _until_block: dispatch is async, so
-                # a runtime kernel fault would otherwise surface at the
-                # caller's device_get, outside this fallback (the block
-                # forces per sub anyway — no overlap is lost).
-                return jax.device_get(pallas_until(
+                # Lowering/compile failures surface at the call; runtime
+                # kernel faults surface at the force — _until_force
+                # catches those (same degradation either way).
+                return ("pallas", pallas_until(
                     np.asarray(plan.midstate, dtype=np.uint32),
                     plan.template,
                     np.uint32(i0), np.uint32(plan.lo_i),
                     np.uint32(plan.hi_i),
                     np.uint32(t_hi), np.uint32(t_lo),
                     rem=plan.rem, k=plan.k, total=self.batch * nbatches,
-                    platform=self._platform()))
+                    platform=self._platform(), hoist=plan.hoist_ops))
             except Exception:
-                # Tier degradation, not a miner death: a Mosaic lowering
-                # regression in the until kernel (its SMEM-flag skip is a
-                # newer construct than the battle-tested argmin kernel)
-                # must not take difficulty mode down with it — the jnp
-                # tier answers the identical contract. Sticky per
-                # searcher so one block's failure doesn't retry the
-                # broken lowering for every sub of every later block.
-                import logging
-                logging.getLogger("dbm.model").exception(
-                    "pallas until tier failed; degrading this searcher "
-                    "to the jnp until tier")
-                self._until_degraded = True
-        return search_span_until(
+                self._degrade_until()
+        return ("jnp", search_span_until(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-            np.uint32(t_hi), np.uint32(t_lo),
-            rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
+            np.uint32(t_hi), np.uint32(t_lo), plan.hoist_ops,
+            rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches))
+
+    def _until_force(self, plan: _BlockPlan, i0: int, nbatches: int,
+                     t_hi: int, t_lo: int, handle):
+        """Force one sub's handle to host ints. A pallas RUNTIME fault
+        lands here (dispatch is async): degrade and recompute this sub on
+        the jnp tier — re-scanning the identical range is idempotent."""
+        import jax
+
+        kind, result = handle
+        try:
+            # One batched fetch per sub (see finalize: per-scalar int()
+            # costs a tunnel round-trip each).
+            return jax.device_get(result)
+        except Exception:
+            # Key on the HANDLE's tier, not the sticky flag: with
+            # pipelining, sub k+1 was dispatched as pallas before sub k's
+            # fault latched degradation, and its force must also fall
+            # back instead of re-raising. The recompute dispatches jnp
+            # (flag is set), so there is no recursion.
+            if kind != "pallas":
+                raise
+            if not self._until_degraded:
+                self._degrade_until()
+            return jax.device_get(
+                self._until_sub(plan, i0, nbatches, t_hi, t_lo)[1])
 
     def _until_block(self, plan: _BlockPlan, t_hi: int, t_lo: int):
-        """Difficulty-target scan of one block: the pow2 sub-dispatches run
-        IN ORDER, forced one at a time, so the device early-exit composes
-        with a host early-exit between subs and the first qualifying nonce
-        globally is the first sub's first hit. Returns host ints
+        """Difficulty-target scan of one block: the pow2 sub-dispatches are
+        FORCED in ascending order, so the device early-exit composes with
+        a host early-exit between subs and the first qualifying nonce
+        globally is the first sub's first hit. With pipelining (default,
+        ``DBM_UNTIL_PIPELINE``) sub k+1 is dispatched before sub k's
+        result is fetched, so the device computes while the host merges —
+        pure speculation: if sub k hits, sub k+1's in-flight scan is
+        discarded unread (it covers strictly higher nonces, so it can
+        never change the answer). Returns host ints
         ``(found, f_hash, f_idx, best_hi, best_lo, best_idx)`` — f_hash is
         recomputed from the host oracle (the device tiers report only the
         qualifying INDEX; one host sha256 is exact and free at this
         frequency)."""
-        import jax
-
         sent = (*_SENTINEL, 0xFFFFFFFF)
         best, seen = sent, False
-        for i0, nbatches in self._sub_dispatches(plan):
-            # One batched fetch per sub (see finalize: per-scalar int()
-            # costs a tunnel round-trip each).
-            found, f_idx, b_hi, b_lo, b_idx = jax.device_get(
-                self._until_sub(plan, i0, nbatches, t_hi, t_lo))
+        subs = self._sub_dispatches(plan)
+        inflight: list = []
+        qi = 0
+        while qi < len(subs) or inflight:
+            while qi < len(subs) and len(inflight) <= self._until_lookahead:
+                i0, nbatches = subs[qi]
+                qi += 1
+                inflight.append((i0, nbatches, self._until_sub(
+                    plan, i0, nbatches, t_hi, t_lo)))
+            i0, nbatches, handle = inflight.pop(0)
+            found, f_idx, b_hi, b_lo, b_idx = self._until_force(
+                plan, i0, nbatches, t_hi, t_lo, handle)
             trip = (int(b_hi), int(b_lo), int(b_idx))
             # Strict lex-less on (hi, lo): subs ascend, so ties keep the
             # earlier (lower-nonce) sub, matching finalize's rule. The

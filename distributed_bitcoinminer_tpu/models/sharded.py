@@ -25,8 +25,8 @@ class ShardedNonceSearcher(NonceSearcher):
     """
 
     def __init__(self, data: str, batch: int = 1 << 20, mesh=None,
-                 tier: str | None = None):
-        super().__init__(data, batch, tier=tier)
+                 tier: str | None = None, hoist: bool | None = None):
+        super().__init__(data, batch, tier=tier, hoist=hoist)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = self.mesh.devices.size
 
@@ -39,7 +39,7 @@ class ShardedNonceSearcher(NonceSearcher):
             i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
             out.append(sharded_search_span(
                 np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                i0_d, plan.lo_i, plan.hi_i,
+                i0_d, plan.lo_i, plan.hi_i, plan.hoist_ops,
                 mesh=self.mesh, rem=plan.rem, k=plan.k,
                 batch=self.batch, nbatches=nbatches, tier=self.tier))
         return out
@@ -56,21 +56,18 @@ class ShardedNonceSearcher(NonceSearcher):
         """Sharded difficulty-target sub-dispatch (VERDICT r2 task 6): each
         device early-exits on its own contiguous span; the collective merge
         preserves the global first-qualifying-nonce rule (see
-        ``parallel.mesh_search.sharded_search_span_until``). Same sticky
-        pallas->jnp until-tier degradation as the single-device model
-        (miner_model._until_sub): a lowering failure in the newer
-        SMEM-flag kernel must not take difficulty mode down."""
-        import jax
-
+        ``parallel.mesh_search.sharded_search_span_until``). Unforced —
+        returns a ``(tier, result)`` handle for ``_until_force`` (the
+        pipelined dispatch contract of miner_model._until_block). Same
+        sticky pallas->jnp until-tier degradation as the single-device
+        model: a lowering failure in the newer SMEM-flag kernel must not
+        take difficulty mode down."""
         i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
         tier = "jnp" if self._until_degraded else self.tier
         try:
-            # Forced here so a runtime kernel fault lands inside this
-            # fallback, not at the caller's device_get (see
-            # miner_model._until_sub).
-            return jax.device_get(sharded_search_span_until(
+            return (tier, sharded_search_span_until(
                 np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo,
+                i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo, plan.hoist_ops,
                 mesh=self.mesh, rem=plan.rem, k=plan.k,
                 batch=self.batch, nbatches=nbatches, tier=tier))
         except Exception:

@@ -8,12 +8,16 @@ Three tiers, all bit-identical to the host oracle
 - ``sha256_pallas``: Pallas TPU kernel with blockwise grid + fused argmin.
 """
 
-from .sha256_host import sha256_midstate, compress_host, SHA256_H0, SHA256_K
+from .sha256_host import (sha256_midstate, compress_host, compress_rounds,
+                          schedule_words, SHA256_H0, SHA256_K)
 from .sha256_jnp import (
-    build_tail_template, chunk_search_fn, lex_argmin, digit_positions,
+    HoistPlan, build_hoist, build_tail_template, chunk_search_fn,
+    hoist_structure, lex_argmin, digit_positions,
 )
 
 __all__ = [
-    "sha256_midstate", "compress_host", "SHA256_H0", "SHA256_K",
-    "build_tail_template", "chunk_search_fn", "lex_argmin", "digit_positions",
+    "sha256_midstate", "compress_host", "compress_rounds", "schedule_words",
+    "SHA256_H0", "SHA256_K",
+    "HoistPlan", "build_hoist", "build_tail_template", "chunk_search_fn",
+    "hoist_structure", "lex_argmin", "digit_positions",
 ]

@@ -16,20 +16,30 @@ import jax.numpy as jnp
 import numpy as np
 
 from .sha256_host import SHA256_K
-from .sha256_jnp import (_compress, digit_contrib, ensure_varying,
-                         lex_argmin)
+from .sha256_jnp import (_compress, compress_tail_hoisted, digit_contrib,
+                         ensure_varying, lex_argmin)
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 
 
 def _hash_lanes(midstate, template, i, rem: int, k: int, vary_axes=(),
-                base=None, span: int = 0):
+                base=None, span: int = 0, hoist=None):
     """Hash a lane vector of low-digit offsets; returns (hi, lo) uint32.
 
     ``base``/``span``: the scalar start and static length of the window
     ``i`` covers, enabling the high-digit hoist (see
-    :func:`sha256_jnp.digit_contrib`)."""
+    :func:`sha256_jnp.digit_contrib`). ``hoist`` is the optional
+    lane-invariant precompute operand dict (``HoistPlan.ops``): with it,
+    the compression enters at the host-extended deep midstate and skips
+    the constant schedule terms (:func:`sha256_jnp.compress_tail_hoisted`);
+    without it the original rolled path runs — both are bit-identical.
+    """
     contrib = digit_contrib(i, rem, k, base=base, span=span)
+    if hoist is not None:
+        state = compress_tail_hoisted(midstate, template, contrib, hoist,
+                                      rem=rem, k=k, shape=i.shape,
+                                      vary_axes=vary_axes)
+        return state[0], state[1]
 
     state = tuple(jnp.broadcast_to(midstate[r], i.shape) for r in range(8))
     for blk in range(template.shape[0]):
@@ -44,7 +54,7 @@ def _hash_lanes(midstate, template, i, rem: int, k: int, vary_axes=(),
 
 
 def span_scan_body(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
-                   batch: int, nbatches: int, vary_axes=()):
+                   batch: int, nbatches: int, vary_axes=(), hoist=None):
     """Unjitted span scan: lanes ``i0 + [0, nbatches*batch)`` masked to
     [lo_i, hi_i]. Shared by the jitted single-device entry point and the
     shard_map per-device body in ``parallel/`` (which passes its mesh axis
@@ -59,7 +69,8 @@ def span_scan_body(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
         base = i0 + j.astype(jnp.uint32) * np.uint32(batch)
         i = base + lane
         hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
-                                 vary_axes=vary_axes, base=base, span=batch)
+                                 vary_axes=vary_axes, base=base, span=batch,
+                                 hoist=hoist)
         valid = (i >= lo_i) & (i <= hi_i)
         hi_h = jnp.where(valid, hi_h, _MAX_U32)
         lo_h = jnp.where(valid, lo_h, _MAX_U32)
@@ -83,18 +94,19 @@ def span_scan_body(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("rem", "k", "batch", "nbatches"))
-def search_span(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
-                batch: int, nbatches: int):
+def search_span(midstate, template, i0, lo_i, hi_i, hoist=None, *,
+                rem: int, k: int, batch: int, nbatches: int):
     """Jitted single-device span scan (see :func:`span_scan_body`)."""
     midstate = jnp.asarray(midstate, dtype=jnp.uint32)
     template = jnp.asarray(template, dtype=jnp.uint32)
     return span_scan_body(midstate, template, i0, lo_i, hi_i,
-                          rem=rem, k=k, batch=batch, nbatches=nbatches)
+                          rem=rem, k=k, batch=batch, nbatches=nbatches,
+                          hoist=hoist)
 
 
 def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
                     target_lo, *, rem: int, k: int, batch: int,
-                    nbatches: int, vary_axes=()):
+                    nbatches: int, vary_axes=(), hoist=None):
     """Unjitted difficulty-target span scan: stop at the first batch holding
     a hash below the 64-bit target (as a (hi, lo) uint32 pair).
 
@@ -126,7 +138,8 @@ def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
         base = i0 + j.astype(jnp.uint32) * np.uint32(batch)
         i = base + lane
         hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
-                                 vary_axes=vary_axes, base=base, span=batch)
+                                 vary_axes=vary_axes, base=base, span=batch,
+                                 hoist=hoist)
         valid = (i >= lo_i) & (i <= hi_i)
         hi_h = jnp.where(valid, hi_h, _MAX_U32)
         lo_h = jnp.where(valid, lo_h, _MAX_U32)
@@ -156,12 +169,13 @@ def span_until_body(midstate, template, i0, lo_i, hi_i, target_hi,
 @functools.partial(jax.jit,
                    static_argnames=("rem", "k", "batch", "nbatches"))
 def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
-                      target_lo, *, rem: int, k: int, batch: int,
-                      nbatches: int):
+                      target_lo, hoist=None, *, rem: int, k: int,
+                      batch: int, nbatches: int):
     """Jitted single-device difficulty-target scan
     (see :func:`span_until_body`)."""
     midstate = jnp.asarray(midstate, dtype=jnp.uint32)
     template = jnp.asarray(template, dtype=jnp.uint32)
     return span_until_body(midstate, template, i0, lo_i, hi_i,
                            target_hi, target_lo,
-                           rem=rem, k=k, batch=batch, nbatches=nbatches)
+                           rem=rem, k=k, batch=batch, nbatches=nbatches,
+                           hoist=hoist)

@@ -70,6 +70,57 @@ def sha256_midstate(prefix: bytes) -> tuple[tuple, bytes]:
     return state, prefix[full:]
 
 
+def sigma0(x: int) -> int:
+    """Message-schedule small sigma-0 (FIPS 180-4 4.6)."""
+    return _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> 3)
+
+
+def sigma1(x: int) -> int:
+    """Message-schedule small sigma-1 (FIPS 180-4 4.7)."""
+    return _rotr(x, 17) ^ _rotr(x, 19) ^ (x >> 10)
+
+
+def schedule_words(block_words) -> list:
+    """Full 64-entry message schedule of one 16-word block (host ints).
+
+    The lane-invariant half of the hoist: a tail block that carries no
+    nonce-digit bytes (e.g. the pure padding+length block of a 2-block
+    tail) has a fully constant schedule, so ``K[t] + W[t]`` can be
+    precombined ONCE here and the device compression runs with no
+    schedule arithmetic at all.
+    """
+    w = [int(x) & _M32 for x in block_words]
+    assert len(w) == 16
+    for t in range(16, 64):
+        w.append((w[t - 16] + sigma0(w[t - 15]) + w[t - 7]
+                  + sigma1(w[t - 2])) & _M32)
+    return w
+
+
+def compress_rounds(state: tuple, w, start: int, stop: int) -> tuple:
+    """Run SHA-256 rounds [start, stop) from raw round-state ``state``.
+
+    ``w`` is the (absolute-indexed) message schedule, at least ``stop``
+    entries. Returns the raw (a..h) round state WITHOUT the final
+    feed-forward — the device kernel continues from exactly this state.
+    This is both the builder for the hoisted deep midstate (the first
+    ``rem // 4`` rounds of block 0 consume only constant words, so they
+    run once per plan here instead of once per lane on device) and the
+    bit-exactness oracle the hoist tests check device entry paths
+    against.
+    """
+    a, b, c, d, e, f, g, h = (int(x) & _M32 for x in state)
+    for t in range(start, stop):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g & _M32)
+        t1 = (h + s1 + ch + SHA256_K[t] + (int(w[t]) & _M32)) & _M32
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj) & _M32
+        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & _M32, c, b, a, (t1 + t2) & _M32
+    return a, b, c, d, e, f, g, h
+
+
 def sha256_finish_host(state: tuple, tail: bytes, total_len: int) -> bytes:
     """Finish a hash from a midstate (host oracle for the device path)."""
     padded = tail + b"\x80"

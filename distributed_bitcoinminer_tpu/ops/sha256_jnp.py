@@ -23,6 +23,7 @@ signature, reused across the whole search.
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +32,7 @@ import numpy as np
 from .sha256_host import SHA256_H0, SHA256_K
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
+_M32 = 0xFFFFFFFF
 
 
 def digit_positions(rem: int, k: int) -> list[tuple[int, int, int]]:
@@ -124,6 +126,251 @@ def _rotr(x, n: int):
 
 
 _K64 = np.asarray(SHA256_K, dtype=np.uint32)
+
+
+# ------------------------------------------------------------- hoist plane
+#
+# The tail blocks are ALMOST entirely lane-invariant: only the k ASCII
+# digit bytes at positions rem..rem+k-1 vary per lane. The AsicBoost
+# observation (arxiv 1604.00575) — factor work-item-invariant SHA-256 out
+# of the inner loop — applies directly:
+#
+# (a) rounds 0..rem//4-1 of block 0 consume only constant words (the
+#     first digit byte sits in word rem//4), so the round state after
+#     them is computed ONCE on the host and the device starts deeper;
+# (b) schedule taps over words that never receive digit bits are
+#     constant; their s0/s1 terms and additive taps are precombined on
+#     the host, so the device schedule of rounds 16..31 computes only
+#     the varying taps (rounds 32..63 stay rolled: for small rem every
+#     tap is varying by then; the residual constant taps of large rem
+#     are computed per-lane there — unhoisted, never wrong);
+# (c) a tail block with NO digit bytes at all (the padding+length block
+#     of a 2-block tail when the digits fit block 0) has a fully
+#     constant schedule: K[t]+W[t] for all 64 rounds precombined, the
+#     device runs zero schedule arithmetic for that block.
+#
+# The structure (which words/taps vary) depends only on (rem, k,
+# nblocks) — all static under jit — so hoist_structure() is re-derived
+# at trace time; only the precombined VALUES ride as jit operands.
+
+#: Schedule tap kinds: plain additive tap or a small-sigma term.
+_TAPS = ((("w", -16), ("s0", -15), ("w", -7), ("s1", -2)))
+
+
+def hoist_structure(rem: int, k: int, nblocks: int):
+    """Static constancy analysis of the tail blocks.
+
+    Returns one ``(varying_words, var_taps, full_const)`` triple per
+    block: the initial window words carrying digit bytes, and — for
+    rounds 16..31 — the subset of each round's schedule taps that is
+    lane-varying (the constant rest is folded into the host-built
+    ``cw`` operand). ``full_const`` marks a digit-free block whose
+    entire schedule hoists (see ``build_hoist``).
+    """
+    pos = digit_positions(rem, k)
+    blocks = []
+    for b in range(nblocks):
+        varying = tuple(sorted({w for (bb, w, _) in pos if bb == b}))
+        if not varying:
+            blocks.append((varying, (), True))
+            continue
+        var = [w in varying for w in range(16)]
+        taps = []
+        for t in range(16, 32):
+            tv = tuple((kind, t + off) for kind, off in _TAPS
+                       if var[t + off])
+            var.append(bool(tv))
+            taps.append(tv)
+        blocks.append((varying, tuple(taps), False))
+    return tuple(blocks)
+
+
+@dataclass(frozen=True)
+class HoistPlan:
+    """Host-precomputed lane-invariant SHA-256 work for one tail template.
+
+    Built once per midstate-cache entry (models.miner_model._plan_block)
+    and threaded through every compute tier as jit operands; the
+    matching static structure is re-derived from (rem, k, nblocks) by
+    :func:`hoist_structure` at trace time.
+    """
+    wd0: int                    #: rounds of block 0 hoisted into ``deep``
+    nblocks: int
+    full_const: tuple           #: per block: schedule fully constant
+    hoisted_rounds: int         #: == wd0 (bench counter)
+    schedule_terms_hoisted: int  #: constant schedule terms folded on host
+    ops: dict                   #: jit operands: deep/kw/cw (+ckw)
+
+
+def build_hoist(midstate, template: np.ndarray, rem: int, k: int) -> HoistPlan:
+    """Precompute the hoist operands for one (midstate, template) pair.
+
+    ``ops`` holds: ``deep`` (8,) — the round state after the first
+    ``rem // 4`` rounds of block 0; ``kw`` (nblocks, 16) — K[j]+W[j]
+    for rounds 0..15 (digit words add their per-lane contribution ON TOP,
+    exact because the digit byte positions are zero in the template);
+    ``cw`` (nblocks, 16) — the constant part of each expanded word
+    w[16..31]; ``ckw`` (64,) — full K+W precombination of the one
+    fully-constant block, when present.
+    """
+    from .sha256_host import compress_rounds, schedule_words, sigma0, sigma1
+
+    nblocks = int(template.shape[0])
+    struct = hoist_structure(rem, k, nblocks)
+    wd0 = struct[0][0][0]   # first digit word of block 0 == rem // 4
+    deep = compress_rounds(midstate, [int(x) for x in template[0]], 0, wd0)
+    kw = np.zeros((nblocks, 16), dtype=np.uint32)
+    cw = np.zeros((nblocks, 16), dtype=np.uint32)
+    ckw = None
+    terms = 0
+    for b, (varying, taps, full) in enumerate(struct):
+        words = [int(x) for x in template[b]]
+        if full:
+            sched = schedule_words(words)
+            ckw = np.asarray([(SHA256_K[t] + sched[t]) & _M32
+                              for t in range(64)], dtype=np.uint32)
+            terms += 4 * 48   # every tap of every expanded word
+            continue
+        kw[b] = [(SHA256_K[j] + words[j]) & _M32 for j in range(16)]
+        vals: list = words + [None] * 16
+        for i, tv in enumerate(taps):
+            t = 16 + i
+            acc = 0
+            for kind, off in _TAPS:
+                if (kind, t + off) in tv:
+                    continue
+                v = vals[t + off]
+                acc += (v if kind == "w"
+                        else sigma0(v) if kind == "s0" else sigma1(v))
+                terms += 1
+            cw[b, i] = acc & _M32
+            if not tv:
+                vals[t] = int(cw[b, i])
+    ops = {"deep": np.asarray(deep, dtype=np.uint32), "kw": kw, "cw": cw}
+    if ckw is not None:
+        ops["ckw"] = ckw
+    return HoistPlan(wd0=wd0, nblocks=nblocks,
+                     full_const=tuple(s[2] for s in struct),
+                     hoisted_rounds=wd0, schedule_terms_hoisted=terms,
+                     ops=ops)
+
+
+def _sig0(x):
+    return _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> np.uint32(3))
+
+
+def _sig1(x):
+    return _rotr(x, 17) ^ _rotr(x, 19) ^ (x >> np.uint32(10))
+
+
+def _compress_const_block(ff, ckw, vary_axes=()):
+    """Compression of a fully-constant block: NO schedule arithmetic.
+
+    ``ckw`` is the (64,) host-precombined K[t]+W[t] vector; the rolled
+    fori carries only the 8 state tiles (vs 24 with the schedule
+    window), which also cuts the loop's memory traffic by 2/3.
+    """
+    st = tuple(ff)
+    for j in range(16):
+        st = _round(*st, ckw[j])
+    if vary_axes:
+        st = tuple(ensure_varying(x, vary_axes) for x in st)
+        ckw = ensure_varying(ckw, vary_axes)
+
+    def body(bi, st8):
+        kvec = jax.lax.dynamic_slice(ckw, (bi * 16,), (16,))
+        for j in range(16):
+            st8 = _round(*st8, kvec[j])
+        return st8
+
+    st = jax.lax.fori_loop(1, 4, body, st)
+    return tuple(f + s for f, s in zip(ff, st))
+
+
+def _compress_block_hoisted(ff, entry, wd, varying, taps, contribs, tw,
+                            kwv, cwv, shape, vary_axes=()):
+    """Hoist-aware compression of one digit-carrying block.
+
+    ``ff`` is the feed-forward base (the block's true input state);
+    ``entry`` the round state the device enters at round ``wd`` (block
+    0: the host-extended deep midstate; later blocks: ``ff`` itself with
+    ``wd == 0``). Rounds wd..15 run schedule-free off the precombined
+    ``kwv``; rounds 16..31 are static with only the varying taps
+    computed per lane (constant terms ride ``cwv``); rounds 32..63 stay
+    rolled — by then the window is carried as full tiles either way.
+    """
+    st = tuple(entry)
+    for j in range(wd, 16):
+        kwj = kwv[j]
+        if j in varying:
+            kwj = kwj + contribs[j]
+        st = _round(*st, kwj)
+    # Lane-varying initial window values (constant ones live in cwv).
+    wv = {j: tw[j] + contribs[j] for j in varying}
+    for i, tv in enumerate(taps):
+        t = 16 + i
+        acc = cwv[i]
+        for kind, tap in tv:
+            x = wv[tap]
+            acc = acc + (x if kind == "w"
+                         else _sig0(x) if kind == "s0" else _sig1(x))
+        wv[t] = acc
+        st = _round(*st, acc + np.uint32(SHA256_K[t]))
+    w = [jnp.broadcast_to(jnp.asarray(wv[16 + j], jnp.uint32), shape)
+         for j in range(16)]
+    st = [jnp.broadcast_to(jnp.asarray(x, jnp.uint32), shape) for x in st]
+    if vary_axes:
+        st = [ensure_varying(x, vary_axes) for x in st]
+        w = [ensure_varying(x, vary_axes) for x in w]
+    k64 = jnp.asarray(_K64)
+
+    def block(i, carry):
+        st, w = carry
+        kvec = jax.lax.dynamic_slice(k64, (i * 16,), (16,))
+        st, w = _schedule_block(st, list(w), kvec)
+        return st, tuple(w)
+
+    st, _ = jax.lax.fori_loop(2, 4, block, (tuple(st), tuple(w)))
+    return tuple(f + s for f, s in zip(ff, st))
+
+
+def compress_tail_hoisted(midstate, template, contrib, hoist_ops, *,
+                          rem: int, k: int, shape, vary_axes=()):
+    """Full hoisted tail compression; returns the 8 output words.
+
+    ``contrib`` is the per-(block, word) digit-contribution dict of
+    :func:`digit_contrib`; ``hoist_ops`` the operand dict of
+    :func:`build_hoist` (values traced, structure re-derived here).
+    Bit-identical to the plain path — the oracle-equivalence sweep in
+    tests/test_hoist.py pins that across rem/k/block boundaries.
+    """
+    nblocks = template.shape[0]
+    struct = hoist_structure(rem, k, nblocks)
+    # Coerce to jnp up front: a no-op under jit, and in eager use it keeps
+    # the scalar-plane adds on jnp's wrapping uint32 instead of numpy
+    # scalars (whose wraparound spams RuntimeWarnings).
+    midstate = jnp.asarray(midstate, jnp.uint32)
+    template = jnp.asarray(template, jnp.uint32)
+    hoist_ops = {k_: jnp.asarray(v, jnp.uint32)
+                 for k_, v in hoist_ops.items()}
+    deep, kw, cw = hoist_ops["deep"], hoist_ops["kw"], hoist_ops["cw"]
+    out = None
+    for b, (varying, taps, full) in enumerate(struct):
+        ff = (tuple(midstate[r] for r in range(8)) if b == 0 else out)
+        if full:
+            out = _compress_const_block(ff, hoist_ops["ckw"],
+                                        vary_axes=vary_axes)
+            continue
+        entry = tuple(deep[r] for r in range(8)) if b == 0 else ff
+        wd = struct[0][0][0] if b == 0 else 0
+        contribs = {w: contrib[(b, w)] for w in varying}
+        out = _compress_block_hoisted(
+            ff, entry, wd, varying, taps, contribs,
+            tw=[template[b, j] for j in range(16)],
+            kwv=[kw[b, j] for j in range(16)],
+            cwv=[cw[b, i] for i in range(16)],
+            shape=shape, vary_axes=vary_axes)
+    return out
 
 
 def ensure_varying(x, axes):

@@ -49,7 +49,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .sha256_host import SHA256_K
-from .sha256_jnp import digit_contrib, lex_argmin
+from .sha256_jnp import (_sig0, _sig1, digit_contrib, hoist_structure,
+                         lex_argmin)
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 _LANES = 128
@@ -86,27 +87,34 @@ def peel_enabled() -> bool:
 
 
 def pallas_argmin(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
-                  total: int, platform: str, vma: tuple = ()):
+                  total: int, platform: str, vma: tuple = (), hoist=None):
     """THE dispatch wrapper for the argmin kernel: geometry + interpret
     flag derived in one place for every call site (single-device and mesh
-    — the two drifted once in round 2)."""
+    — the two drifted once in round 2). ``hoist`` (HoistPlan.ops) is
+    consumed only by the peeled kernel shape — the rolled fori-over-blocks
+    kernel cannot start block 0 mid-round, so the chip-validated default
+    stays byte-identical when DBM_PEEL is off."""
     rows, nsteps = pallas_geometry(total)
+    peel = peel_enabled()
     return pallas_search_span(
-        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        midstate, template, i0, lo_i, hi_i,
+        hoist if peel else None, rem=rem, k=k, rows=rows,
         nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
-        peel=peel_enabled())
+        peel=peel)
 
 
 def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
                  rem: int, k: int, total: int, platform: str,
-                 vma: tuple = ()):
+                 vma: tuple = (), hoist=None):
     """Dispatch wrapper for the difficulty-target kernel (see
     :func:`pallas_argmin`)."""
     rows, nsteps = pallas_geometry(total)
+    peel = peel_enabled()
     return pallas_search_span_until(
-        midstate, template, i0, lo_i, hi_i, t_hi, t_lo, rem=rem, k=k,
+        midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
+        hoist if peel else None, rem=rem, k=k,
         rows=rows, nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
-        peel=peel_enabled())
+        peel=peel)
 
 
 def pallas_geometry(total: int) -> tuple[int, int]:
@@ -138,6 +146,34 @@ def _round(a, b, c, d, e, f, g, h, kw):
     return t1 + s0 + maj, a, b, c, d + t1, e, f, g
 
 
+def _round_ab(a, b, c, d, e, f, g, h, kw):
+    """The truncated FINAL round: only digest words 0 and 1 are ever read
+    (hi/lo hash lanes), so of the last round's two real updates only
+    ``t1 + s0 + maj`` (the a-chain) survives — the ``d + t1`` e-chain
+    update is dead and dropped. Expressible only in the unrolled tail the
+    peeled kernel ends with; returns ``(a_64, a_63)``."""
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = g ^ (e & (f ^ g))
+    t1 = h + s1 + ch + kw
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & (b ^ c)) ^ (b & c)
+    return t1 + s0 + maj, a
+
+
+def _make_round16(scal_ref, ckoff: int):
+    """Rounds-only 16-round fori body for a fully-constant block: the
+    whole schedule was precombined on the host into K[t]+W[t] scalars at
+    ``ckoff`` (SMEM), so the carry is just the 8 state tiles — no window,
+    no sigma arithmetic, 1/3 the loop carry of the scheduled body."""
+    def round16(bi, carry):
+        a, b, c, d, e, f, g, h = carry
+        for j in range(16):
+            a, b, c, d, e, f, g, h = _round(
+                a, b, c, d, e, f, g, h, scal_ref[ckoff + bi * 16 + j])
+        return (a, b, c, d, e, f, g, h)
+    return round16
+
+
 def _make_block16(scal_ref, koff: int, guard_first: bool):
     """The 16-round schedule-block fori body, built ONCE for both kernel
     shapes: ``guard_first=True`` is the rolled kernel (fori over blocks
@@ -164,9 +200,117 @@ def _make_block16(scal_ref, koff: int, guard_first: bool):
     return block16
 
 
+def _peel_hoisted(scal_ref, contrib, nz, *, rem: int, k: int, nblocks: int,
+                  rows: int, until: bool):
+    """Peeled compression consuming the HOST hoist (the tentpole):
+
+    - block 0 enters at the host-extended deep midstate (SMEM scalars at
+      ``hoff``) — the ``rem // 4`` lane-invariant head rounds that the
+      plain peel recomputed on the scalar plane EVERY grid step now run
+      once per plan on the host;
+    - rounds t*..15 are schedule-free off host-precombined K+W scalars;
+    - rounds 16..31 run as static code computing only the lane-VARYING
+      schedule taps; the constant s0/s1 terms and additive taps ride the
+      ``cw`` SMEM scalars (sha256_jnp.build_hoist);
+    - a digit-free block (2-block tails whose digits fit block 0) runs
+      with ZERO schedule arithmetic off the full K[t]+W[t] vector at
+      ``ckoff``, its fori carrying 8 tiles instead of 24;
+    - the final block's last 16 rounds are static so the one dead update
+      (round 64's e-chain) and the 6 dead feed-forward adds drop — only
+      digest words 0 and 1 are ever read.
+
+    Returns the two live output tiles ``(a_out, b_out)``.
+    """
+    struct = hoist_structure(rem, k, nblocks)
+    koff = _TMPL_OFF + 16 * nblocks
+    hoff = koff + 64 + (2 if until else 0)
+    kwoff = hoff + 8
+    cwoff = kwoff + 16 * nblocks
+    ckoff = cwoff + 16 * nblocks
+    shape = (rows, _LANES)
+    vec = None                        # 8-tuple of tiles between blocks
+    out_a = out_b = None
+    for blk in range(nblocks):
+        varying, taps, full = struct[blk]
+        final = blk == nblocks - 1
+        if full:
+            # Only the padding+length block of a 2-block tail can be
+            # digit-free, so a full-const block is always final and its
+            # entry state is always lane-varying tiles.
+            ff = vec
+            a, b, c, d, e, f, g, h = vec
+            for j in range(16):
+                a, b, c, d, e, f, g, h = _round(
+                    a, b, c, d, e, f, g, h, scal_ref[ckoff + j])
+            a, b, c, d, e, f, g, h = jax.lax.fori_loop(
+                1, 3, _make_round16(scal_ref, ckoff),
+                (a, b, c, d, e, f, g, h))
+            for j in range(15):
+                a, b, c, d, e, f, g, h = _round(
+                    a, b, c, d, e, f, g, h, scal_ref[ckoff + 48 + j])
+            a, b = _round_ab(a, b, c, d, e, f, g, h, scal_ref[ckoff + 63])
+            out_a, out_b = ff[0] + a, ff[1] + b
+            continue
+        if vec is None:               # block 0: deep-midstate entry
+            t_star = varying[0]       # == rem // 4
+            deep = tuple(scal_ref[hoff + r] for r in range(8))
+            ff = tuple(scal_ref[3 + r] for r in range(8))
+            a, b, c, d, e, f, g, h = (
+                jnp.full(shape, s, jnp.uint32) + nz for s in deep)
+        else:
+            t_star = 0                # digit spill: word 0 varies
+            ff = vec
+            a, b, c, d, e, f, g, h = vec
+        # Lane-varying initial window values (const taps ride cw).
+        wv = {j: contrib[(blk, j)] | scal_ref[_TMPL_OFF + blk * 16 + j]
+              for j in varying}
+        for j in range(t_star, 16):
+            kwj = scal_ref[kwoff + blk * 16 + j]
+            if j in wv:
+                kwj = wv[j] + scal_ref[koff + j]
+            a, b, c, d, e, f, g, h = _round(a, b, c, d, e, f, g, h, kwj)
+        for i16, tv in enumerate(taps):
+            t = 16 + i16
+            acc = scal_ref[cwoff + blk * 16 + i16]
+            for kind, tap in tv:
+                x = wv[tap]
+                acc = acc + (x if kind == "w"
+                             else _sig0(x) if kind == "s0" else _sig1(x))
+            wv[t] = acc               # SMEM scalar when tv is empty
+            a, b, c, d, e, f, g, h = _round(
+                a, b, c, d, e, f, g, h, acc + scal_ref[koff + t])
+        w = [wv[16 + j] if taps[j] else
+             jnp.full(shape, wv[16 + j], jnp.uint32) + nz
+             for j in range(16)]
+        if final:
+            carry = jax.lax.fori_loop(   # rounds 32..47, rolled
+                2, 3, _make_block16(scal_ref, koff, guard_first=False),
+                (a, b, c, d, e, f, g, h, *w))
+            a, b, c, d, e, f, g, h = carry[:8]
+            w = list(carry[8:])
+            for j in range(16):          # rounds 48..63, static + truncated
+                s0 = _sig0(w[(j + 1) % 16])
+                s1 = _sig1(w[(j + 14) % 16])
+                w[j] = w[j] + s0 + w[(j + 9) % 16] + s1
+                kwj = w[j] + scal_ref[koff + 48 + j]
+                if j == 15:
+                    a, b = _round_ab(a, b, c, d, e, f, g, h, kwj)
+                else:
+                    a, b, c, d, e, f, g, h = _round(
+                        a, b, c, d, e, f, g, h, kwj)
+            out_a, out_b = ff[0] + a, ff[1] + b
+        else:
+            carry = jax.lax.fori_loop(   # rounds 32..63, rolled
+                2, 4, _make_block16(scal_ref, koff, guard_first=False),
+                (a, b, c, d, e, f, g, h, *w))
+            st8 = carry[:8]
+            vec = tuple(fv + sv for fv, sv in zip(ff, st8))
+    return out_a, out_b
+
+
 def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
             nblocks: int, rows: int, until: bool = False,
-            peel: bool = False):
+            peel: bool = False, hoisted: bool = False):
     step = pl.program_id(0)
     if until:
         # In-kernel early exit (VERDICT r3 task 2): the grid is sequential
@@ -198,16 +342,16 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
             # is identical either way — the grid is sequential).
             _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref,
                          step=step, rem=rem, k=k, nblocks=nblocks,
-                         rows=rows, until=True, peel=peel)
+                         rows=rows, until=True, peel=peel, hoisted=hoisted)
     else:
         _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, None, None,
                      step=step, rem=rem, k=k, nblocks=nblocks, rows=rows,
-                     until=False, peel=peel)
+                     until=False, peel=peel, hoisted=hoisted)
 
 
 def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
                  step, rem: int, k: int, nblocks: int, rows: int,
-                 until: bool, peel: bool = False):
+                 until: bool, peel: bool = False, hoisted: bool = False):
     i0 = scal_ref[0]
     lo = scal_ref[1]
     hi = scal_ref[2]
@@ -248,7 +392,10 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
             w.append(wv + nz)
         return w
 
-    if not peel:
+    if hoisted and peel:
+        out_a, out_b = _peel_hoisted(scal_ref, contrib, nz, rem=rem, k=k,
+                                     nblocks=nblocks, rows=rows, until=until)
+    elif not peel:
         a, b, c, d, e, f, g, h = (jnp.full((rows, _LANES), s, jnp.uint32)
                                   + nz for s in state)
         for blk in range(nblocks):
@@ -270,7 +417,8 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
             a, b, c, d, e, f, g, h = carry[:8]
             a, b, c, d = sa + a, sb + b, sc + c, sd + d
             e, f, g, h = se + e, sf + f, sg + g, sh + h
-    else:
+        out_a, out_b = a, b
+    elif peel:
         # Peeled compression (round 5): rounds 0-15 of each compression
         # run as STATIC straight-line code with no schedule expansion —
         # the rolled loop's block-0 ``where`` guard computes and
@@ -317,11 +465,11 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
             a, b, c, d, e, f, g, h = carry[:8]
             vec = (ff[0] + a, ff[1] + b, ff[2] + c, ff[3] + d,
                    ff[4] + e, ff[5] + f, ff[6] + g, ff[7] + h)
-        a, b, c, d, e, f, g, h = vec
+        out_a, out_b = vec[0], vec[1]
 
     valid = (i >= lo) & (i <= hi)
-    hi_h = jnp.where(valid, a, _MAX_U32)
-    lo_h = jnp.where(valid, b, _MAX_U32)
+    hi_h = jnp.where(valid, out_a, _MAX_U32)
+    lo_h = jnp.where(valid, out_b, _MAX_U32)
     idx = jnp.where(valid, i, _MAX_U32)
     if until:
         # Difficulty-target accumulator: per lane position, the minimum
@@ -371,8 +519,8 @@ def _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref, *,
     jax.jit,
     static_argnames=("rem", "k", "rows", "nsteps", "interpret", "vma",
                      "peel"))
-def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
-                       k: int, rows: int, nsteps: int,
+def pallas_search_span(midstate, template, i0, lo_i, hi_i, hoist=None, *,
+                       rem: int, k: int, rows: int, nsteps: int,
                        interpret: bool = False, vma: tuple = (),
                        peel: bool = False):
     """Scan lanes ``i0 + [0, nsteps*rows*128)`` masked to [lo_i, hi_i].
@@ -393,7 +541,8 @@ def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
     """
     hi_h, lo_h, idx = _run_kernel(
         midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
-        nsteps=nsteps, interpret=interpret, vma=vma, peel=peel)
+        nsteps=nsteps, interpret=interpret, vma=vma, peel=peel,
+        hoist=hoist)
     return lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
 
 
@@ -402,9 +551,9 @@ def pallas_search_span(midstate, template, i0, lo_i, hi_i, *, rem: int,
     static_argnames=("rem", "k", "rows", "nsteps", "interpret", "vma",
                      "peel"))
 def pallas_search_span_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
-                             *, rem: int, k: int, rows: int, nsteps: int,
-                             interpret: bool = False, vma: tuple = (),
-                             peel: bool = False):
+                             hoist=None, *, rem: int, k: int, rows: int,
+                             nsteps: int, interpret: bool = False,
+                             vma: tuple = (), peel: bool = False):
     """Difficulty-target span scan on the Mosaic kernel.
 
     Same lane coverage as :func:`pallas_search_span` plus a 4th in-VMEM
@@ -426,7 +575,7 @@ def pallas_search_span_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
     hi_h, lo_h, idx, f, flag = _run_kernel(
         midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
         nsteps=nsteps, interpret=interpret, vma=vma, target=(t_hi, t_lo),
-        peel=peel)
+        peel=peel, hoist=hoist)
     f_idx = jnp.min(f.ravel())
     found = (flag[0] != 0).astype(jnp.uint32)
     b_hi, b_lo, b_idx = lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
@@ -448,11 +597,19 @@ def _out_struct(shape, vma):
 
 
 def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
-                interpret, vma, target=None, peel=False):
-    """Shared pallas_call builder for the argmin and difficulty variants."""
+                interpret, vma, target=None, peel=False, hoist=None):
+    """Shared pallas_call builder for the argmin and difficulty variants.
+
+    With ``hoist`` (peeled shape only), the host-precomputed sections are
+    APPENDED to the scalar-prefetch vector — deep midstate (8), K+W for
+    rounds 0..15 (16 per block), the rounds-16..31 constant schedule
+    terms (16 per block) and, when a digit-free block exists, its full
+    K[t]+W[t] precombination (64) — so the chip-validated layout of the
+    rolled kernel is byte-identical when the hoist is off."""
     midstate = jnp.asarray(midstate, dtype=jnp.uint32).reshape(8)
     template = jnp.asarray(template, dtype=jnp.uint32)
     nblocks = template.shape[0]
+    hoisted = peel and hoist is not None
     parts = [
         jnp.asarray([i0, lo_i, hi_i], dtype=jnp.uint32),
         midstate, template.reshape(-1),
@@ -460,6 +617,12 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
     if target is not None:
         parts.append(jnp.stack([jnp.asarray(t, dtype=jnp.uint32)
                                 for t in target]))
+    if hoisted:
+        parts += [jnp.asarray(hoist["deep"], dtype=jnp.uint32),
+                  jnp.asarray(hoist["kw"], dtype=jnp.uint32).reshape(-1),
+                  jnp.asarray(hoist["cw"], dtype=jnp.uint32).reshape(-1)]
+        if "ckw" in hoist:
+            parts.append(jnp.asarray(hoist["ckw"], dtype=jnp.uint32))
     scal = jnp.concatenate(parts)
 
     # Accumulator BlockSpec = the whole (rows, 128) array with a constant
@@ -485,7 +648,8 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
     )
     return pl.pallas_call(
         functools.partial(_kernel, rem=rem, k=k, nblocks=nblocks, rows=rows,
-                          until=target is not None, peel=peel),
+                          until=target is not None, peel=peel,
+                          hoisted=hoisted),
         out_shape=out_shapes,
         grid_spec=grid_spec,
         # Mosaic TPU simulator where this jax has it; jax 0.4.x predates

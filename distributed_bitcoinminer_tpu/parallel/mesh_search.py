@@ -76,9 +76,9 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "rem", "k", "batch", "nbatches", "tier"))
-def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
-                        rem: int, k: int, batch: int, nbatches: int,
-                        tier: str = "jnp"):
+def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, hoist=None, *,
+                        mesh: Mesh, rem: int, k: int, batch: int,
+                        nbatches: int, tier: str = "jnp"):
     """Scan ``n`` disjoint spans, one per device, and merge on device.
 
     midstate: (8,) uint32 — replicated.
@@ -87,6 +87,9 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
         ``i0_d[d] + [0, nbatches*batch)``).
     lo_i, hi_i: uint32 scalars — the block's global valid lane window;
         lanes outside it contribute the 0xffffffff sentinel.
+    hoist: optional lane-invariant precompute operand dict
+        (``sha256_jnp.HoistPlan.ops``) — replicated like the midstate it
+        extends; None keeps the original entry path.
     tier: per-device kernel — ``jnp`` (rolled span scan) or ``pallas``
         (unrolled Mosaic kernel; the collective merge is identical).
 
@@ -94,12 +97,15 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
     """
     midstate = jnp.asarray(midstate, dtype=jnp.uint32)
     template = jnp.asarray(template, dtype=jnp.uint32)
+    hoist_in = () if hoist is None else (hoist,)
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(), P(), P(AXIS), P(), P()),
+        in_specs=(P(), P(), P(AXIS), P(), P()) + ((P(),) if hoist_in
+                                                 else ()),
         out_specs=(P(), P(), P()))
-    def body(midstate, template, i0, lo_i, hi_i):
+    def body(midstate, template, i0, lo_i, hi_i, *hoist_in):
+        hoist = hoist_in[0] if hoist_in else None
         # The pallas tier runs everywhere since round 3: through Mosaic on
         # the chip, through the Mosaic TPU simulator (InterpretParams) on
         # the CPU test mesh — the wrapper derives interpret mode from the
@@ -113,25 +119,26 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, *, mesh: Mesh,
             hi_h, lo_h, idx = pallas_argmin(
                 midstate, template, i0[0], lo_i, hi_i,
                 rem=rem, k=k, total=batch * nbatches,
-                platform=mesh.devices.flat[0].platform, vma=(AXIS,))
+                platform=mesh.devices.flat[0].platform, vma=(AXIS,),
+                hoist=hoist)
         else:
             hi_h, lo_h, idx = span_scan_body(
                 midstate, template, i0[0], lo_i, hi_i,
                 rem=rem, k=k, batch=batch, nbatches=nbatches,
-                vary_axes=(AXIS,))
+                vary_axes=(AXIS,), hoist=hoist)
         return _pmin_lex_argmin(hi_h, lo_h, idx)
 
     return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
-                jnp.uint32(lo_i), jnp.uint32(hi_i))
+                jnp.uint32(lo_i), jnp.uint32(hi_i), *hoist_in)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "rem", "k", "batch", "nbatches", "tier"))
 def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
-                              target_hi, target_lo, *, mesh: Mesh, rem: int,
-                              k: int, batch: int, nbatches: int,
-                              tier: str = "jnp"):
+                              target_hi, target_lo, hoist=None, *,
+                              mesh: Mesh, rem: int, k: int, batch: int,
+                              nbatches: int, tier: str = "jnp"):
     """Difficulty-target scan over ``n`` disjoint per-device spans.
 
     Each device scans its own contiguous span — the jnp tier with the
@@ -155,23 +162,27 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
     """
     midstate = jnp.asarray(midstate, dtype=jnp.uint32)
     template = jnp.asarray(template, dtype=jnp.uint32)
+    hoist_in = () if hoist is None else (hoist,)
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(), P(), P(AXIS), P(), P(), P(), P()),
+        in_specs=(P(), P(), P(AXIS), P(), P(), P(), P()) + (
+            (P(),) if hoist_in else ()),
         out_specs=(P(),) * 5)
-    def body(midstate, template, i0, lo_i, hi_i, t_hi, t_lo):
+    def body(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *hoist_in):
+        hoist = hoist_in[0] if hoist_in else None
         if tier == "pallas":
             from ..ops.sha256_pallas import pallas_until
             found, f_idx, b_hi, b_lo, b_idx = pallas_until(
                 midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
                 rem=rem, k=k, total=batch * nbatches,
-                platform=mesh.devices.flat[0].platform, vma=(AXIS,))
+                platform=mesh.devices.flat[0].platform, vma=(AXIS,),
+                hoist=hoist)
         else:
             found, f_idx, b_hi, b_lo, b_idx = span_until_body(
                 midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
                 rem=rem, k=k, batch=batch, nbatches=nbatches,
-                vary_axes=(AXIS,))
+                vary_axes=(AXIS,), hoist=hoist)
         # First qualifying nonce globally = min of per-device first hits
         # (disjoint ascending spans; non-hit devices carry the MAX
         # sentinel).
@@ -183,7 +194,7 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
 
     return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
                 jnp.uint32(lo_i), jnp.uint32(hi_i),
-                jnp.uint32(target_hi), jnp.uint32(target_lo))
+                jnp.uint32(target_hi), jnp.uint32(target_lo), *hoist_in)
 
 
 def device_spans(i0: int, n_devices: int, batch: int, nbatches: int) -> np.ndarray:

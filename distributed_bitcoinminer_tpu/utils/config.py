@@ -24,6 +24,18 @@ Environment variables:
 - ``DBM_RETRY_ATTEMPTS`` / ``DBM_RETRY_TIMEOUT_S`` / ``DBM_RETRY_BACKOFF_S``
   / ``DBM_RETRY_BACKOFF_CAP_S``: client submit-with-retry plane
   (apps/client.py submit_with_retry).
+- ``DBM_CACHE`` (0 disables) / ``DBM_CACHE_SIZE``: scheduler-side
+  ``(data, lower, upper, target)`` -> Result memoization (bounded LRU):
+  a retried/resubmitted request after a lost Result replays in O(1)
+  instead of re-running the whole search (apps/scheduler.ResultCache).
+- ``DBM_QUEUE_ALARM_S``: age bound after which a still-queued request
+  emits a structured warning (rides the scheduler's sweep timer), so a
+  stalled queue — empty or fully-quarantined pool — is visible to an
+  operator instead of silent.
+- ``DBM_HOIST`` (0 disables): lane-invariant SHA-256 hoist (deep
+  midstate + precombined schedule terms, ops/sha256_jnp.build_hoist).
+- ``DBM_UNTIL_PIPELINE`` (0 disables): difficulty-mode sub-dispatch
+  pipelining (models.miner_model._until_block).
 """
 
 from __future__ import annotations
@@ -171,6 +183,21 @@ class LeaseParams:
     tick_s: float = 1.0            # lease-check cadence
     quarantine_after: int = 3      # consecutive blown leases -> quarantine
     ewma_alpha: float = 0.3        # weight of the newest throughput sample
+    queue_alarm_s: float = 30.0    # queued-request age alarm bound
+
+
+@dataclass(frozen=True)
+class CacheParams:
+    """Scheduler result-memoization knobs (apps/scheduler.ResultCache).
+
+    The cache keys on the full request identity ``(data, lower, upper,
+    target)`` and replays the recorded Result without touching the pool;
+    ``size`` bounds it as an LRU. Weak difficulty merges (a stock miner
+    answered a target chunk) are never cached — their answer is only
+    guaranteed qualifying, not deterministic.
+    """
+    enabled: bool = True
+    size: int = 256
 
 
 @dataclass(frozen=True)
@@ -200,6 +227,7 @@ class FrameworkConfig:
     batch: int | None = None       # None -> platform default
     lease: LeaseParams = field(default_factory=LeaseParams)
     retry: RetryParams = field(default_factory=RetryParams)
+    cache: CacheParams = field(default_factory=CacheParams)
 
     def make_searcher(self, data: str):
         """Build the configured searcher for one message string.
@@ -250,6 +278,15 @@ def lease_from_env() -> LeaseParams:
         floor_s=_float_env("DBM_LEASE_FLOOR_S", d.floor_s),
         tick_s=_float_env("DBM_LEASE_TICK_S", d.tick_s),
         quarantine_after=_int_env("DBM_LEASE_QUARANTINE", d.quarantine_after),
+        queue_alarm_s=_float_env("DBM_QUEUE_ALARM_S", d.queue_alarm_s),
+    )
+
+
+def cache_from_env() -> CacheParams:
+    d = CacheParams()
+    return CacheParams(
+        enabled=_int_env("DBM_CACHE", 1) != 0,
+        size=max(1, _int_env("DBM_CACHE_SIZE", d.size)),
     )
 
 
@@ -280,4 +317,5 @@ def from_env() -> FrameworkConfig:
         batch=int(batch) if batch else None,
         lease=lease_from_env(),
         retry=retry_from_env(),
+        cache=cache_from_env(),
     )

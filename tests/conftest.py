@@ -70,6 +70,14 @@ def _fast_exit():
     os._exit(_exit_status[0])
 
 
+def pytest_configure(config):
+    # The tier-1 gate runs `-m 'not slow'`; register the marker so the
+    # full-cross-product hoist sweeps (tests/test_hoist.py) don't warn.
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate; run explicitly with -m slow")
+
+
 @pytest.fixture(autouse=True)
 def reset_network_faults():
     """Every test starts and ends with a clean fault-injection state."""

@@ -207,6 +207,15 @@ def test_client_drop_with_parked_chunk_does_not_deadlock():
 def test_end_to_end_with_real_jax_searcher():
     from distributed_bitcoinminer_tpu.apps.miner import default_searcher_factory
 
+    # Precompile OUTSIDE the wire deadline: on slow CPU boxes the first
+    # XLA compile alone ate the whole 120 s budget (flaked on the seed
+    # too). This searcher scans the exact range the one chunk below will
+    # cover, so every (rem, k, nbatches) signature—and the until/argmin
+    # graphs behind it—is warm in the in-process jit cache (and the
+    # persistent cache) before the clock starts; the timed wait then
+    # covers wire + execution only.
+    default_searcher_factory("cmu440", 1 << 10).search(0, 3000)
+
     async def scenario():
         async with Cluster(fast_params()) as c:
             await c.start_miner(

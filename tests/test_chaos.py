@@ -92,9 +92,10 @@ class ChaosCluster:
     def hostport(self):
         return f"127.0.0.1:{self.server.port}"
 
-    async def add_miner(self, name, delay=0.02):
+    async def add_miner(self, name, delay=0.02, factory=None):
         m = chaos.ChaosMiner(self.hostport, params=self.params,
-                             searcher_factory=oracle_factory(delay),
+                             searcher_factory=factory or
+                             oracle_factory(delay),
                              name=name)
         await m.start()
         # The JOIN rides an async datagram; wait until the scheduler has
@@ -300,6 +301,58 @@ def test_client_retry_difficulty_target_mode():
                              c.params), 30)
             assert ref is not None and ref[2]
             assert await c.settle()
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_seeded_chaos_difficulty_storm_first_qualifying(seed):
+    """Chaos coverage for difficulty mode (ROADMAP open item): a seeded
+    self-healing storm (wedges -> lease blow + speculative re-issue,
+    kills -> epoch drop + chunk recovery, packet delay) rides over an
+    all-until pool while clients drive ``search_until`` requests through
+    ``submit_with_retry``. Invariants, per request:
+
+    - the answer is EXACTLY the host oracle's first-qualifying nonce over
+      the scanned range [0, max+1] (or the exact arg-min fallback when
+      the target is unreachable) — wedged stragglers, re-issued copies,
+      prefix releases, and retry resubmissions never change the merge;
+    - the pool converges to quiescent after the storm heals.
+
+    Retried resubmissions of an already-answered request replay from the
+    scheduler's result memo — the cache satellite under the same storm.
+    """
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+    from tests.test_difficulty import until_factory
+
+    async def scenario():
+        chaos.seed_packet_faults(seed)
+        async with ChaosCluster(lease=tight_lease(quarantine_after=3)) as c:
+            for name in ("alpha", "beta", "gamma"):
+                await c.add_miner(name, factory=until_factory(0.02))
+            schedule = chaos.generate_schedule(
+                seed, 3.0, list(c.miners), episodes=4, max_percent=20,
+                kinds=("wedge", "kill", "delay"))
+            storm = asyncio.create_task(
+                chaos.run_schedule(schedule, c.miners))
+            #              (data, max_nonce, target)
+            jobs = [("until storm one", 1499, 1 << 59),   # quick hit
+                    ("until storm two", 1999, 1 << 58),   # deeper hit
+                    ("until storm three", 899, 1)]        # miss -> argmin
+            retry = RetryParams(attempts=8, timeout_s=2.5, backoff_s=0.1,
+                                backoff_cap_s=0.5)
+            try:
+                for data, max_nonce, target in jobs:
+                    got = await asyncio.wait_for(submit_with_retry(
+                        c.hostport, data, max_nonce, target, c.params,
+                        retry), 40)
+                    assert got is not None, f"{data} never answered"
+                    want = scan_until(data, 0, max_nonce + 1, target)
+                    assert got == want, (data, got, want)
+            finally:
+                await asyncio.wait_for(storm, 20)
+            assert await c.settle(timeout=12.0)
+            # All miners speak the extension: the merge was never weak.
+            assert c.scheduler.current is None
     asyncio.run(scenario())
 
 

@@ -11,11 +11,14 @@ These are the recovery paths the lease machinery extends (ISSUE 1 satellite):
 regressions here historically hid behind timing luck in the e2e tests.
 """
 
-from distributed_bitcoinminer_tpu.apps.scheduler import Request, Scheduler
+from distributed_bitcoinminer_tpu.apps.scheduler import (Request,
+                                                         ResultCache,
+                                                         Scheduler)
 from distributed_bitcoinminer_tpu.bitcoin.hash import MAX_U64
 from distributed_bitcoinminer_tpu.bitcoin.message import (
     Message, MsgType, new_join, new_request, new_result)
-from distributed_bitcoinminer_tpu.utils.config import LeaseParams
+from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
+                                                       LeaseParams)
 
 
 class FakeServer:
@@ -246,3 +249,141 @@ def test_empty_range_still_answers_with_quarantined_miner_present():
     result(sched, MINER_B)
     replies = server.sent_to(CLIENT_Y, MsgType.RESULT)
     assert [(m.hash, m.nonce) for m in replies] == [(MAX_U64, 0)]
+
+
+# --------------------------------------------------- result memoization plane
+
+
+def test_result_cache_replays_identical_request_without_pool():
+    """The retry-path satellite: a resubmitted (data, lower, upper,
+    target) request after a lost Result replays in O(1) from the memo —
+    no new chunk is dispatched, the recorded answer is returned as-is."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "memo", 99)
+    result(sched, MINER_A, h=5, nonce=2)
+    assert sched.stats["cache_stores"] == 1
+    assert len(server.sent_to(CLIENT_X, MsgType.RESULT)) == 1
+    request(sched, CLIENT_Y, "memo", 99)     # identical key, other client
+    assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 1  # no new work
+    replies = server.sent_to(CLIENT_Y, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(5, 2)]
+    assert sched.stats["cache_hits"] == 1
+    assert sched.stats["results_sent"] == 2
+    assert sched.queue == [] and sched.current is None
+
+
+def test_result_cache_keys_on_full_request_identity():
+    """Different bounds or a different target are different searches: no
+    false sharing across the (data, lower, upper, target) key."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "keyed", 99)
+    result(sched, MINER_A, h=5, nonce=2)
+    request(sched, CLIENT_X, "keyed", 199)         # wider range: miss
+    assert sched.stats["cache_hits"] == 0
+    assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 2
+    result(sched, MINER_A, h=4, nonce=150)
+    request(sched, CLIENT_X, "keyed", 99, target=1 << 60)  # target: miss
+    assert sched.stats["cache_hits"] == 0
+    assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 3
+
+
+def test_result_cache_lru_bound_evicts_oldest():
+    cache = ResultCache(2)
+    cache.put(("a", 0, 1, 0), (1, 1))
+    cache.put(("b", 0, 1, 0), (2, 2))
+    cache.put(("a", 0, 1, 0), (1, 1))      # refresh "a": now newest
+    cache.put(("c", 0, 1, 0), (3, 3))      # evicts "b", not "a"
+    assert len(cache) == 2
+    assert cache.get(("a", 0, 1, 0)) == (1, 1)
+    assert cache.get(("b", 0, 1, 0)) is None
+    assert cache.get(("c", 0, 1, 0)) == (3, 3)
+
+
+def test_weak_difficulty_merge_is_not_cached():
+    """A stock miner answering a target chunk weakens the merge to 'a
+    qualifying nonce' — not a deterministic function of the key, so it
+    must never be memoized (a replay could contradict a re-run)."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "weak", 99, target=1 << 60)
+    # Miner drops the Target key (stock shape): echo target=0.
+    result(sched, MINER_A, h=5, nonce=2, target=0)
+    assert len(server.sent_to(CLIENT_X, MsgType.RESULT)) == 1
+    assert sched.stats["cache_stores"] == 0
+    request(sched, CLIENT_Y, "weak", 99, target=1 << 60)
+    assert sched.stats["cache_hits"] == 0  # re-runs the search
+
+
+def test_cache_disabled_knob():
+    sched = Scheduler(FakeServer(), cache=CacheParams(enabled=False))
+    assert sched.results is None
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "off", 99)
+    result(sched, MINER_A, h=5, nonce=2)
+    assert sched.stats["cache_stores"] == 0
+    request(sched, CLIENT_Y, "off", 99)
+    assert sched.stats["cache_hits"] == 0
+    assert len(sched.server.sent_to(MINER_A, MsgType.REQUEST)) == 2
+
+
+# ------------------------------------------- queue-age + starvation telemetry
+
+
+def test_no_eligible_miner_latches_once_per_episode():
+    """A dispatch pass that finds queued work but an empty (or fully
+    quarantined) pool must say so — once per starvation episode, not per
+    event — and clear when the pool recovers."""
+    sched, server = make_scheduler()
+    request(sched, CLIENT_X, "starved", 99)        # no miners at all
+    assert sched.stats["no_eligible_miner"] == 1
+    request(sched, CLIENT_Y, "also starved", 99)   # same episode
+    assert sched.stats["no_eligible_miner"] == 1
+    join(sched, MINER_A)                           # pool recovers
+    assert sched.current is not None
+    result(sched, MINER_A)
+    result(sched, MINER_A)
+    assert len(server.sent_to(CLIENT_X, MsgType.RESULT)) == 1
+    assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
+    # A fresh starvation episode (fully-quarantined pool) latches again.
+    sched._find_miner(MINER_A).quarantined = True
+    request(sched, CLIENT_X, "starved again", 99)
+    assert sched.stats["no_eligible_miner"] == 2
+
+
+def test_queue_age_alarm_fires_once_per_bound_interval():
+    sched, _server = make_scheduler(queue_alarm_s=5.0)
+    request(sched, CLIENT_X, "stalled", 99)        # no miners: stays queued
+    req = sched.queue[0]
+    sched._check_queue_age()                       # too young: silent
+    assert sched.stats["queue_alarms"] == 0
+    req.queued_at -= 100.0                         # age it past the bound
+    sched._check_queue_age()
+    assert sched.stats["queue_alarms"] == 1
+    sched._check_queue_age()                       # within re-warn window
+    assert sched.stats["queue_alarms"] == 1
+    req.last_alarm -= 100.0                        # next interval elapsed
+    sched._check_queue_age()
+    assert sched.stats["queue_alarms"] == 2
+    join(sched, MINER_A)                           # dispatches; queue empty
+    sched._check_queue_age()
+    assert sched.stats["queue_alarms"] == 2
+
+
+def test_result_cache_replays_at_dispatch_time_too():
+    """A duplicate that queued BEHIND its still-in-flight original (the
+    common retry race) must replay from the memo when it is POPPED, not
+    re-run the whole search: the original finished and stored while the
+    duplicate waited in the queue."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "dup race", 99)   # in flight
+    request(sched, CLIENT_Y, "dup race", 99)   # queued; cache still empty
+    assert len(sched.queue) == 1 and sched.stats["cache_hits"] == 0
+    result(sched, MINER_A, h=5, nonce=2)       # finishes + stores + pops queue
+    replies = server.sent_to(CLIENT_Y, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(5, 2)]
+    assert sched.stats["cache_hits"] == 1
+    assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 1  # no re-run
+    assert sched.queue == [] and sched.current is None
