@@ -82,6 +82,11 @@ def _measure_overlapped(searcher, lower: int, upper: int, reps: int,
 
 def main() -> int:
     from distributed_bitcoinminer_tpu.utils.config import probe_backend
+    from distributed_bitcoinminer_tpu.utils.metrics import ensure_emitter
+    # Metrics plane live during the measurement (DBM_METRICS_INTERVAL_S;
+    # 0 disables the emitter — the overhead-comparison baseline). The
+    # final registry snapshot is embedded in the artifact either way.
+    ensure_emitter()
     init_deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
     probe = probe_backend(init_deadline, _REPO)
     force_cpu = "error" in probe
@@ -306,6 +311,7 @@ def main() -> int:
             sweep_detail = {"rem_sweep_error": repr(exc)[:200]}
 
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
+    from distributed_bitcoinminer_tpu.utils.metrics import registry
 
     _emit(best["rate"], {
         "tier": best_tier,
@@ -332,6 +338,11 @@ def main() -> int:
                        if "overlapped_rate" in r},
         **until_detail,
         **sweep_detail,
+        # Process metrics snapshot (ISSUE 3): stable-keyed and
+        # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
+        # (midstate cache behavior, until-tier degradations) stay
+        # comparable run to run.
+        "metrics": registry().snapshot(),
         **({"tier_errors": errors} if errors else {}),
         **({"probe": probe} if force_cpu else {}),
     })

@@ -17,8 +17,17 @@ from ..lsp.client import new_async_client
 from ..lsp.errors import LspError
 from ..lsp.params import Params
 from ..utils.config import RetryParams
+from ..utils.metrics import registry as _registry
 
 logger = logging.getLogger("dbm.client")
+
+# Client-side retry metrics (utils/metrics.py): how often the retry plane
+# actually fires, and how attempts resolve.
+_M = _registry()
+_MET_ATTEMPTS = _M.counter("client.retry_attempts")
+_MET_OUTCOME = {k: _M.counter("client.retry_outcomes", outcome=k)
+                for k in ("ok", "exhausted")}
+_MET_RESULT_S = _M.histogram("client.result_latency_s")
 
 
 async def submit(hostport: str, message: str, max_nonce: int,
@@ -143,7 +152,9 @@ async def submit_with_retry(hostport: str, message: str, max_nonce: int,
     """
     retry = retry if retry is not None else RetryParams()
     delay = retry.backoff_s
+    t0 = asyncio.get_running_loop().time()
     for attempt in range(max(1, retry.attempts)):
+        _MET_ATTEMPTS.inc()
         if attempt:
             await asyncio.sleep(delay)
             delay = min(delay * 2, retry.backoff_cap_s)
@@ -184,7 +195,10 @@ async def submit_with_retry(hostport: str, message: str, max_nonce: int,
             continue
         if msg.type != MsgType.RESULT:
             continue
+        _MET_OUTCOME["ok"].inc()
+        _MET_RESULT_S.observe(asyncio.get_running_loop().time() - t0)
         return msg.hash, msg.nonce, bool(target) and msg.hash < target
+    _MET_OUTCOME["exhausted"].inc()
     return None
 
 

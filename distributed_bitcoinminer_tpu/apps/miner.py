@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import OrderedDict
 from typing import Callable, Optional
 
@@ -25,8 +26,19 @@ from ..bitcoin.message import Message, MsgType, new_join, new_result
 from ..lsp.client import AsyncClient, new_async_client
 from ..lsp.errors import LspError
 from ..lsp.params import Params
+from ..utils.metrics import ensure_emitter, registry as _registry
 
 logger = logging.getLogger("dbm.miner")
+
+# Process-wide miner compute metrics (utils/metrics.py): per-chunk compute
+# latency, scanned-nonce totals, and a nonces/s EWMA — the miner-side
+# ground truth the scheduler's lease EWMA estimates from the outside.
+_M = _registry()
+_MET_CHUNK_S = _M.histogram("miner.chunk_seconds")
+_MET_NONCES = _M.counter("miner.nonces_scanned")
+_MET_CHUNKS = _M.counter("miner.chunks_served")
+_MET_RATE = _M.ewma("miner.nonces_per_s", tau_s=30.0)
+_MET_FAILURES = _M.counter("miner.search_failures")
 
 
 class HostSearcher:
@@ -97,6 +109,7 @@ class MinerWorker:
         self._searchers: OrderedDict[str, object] = OrderedDict()
         self.client: Optional[AsyncClient] = None
         self.jobs_done = 0
+        ensure_emitter()   # DBM_METRICS_INTERVAL_S-driven; 0 = no-op
 
     async def join(self) -> None:
         """Connect and send Join (ref: miner.go:24-34)."""
@@ -119,10 +132,12 @@ class MinerWorker:
             if msg.type != MsgType.REQUEST:
                 continue
             # Compute off-loop so LSP heartbeats keep flowing mid-search.
+            t0 = time.monotonic()
             try:
                 best_hash, best_nonce, echo_target = await asyncio.to_thread(
                     self._search, msg.data, msg.lower, msg.upper, msg.target)
             except Exception:
+                _MET_FAILURES.inc()
                 # A broken worker must LEAVE the pool — exit so the
                 # scheduler declares the connection lost and reassigns
                 # this exact chunk (ref: the Go miner exits silently on
@@ -137,6 +152,18 @@ class MinerWorker:
                                  msg.data, msg.lower, msg.upper)
                 await self.client.close()
                 return
+            elapsed = max(time.monotonic() - t0, 1e-9)
+            _MET_CHUNK_S.observe(elapsed)
+            _MET_CHUNKS.inc()
+            if msg.upper >= msg.lower:
+                # Upper is read inclusive (reference bound quirk). A
+                # difficulty early-exit may scan less than `scanned`, so
+                # the EWMA is an upper bound there — same caveat as the
+                # scheduler-side lease EWMA, which excludes target chunks.
+                scanned = msg.upper - msg.lower + 1
+                _MET_NONCES.inc(scanned)
+                if not msg.target:
+                    _MET_RATE.observe(scanned / elapsed)
             try:
                 self.client.write(
                     new_result(best_hash, best_nonce, echo_target).to_json())

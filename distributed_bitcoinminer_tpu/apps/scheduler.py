@@ -57,6 +57,41 @@ channel-coupled goroutines:
   assignments until it answers again (any Result pop lifts it). Leases and
   quarantine change scheduling latency under faults only — never the
   answer: re-issued chunks scan the same range, so the merge is idempotent.
+- Position-aware leases (ISSUE 3, closes the ROADMAP "lease-aware FIFO
+  depth" item): a miner computes its pending FIFO strictly in order, so a
+  chunk assigned BEHIND other entries (e.g. behind the cancelled chunk of
+  a dropped client that the miner is still grinding) cannot start until
+  they pop. Its initial deadline therefore BUDGETS the work ahead — the
+  latest predecessor expiry plus its own lease — and is re-stamped to the
+  tight single-chunk lease when the chunk actually reaches the FIFO head
+  (which also re-stamps ``assigned_at``, keeping the throughput EWMA
+  honest). A deep-but-healthy FIFO no longer blows leases spuriously,
+  while a FIFO wedged at its head still expires once the budget runs out
+  (never deferring forever — the flaw a pure start-at-head clock has).
+  ``LeaseParams.fifo_aware=False`` restores the at-assignment clock; with
+  it off, a lease that blows while entries sit ahead of the chunk is
+  counted in ``leases_blown_spurious`` (the before/after evidence).
+- Desperation dispatch (ISSUE 3, closes the ROADMAP open item): when the
+  ENTIRE pool is quarantined, waiting for an answer that may never come
+  serves nobody — a queued request is dispatched to the least-bad
+  available quarantined miner (lowest blown-lease streak, then highest
+  observed throughput) as a last resort, counted in
+  ``desperation_dispatch`` and logged as a structured warning. Gated by
+  ``LeaseParams.desperation``; any non-quarantined miner disables it.
+
+Observability plane (ISSUE 3): every counter that used to live in the
+ad-hoc ``stats`` dict is now a series in a per-scheduler metrics
+:class:`~..utils.metrics.Registry`, mounted into the process registry under
+``sched.`` so the periodic emitter and ``bench.py`` snapshots include it;
+``Scheduler.stats`` remains as a read-only dict view for tests/operators.
+Queue depth, queue-age and lease-wait histograms, per-miner throughput
+EWMA gauges, lease-remaining gauges, and the cache hit ratio ride the same
+registry. Each request additionally records a TRACE — an ordered span of
+enqueue -> dispatch -> assign/result/merge -> reply events keyed by its
+``job_id`` (no wire-format change) — retrievable via
+:meth:`Scheduler.trace` and dumped wholesale when a queue-age or in-flight
+age alarm fires, so a stalled request names the miner that wedged it and
+the re-issue that rescued it.
 
 Bookkeeping divergence from the reference (deliberate): the reference tracks
 one recorded chunk per miner plus a positional ``responsibleMiners`` list,
@@ -76,6 +111,7 @@ merge rule, one-in-flight FIFO scheduling) is unchanged.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from dataclasses import dataclass, field
@@ -86,8 +122,20 @@ from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
 from ..utils.config import CacheParams, LeaseParams
+from ..utils.metrics import (LATENCY_BUCKETS_S, Registry, RequestTrace,
+                             TraceBuffer, ensure_emitter,
+                             registry as process_registry)
 
 logger = logging.getLogger("dbm.scheduler")
+
+#: Every monotonic counter the scheduler keeps (the old ``stats`` dict keys
+#: plus the ISSUE 3 additions). ``Scheduler.stats`` is a dict view of these.
+STAT_COUNTERS = (
+    "results_sent", "dup_results", "leases_blown", "reissues",
+    "quarantines", "cache_hits", "cache_misses", "cache_stores",
+    "queue_alarms", "inflight_alarms", "no_eligible_miner",
+    "desperation_dispatch", "leases_blown_spurious",
+)
 
 
 class ResultCache:
@@ -140,8 +188,13 @@ class Chunk:
     # re-issue pushes a fresh Chunk object (same job/idx/range) onto the
     # takeover miner's FIFO with its own lease, while the blown original
     # stays in its miner's FIFO awaiting the in-order pop.
-    assigned_at: float = 0.0   # monotonic stamp set by _assign_chunk
-    deadline: float = 0.0      # lease expiry (monotonic); 0 = no lease
+    assigned_at: float = 0.0   # monotonic stamp; reset when the lease starts
+    deadline: float = 0.0      # lease expiry (monotonic)
+    # Position-aware lease clock (fifo_aware): False until the chunk
+    # reaches the head of its miner's FIFO. Until then the deadline is a
+    # BUDGET covering the predecessors too; at the head it is re-stamped
+    # to the tight single-chunk lease.
+    lease_started: bool = False
     lease_blown: bool = False  # expiry observed (counted once per entry)
     reissued: bool = False     # a speculative copy is already in flight
 
@@ -205,6 +258,20 @@ class Request:
     cache_key: Optional[tuple] = None  # (data, lower, upper, target) as received
     queued_at: float = 0.0         # monotonic stamp set at _on_request
     last_alarm: float = 0.0        # last queue-age warning for this request
+    # Separate stamp for the in-flight age alarm: a request that alarmed
+    # while QUEUED must not have its first in-flight alarm suppressed for
+    # a full extra bound after dispatch.
+    last_inflight_alarm: float = 0.0
+    trace: object = None           # RequestTrace (utils/metrics.py)
+
+    def __post_init__(self):
+        # Every Request carries a trace from birth, even when constructed
+        # directly (tests, programmatic drivers) rather than via
+        # _on_request — the scheduler records events unconditionally.
+        if self.trace is None:
+            self.trace = RequestTrace(data=self.data, lower=self.lower,
+                                      upper=self.upper, target=self.target,
+                                      client=self.conn_id)
 
 
 class Scheduler:
@@ -226,11 +293,77 @@ class Scheduler:
         self._pool_rate: Optional[float] = None   # pool-wide throughput EWMA
         self._dispatching = False                 # _maybe_dispatch guard
         self._starved = False                     # no-eligible-miner latch
-        # Observability for tests/ops; never drives behavior.
-        self.stats = {"results_sent": 0, "dup_results": 0,
-                      "leases_blown": 0, "reissues": 0, "quarantines": 0,
-                      "cache_hits": 0, "cache_stores": 0,
-                      "queue_alarms": 0, "no_eligible_miner": 0}
+        # Observability plane (ISSUE 3): a per-scheduler registry (so unit
+        # tests see exactly THIS instance's counts), mounted into the
+        # process registry under "sched." for the emitter/bench snapshot.
+        # The prefix is FIXED and latest-wins by design: production runs
+        # one scheduler per process, and a stable key set is what keeps
+        # emitter lines and BENCH snapshots diffable across restarts. A
+        # process deliberately embedding several live schedulers should
+        # read each instance's own `.metrics`/`.stats` — only the newest
+        # is visible through the process snapshot. Never drives behavior.
+        self.metrics = Registry()
+        process_registry().mount("sched", self.metrics)
+        ensure_emitter()
+        self._counters = {n: self.metrics.counter(n) for n in STAT_COUNTERS}
+        self._queue_depth = self.metrics.gauge("queue_depth")
+        self._pool_size = self.metrics.gauge("pool_size")
+        self._pool_quarantined = self.metrics.gauge("pool_quarantined")
+        self._cache_hit_ratio = self.metrics.gauge("cache_hit_ratio")
+        self._lease_min_remaining = self.metrics.gauge(
+            "lease_min_remaining_s")
+        self._queue_wait = self.metrics.histogram("queue_wait_s",
+                                                  LATENCY_BUCKETS_S)
+        self._lease_wait = self.metrics.histogram("lease_wait_s",
+                                                  LATENCY_BUCKETS_S)
+        self.traces = TraceBuffer()
+        self._cache_trace_seq = 0
+
+    # ------------------------------------------------------- stats / metrics
+
+    @property
+    def stats(self) -> dict:
+        """Read-only dict view of every counter (the pre-ISSUE-3 ``stats``
+        dict surface, now backed by the registry)."""
+        return {n: c.value for n, c in self._counters.items()}
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self._counters[name].inc(n)
+
+    def _update_pool_gauges(self) -> None:
+        self._pool_size.set(len(self.miners))
+        self._pool_quarantined.set(
+            sum(1 for m in self.miners if m.quarantined))
+
+    def _cache_lookup(self, key, count_miss: bool = True):
+        """ResultCache get + hit/miss/ratio accounting in one place.
+
+        ``count_miss=False`` for the dispatch-time RE-check of a key that
+        already missed at enqueue: counting it again would charge every
+        normally-dispatched request two misses and skew the hit ratio."""
+        hit = self.results.get(key)
+        if hit is not None:
+            self._count("cache_hits")
+        elif count_miss:
+            self._count("cache_misses")
+        hits = self._counters["cache_hits"].value
+        total = hits + self._counters["cache_misses"].value
+        self._cache_hit_ratio.set(hits / total if total else 0.0)
+        return hit
+
+    def trace(self, request_id: int):
+        """The recorded :class:`RequestTrace` for a job id (or a
+        ``cache:N`` replay key); None when unknown or evicted."""
+        return self.traces.get(request_id)
+
+    def _dump_trace(self, why: str, trace) -> None:
+        """Structured single-line JSON dump of one request trace — the
+        queue-age alarm's "a stalled request explains itself" payload."""
+        if trace is None:
+            return
+        logger.warning("trace dump (%s): %s", why,
+                       json.dumps(trace.to_dict(), sort_keys=True,
+                                  default=str))
 
     # ------------------------------------------------------------- main loop
 
@@ -280,15 +413,15 @@ class Scheduler:
     def _on_request(self, conn_id: int, msg: Message) -> None:
         key = (msg.data, msg.lower, msg.upper, msg.target)
         if self.results is not None:
-            hit = self.results.get(key)
+            hit = self._cache_lookup(key)
             if hit is not None:
                 # O(1) replay: a retried/resubmitted request after a lost
                 # Result answers from the memo without touching the pool
                 # (and without queueing behind the in-flight request).
                 h, nonce = hit
                 self._write(conn_id, new_result(h, nonce))
-                self.stats["results_sent"] += 1
-                self.stats["cache_hits"] += 1
+                self._count("results_sent")
+                self._trace_cache_replay(conn_id, key, h, nonce)
                 logger.info("request %r [%d, %d] target=%d answered from "
                             "the result cache", msg.data, msg.lower,
                             msg.upper, msg.target)
@@ -297,8 +430,25 @@ class Scheduler:
                           lower=msg.lower, upper=msg.upper,
                           target=msg.target, cache_key=key,
                           queued_at=time.monotonic())
+        request.trace.event("enqueue", queue_depth=len(self.queue))
         self.queue.append(request)
+        self._queue_depth.set(len(self.queue))
         self._maybe_dispatch()
+
+    def _trace_cache_replay(self, conn_id: int, key, h: int,
+                            nonce: int) -> None:
+        """An at-enqueue memo replay never builds a Request (and never
+        gets a job id): trace it under a synthetic ``cache:N`` key so
+        trace completeness still holds. (A replay at DISPATCH time reuses
+        the queued Request's own trace instead — its enqueue stamp and
+        queue wait are real history that must not be discarded.)"""
+        self._cache_trace_seq += 1
+        trace = self.traces.new(data=key[0], lower=key[1], upper=key[2],
+                                target=key[3], client=conn_id)
+        trace.event("enqueue", queue_depth=len(self.queue))
+        trace.event("cache_hit", at="request")
+        trace.event("reply", hash=h, nonce=nonce, cached=True)
+        self.traces.register(f"cache:{self._cache_trace_seq}", trace)
 
     def _on_join(self, conn_id: int) -> None:
         miner = MinerState(conn_id=conn_id)
@@ -306,8 +456,9 @@ class Scheduler:
         # (ref: server.go:222-244).
         chunk = self._next_parked()
         if chunk is not None:
-            self._assign_chunk(miner, chunk)
+            self._assign_chunk(miner, chunk, kind="parked")
         self.miners.append(miner)
+        self._update_pool_gauges()
         self._maybe_dispatch()
 
     def _on_result(self, conn_id: int, msg: Message) -> None:
@@ -316,6 +467,11 @@ class Scheduler:
             return
         chunk = miner.pending.pop(0)   # the Result answers the oldest Request
         self._observe_result(miner, chunk)
+        # Position-aware leases: the next FIFO entry is what the miner
+        # computes now — start its clock (no-op when already started, i.e.
+        # fifo_aware off or it was assigned to an empty FIFO).
+        if miner.pending and not miner.pending[0].lease_started:
+            self._start_lease(miner, miner.pending[0])
         # A freed miner immediately absorbs one parked chunk
         # (ref: server.go:285-304) — BEFORE the stale-Result return, so a
         # miner freed by a stale answer still rescues parked work. The
@@ -325,16 +481,21 @@ class Scheduler:
         if self.parked and miner.available:
             parked = self._next_parked(skip_key=(chunk.job_id, chunk.idx))
             if parked is not None:
-                self._assign_chunk(miner, parked)
+                self._assign_chunk(miner, parked, kind="parked")
         curr = self.current
         if curr is None or chunk.job_id != curr.job_id:
+            stale = self.traces.get(chunk.job_id)
+            if stale is not None:
+                stale.event("stale_result", miner=conn_id, idx=chunk.idx)
             return  # stale Result for a cancelled/finished request
         if curr.answered[chunk.idx]:
             # Loser of a speculative re-issue race: another assignment of
             # this same (job, idx) already merged. Re-issued copies scan
             # the identical range, so dropping the duplicate changes
             # nothing but the stats.
-            self.stats["dup_results"] += 1
+            self._count("dup_results")
+            curr.trace.event("result", miner=conn_id, idx=chunk.idx,
+                             duplicate=True)
             logger.info("duplicate Result for job %d chunk %d from miner %d "
                         "(speculation loser)", curr.job_id, chunk.idx,
                         conn_id)
@@ -343,6 +504,9 @@ class Scheduler:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
         curr.answered[chunk.idx] = True
+        curr.trace.event("result", miner=conn_id, idx=chunk.idx)
+        curr.trace.event("merge", idx=chunk.idx,
+                         answered=sum(curr.answered))
         if curr.target and msg.target != curr.target and not curr.weak:
             curr.weak = True
             logger.info(
@@ -372,9 +536,17 @@ class Scheduler:
         if miner is not None:
             logger.info("miner %d dropped", conn_id)
             self.miners.remove(miner)
+            self._update_pool_gauges()
+            # Retire the dead conn-id's labeled series: stale values must
+            # not linger in snapshots, and reconnect churn (every rejoin
+            # is a fresh conn id) must not exhaust the family cardinality
+            # bound over a long server life.
+            self.metrics.remove("miner_rate_nps", miner=str(conn_id))
+            self.metrics.remove("lease_remaining_s", miner=str(conn_id))
             curr = self.current
             if curr is None:
                 return
+            curr.trace.event("miner_drop", miner=conn_id)
             # Recover every unanswered chunk of the current request
             # (ref: server.go:326-376, single-chunk version). Chunks whose
             # idx already merged (speculation winner landed first) and
@@ -387,17 +559,23 @@ class Scheduler:
                     continue
                 takeover = next((m for m in self._eligible()), None)
                 if takeover is not None:
-                    self._assign_chunk(takeover, chunk)
+                    self._assign_chunk(takeover, chunk, kind="recovered")
                 else:
                     self.parked.append(chunk)
+                    curr.trace.event("park", idx=chunk.idx)
         else:
             logger.info("client %d dropped", conn_id)
             # Purge the dead client's queued requests FIRST so cancelling its
             # in-flight request can't promote another of its own requests.
+            for req in self.queue:
+                if req.conn_id == conn_id:
+                    req.trace.event("cancel", reason="client_drop")
             self.queue = [r for r in self.queue if r.conn_id != conn_id]
+            self._queue_depth.set(len(self.queue))
             curr = self.current
             if curr is not None and curr.conn_id == conn_id:
                 # Cancel immediately (divergence, see module docstring).
+                curr.trace.event("cancel", reason="client_drop")
                 self._retire()
 
     # -------------------------------------------------------------- internal
@@ -407,16 +585,19 @@ class Scheduler:
         """Answer the client and retire the request. ``early`` = prefix
         release: the job's other chunks are still in flight."""
         self._write(curr.conn_id, new_result(h, nonce))
-        self.stats["results_sent"] += 1
+        self._count("results_sent")
         if self.results is not None and curr.cache_key is not None \
                 and not curr.weak:
             # Weak merges excluded: "a qualifying nonce" from a stock
             # miner is not a deterministic function of the key.
             self.results.put(curr.cache_key, (h, nonce))
-            self.stats["cache_stores"] += 1
+            self._count("cache_stores")
+        elapsed = time.monotonic() - curr.started
+        curr.trace.event("reply", hash=h, nonce=nonce, early=early,
+                         weak=curr.weak, elapsed_s=round(elapsed, 6))
         logger.info(
             "request %d served in %.3fs: [%d, %d) over %d chunks%s%s",
-            curr.job_id, time.monotonic() - curr.started,
+            curr.job_id, elapsed,
             curr.lower, curr.upper, curr.num_chunks,
             " (prefix release)" if early else "",
             " (weak merge)" if curr.weak else "")
@@ -439,6 +620,12 @@ class Scheduler:
                     c.cancelled = True
         self.parked.clear()
         self.current = None
+        # No live leases remain: clear the remaining-lease gauges so an
+        # idle system's snapshot doesn't keep reporting the retired job's
+        # last sweep values as work in flight.
+        for m in self.miners:
+            self.metrics.remove("lease_remaining_s", miner=str(m.conn_id))
+        self._lease_min_remaining.set(0.0)
         self._maybe_dispatch()
 
     def _find_miner(self, conn_id: int) -> Optional[MinerState]:
@@ -471,6 +658,22 @@ class Scheduler:
         return [m for m in self.miners
                 if m.available and not m.quarantined]
 
+    def _desperation_pool(self) -> list[MinerState]:
+        """Last-resort pool when the WHOLE pool is quarantined: the
+        least-bad available quarantined miner (lowest blown streak, then
+        highest observed throughput), or nothing. Any non-quarantined
+        miner — even a busy one that will free up — disables desperation:
+        waiting for a healthy miner beats feeding a known-bad one."""
+        if not self.lease.desperation or not self.miners:
+            return []
+        if not all(m.quarantined for m in self.miners):
+            return []
+        avail = [m for m in self.miners if m.available]
+        if not avail:
+            return []
+        return [min(avail, key=lambda m: (m.blown_streak,
+                                          -(m.rate_ewma or 0.0)))]
+
     def _maybe_dispatch(self) -> None:
         """Start the next queued request when the pool can take one.
 
@@ -484,24 +687,43 @@ class Scheduler:
             return
         self._dispatching = True
         try:
-            while self.current is None and self.queue and self._eligible():
+            while self.current is None and self.queue:
+                pool = self._eligible()
+                desperate = False
+                if not pool:
+                    pool = self._desperation_pool()
+                    if not pool:
+                        break
+                    desperate = True
                 req = self.queue.pop(0)
+                self._queue_depth.set(len(self.queue))
                 if self.results is not None and req.cache_key is not None:
-                    hit = self.results.get(req.cache_key)
+                    hit = self._cache_lookup(req.cache_key,
+                                             count_miss=False)
                     if hit is not None:
                         # A duplicate that queued BEHIND its original
                         # (retry raced the still-in-flight first copy)
                         # replays at pop time: the original finished and
-                        # stored while this one waited.
+                        # stored while this one waited. The request's OWN
+                        # trace is completed and registered (under a
+                        # cache:N key — it never gets a job id) so the
+                        # real queue wait stays on record.
                         self._write(req.conn_id, new_result(*hit))
-                        self.stats["results_sent"] += 1
-                        self.stats["cache_hits"] += 1
+                        self._count("results_sent")
+                        self._queue_wait.observe(
+                            time.monotonic() - req.queued_at)
+                        req.trace.event("cache_hit", at="dispatch")
+                        req.trace.event("reply", hash=hit[0], nonce=hit[1],
+                                        cached=True)
+                        self._cache_trace_seq += 1
+                        self.traces.register(
+                            f"cache:{self._cache_trace_seq}", req.trace)
                         logger.info(
                             "queued request %r [%d, %d] answered from "
                             "the result cache at dispatch", req.data,
                             req.lower, req.upper)
                         continue
-                self._load_balance(req)
+                self._load_balance(req, pool, desperate=desperate)
                 self._starved = False
         finally:
             self._dispatching = False
@@ -512,7 +734,7 @@ class Scheduler:
             # while the sweep's queue-age alarm keeps counting time.
             if not self._starved:
                 self._starved = True
-                self.stats["no_eligible_miner"] += 1
+                self._count("no_eligible_miner")
                 quarantined = sum(1 for m in self.miners if m.quarantined)
                 logger.warning(
                     "no eligible miner for %d queued request(s): pool=%d "
@@ -524,18 +746,34 @@ class Scheduler:
         elif not self.queue:
             self._starved = False
 
-    def _load_balance(self, request: Request) -> None:
-        """Split the range over every eligible miner.
+    def _load_balance(self, request: Request, pool: list[MinerState],
+                      desperate: bool = False) -> None:
+        """Split the range over ``pool`` (the eligible miners, or the
+        single-miner desperation pool).
 
         Without faults this is ALL miners (the reference invariant: one
         request in flight, so every miner is free at dispatch); quarantined
         or still-busy miners (wedged compute holding a live lease-blown
         chunk) are excluded."""
-        pool = self._eligible()
         self.current = request
         self._next_job_id += 1
         request.job_id = self._next_job_id
         request.started = time.monotonic()
+        self._queue_wait.observe(request.started - request.queued_at)
+        self.traces.register(request.job_id, request.trace)
+        request.trace.event("dispatch", job=request.job_id,
+                            miners=[m.conn_id for m in pool],
+                            desperate=desperate)
+        if desperate:
+            self._count("desperation_dispatch")
+            m = pool[0]
+            logger.warning(
+                "DESPERATION dispatch: entire pool (%d miner(s)) is "
+                "quarantined; assigning request %r [%d, %d] to least-bad "
+                "miner %d (blown streak %d, rate %s) as a last resort",
+                len(self.miners), request.data, request.lower,
+                request.upper, m.conn_id, m.blown_streak,
+                f"{m.rate_ewma:.0f}/s" if m.rate_ewma else "unknown")
         num = len(pool)
         request.upper += 1  # inclusive -> exclusive
         total = request.upper - request.lower
@@ -559,18 +797,50 @@ class Scheduler:
                       target=request.target, idx=i))
             start = end
 
-    def _assign_chunk(self, miner: MinerState, chunk: Chunk) -> None:
-        now = time.monotonic()
-        chunk.assigned_at = now
-        chunk.deadline = now + self._lease_for(miner, chunk)
+    def _assign_chunk(self, miner: MinerState, chunk: Chunk,
+                      kind: str = "initial") -> None:
+        chunk.assigned_at = time.monotonic()
         chunk.lease_blown = False
         chunk.reissued = False
+        chunk.lease_started = False
+        chunk.deadline = 0.0
         miner.pending.append(chunk)
+        # Position-aware lease clock (see module docstring): a chunk at
+        # the FIFO head starts its tight lease now; one assigned behind
+        # other entries gets a BUDGET deadline (latest predecessor expiry
+        # + its own lease) that is tightened when it reaches the head
+        # (_on_result) — so a deep healthy FIFO never blows spuriously,
+        # but a FIFO wedged at its head still expires. fifo_aware=False
+        # restores the at-assignment clock unconditionally.
+        if not self.lease.fifo_aware or len(miner.pending) == 1:
+            self._start_lease(miner, chunk)
+        else:
+            now = chunk.assigned_at
+            ahead = max((c.deadline for c in miner.pending[:-1]),
+                        default=now)
+            chunk.deadline = max(now, ahead) + self._lease_for(miner, chunk)
+        trace = self.traces.get(chunk.job_id)
+        if trace is not None:
+            trace.event("assign", miner=miner.conn_id, idx=chunk.idx,
+                        lower=chunk.lower, upper=chunk.upper, kind=kind,
+                        fifo_pos=len(miner.pending) - 1,
+                        lease_started=chunk.lease_started)
         self._write(miner.conn_id,
                     new_request(chunk.data, chunk.lower, chunk.upper,
                                 chunk.target))
 
     # ---------------------------------------------------------- lease plane
+
+    def _start_lease(self, miner: MinerState, chunk: Chunk) -> None:
+        """Start the lease clock: the miner is (about to be) computing this
+        chunk. ``assigned_at`` is re-stamped so both the expiry log and the
+        throughput sample measure actual compute time, not FIFO wait."""
+        now = time.monotonic()
+        if chunk.assigned_at:
+            self._lease_wait.observe(now - chunk.assigned_at)
+        chunk.assigned_at = now
+        chunk.deadline = now + self._lease_for(miner, chunk)
+        chunk.lease_started = True
 
     def _observe_result(self, miner: MinerState, chunk: Chunk) -> None:
         """Per-pop bookkeeping: throughput EWMA, streak reset, quarantine
@@ -592,9 +862,13 @@ class Scheduler:
                 alpha * rate + (1 - alpha) * miner.rate_ewma
             self._pool_rate = rate if self._pool_rate is None else \
                 alpha * rate + (1 - alpha) * self._pool_rate
+            self.metrics.gauge("miner_rate_nps",
+                               miner=str(miner.conn_id)).set(miner.rate_ewma)
+            self.metrics.gauge("pool_rate_nps").set(self._pool_rate)
         miner.blown_streak = 0
         if miner.quarantined:
             miner.quarantined = False
+            self._update_pool_gauges()
             logger.info("miner %d answered; quarantine lifted",
                         miner.conn_id)
             self._maybe_dispatch()
@@ -612,28 +886,55 @@ class Scheduler:
         return max(self.lease.floor_s, chunk.size / rate * self.lease.factor)
 
     def _check_queue_age(self) -> None:
-        """Queue-age alarm (ROADMAP open item): a request still queued
-        past ``lease.queue_alarm_s`` emits a structured warning — once
-        per bound interval per request — so an operator sees a stalled
-        queue (empty pool, everything quarantined, or a wedged in-flight
-        request ahead of it) instead of silence. Observability only:
+        """Age alarms (ROADMAP open item + ISSUE 3): a request still QUEUED
+        past ``lease.queue_alarm_s`` — or still IN FLIGHT past the same
+        bound — emits a structured warning, once per bound interval per
+        request, plus a full trace dump so the stall explains itself (a
+        queued request's stall is usually the in-flight request's wedged
+        miner, so its trace is dumped alongside). Observability only:
         never changes scheduling."""
         bound = self.lease.queue_alarm_s
         if bound <= 0:
             return
         now = time.monotonic()
+        curr = self.current
+        queue_alarmed = False
         for req in self.queue:
             age = now - req.queued_at
             if age < bound or now - req.last_alarm < bound:
                 continue
             req.last_alarm = now
-            self.stats["queue_alarms"] += 1
+            queue_alarmed = True
+            self._count("queue_alarms")
             logger.warning(
                 "request %r [%d, %d] from client %d queued for %.1fs "
                 "(bound %.1fs): pool=%d eligible=%d in_flight=%s",
                 req.data, req.lower, req.upper, req.conn_id, age, bound,
                 len(self.miners), len(self._eligible()),
-                self.current is not None)
+                curr is not None)
+            req.trace.event("queue_alarm", age_s=round(age, 3))
+            self._dump_trace("queue-age alarm: stalled request", req.trace)
+        inflight_due = (curr is not None
+                        and now - curr.started >= bound
+                        and now - curr.last_inflight_alarm >= bound)
+        if queue_alarmed and curr is not None and not inflight_due:
+            # The in-flight request is the usual culprit; its trace is the
+            # same document for every stalled request, so dump it once per
+            # sweep — and not at all when the in-flight alarm below dumps
+            # the identical document anyway.
+            self._dump_trace("queue-age alarm: request in flight "
+                             "ahead of the stalled one", curr.trace)
+        if inflight_due:
+            age = now - curr.started
+            curr.last_inflight_alarm = now
+            self._count("inflight_alarms")
+            logger.warning(
+                "request %d in flight for %.1fs (bound %.1fs): "
+                "%d/%d chunks answered",
+                curr.job_id, age, bound, sum(curr.answered),
+                curr.num_chunks)
+            curr.trace.event("inflight_alarm", age_s=round(age, 3))
+            self._dump_trace("in-flight age alarm", curr.trace)
 
     def _check_leases(self) -> None:
         """One lease sweep: blow expired leases (quarantining repeat
@@ -645,6 +946,9 @@ class Scheduler:
         if curr is None:
             return
         now = time.monotonic()
+        # Per-miner MINIMUM remaining lease (a deep budgeted chunk must not
+        # mask the head chunk's imminent expiry), set after the sweep.
+        per_miner_remaining: dict[int, float] = {}
         for miner in list(self.miners):
             for chunk in list(miner.pending):
                 if chunk.cancelled or chunk.job_id != curr.job_id:
@@ -653,20 +957,44 @@ class Scheduler:
                     continue
                 if not chunk.lease_blown:
                     if now < chunk.deadline:
+                        remaining = chunk.deadline - now
+                        prev = per_miner_remaining.get(miner.conn_id)
+                        if prev is None or remaining < prev:
+                            per_miner_remaining[miner.conn_id] = remaining
                         continue
                     chunk.lease_blown = True
-                    self.stats["leases_blown"] += 1
+                    self._count("leases_blown")
+                    # With the at-assignment clock (fifo_aware=False) a
+                    # chunk can blow while entries still sit AHEAD of it —
+                    # the miner never even reached it. Counted so the
+                    # position-aware fix has before/after evidence. (With
+                    # fifo_aware, a pre-head blow means the budgeted
+                    # deadline covering the predecessors ALSO ran out —
+                    # the whole pipeline is overdue, not spurious.)
+                    spurious = (not self.lease.fifo_aware
+                                and miner.pending[0] is not chunk)
+                    if spurious:
+                        self._count("leases_blown_spurious")
                     miner.blown_streak += 1
+                    curr.trace.event("lease_blown", miner=miner.conn_id,
+                                     idx=chunk.idx,
+                                     streak=miner.blown_streak,
+                                     spurious=spurious)
                     logger.warning(
                         "miner %d blew the lease on job %d chunk %d "
-                        "[%d, %d) after %.2fs (streak %d)",
+                        "[%d, %d) after %.2fs (streak %d)%s",
                         miner.conn_id, chunk.job_id, chunk.idx,
                         chunk.lower, chunk.upper, now - chunk.assigned_at,
-                        miner.blown_streak)
+                        miner.blown_streak,
+                        " [spurious: miner had not reached this chunk]"
+                        if spurious else "")
                     if (miner.blown_streak >= self.lease.quarantine_after
                             and not miner.quarantined):
                         miner.quarantined = True
-                        self.stats["quarantines"] += 1
+                        self._count("quarantines")
+                        self._update_pool_gauges()
+                        curr.trace.event("quarantine",
+                                         miner=miner.conn_id)
                         logger.warning(
                             "miner %d quarantined after %d consecutive "
                             "blown leases; no new assignments until it "
@@ -678,7 +1006,10 @@ class Scheduler:
                 if takeover is None:
                     continue   # retry next sweep
                 chunk.reissued = True
-                self.stats["reissues"] += 1
+                self._count("reissues")
+                curr.trace.event("reissue", idx=chunk.idx,
+                                 from_miner=miner.conn_id,
+                                 to_miner=takeover.conn_id)
                 logger.warning(
                     "speculatively re-issuing job %d chunk %d [%d, %d) "
                     "from miner %d to miner %d",
@@ -687,7 +1018,21 @@ class Scheduler:
                 self._assign_chunk(
                     takeover,
                     Chunk(chunk.job_id, chunk.data, chunk.lower,
-                          chunk.upper, target=chunk.target, idx=chunk.idx))
+                          chunk.upper, target=chunk.target, idx=chunk.idx),
+                    kind="reissue")
+        # Miners with no live unexpired lease this sweep (blown, answered,
+        # or idle) lose their series: a stale positive "remaining" on a
+        # blown lease would read as healthy headroom.
+        for m in self.miners:
+            if m.conn_id not in per_miner_remaining:
+                self.metrics.remove("lease_remaining_s",
+                                    miner=str(m.conn_id))
+        for conn_id, remaining in per_miner_remaining.items():
+            self.metrics.gauge("lease_remaining_s",
+                               miner=str(conn_id)).set(remaining)
+        self._lease_min_remaining.set(
+            min(per_miner_remaining.values()) if per_miner_remaining
+            else 0.0)
 
     def _write(self, conn_id: int, msg: Message) -> None:
         try:

@@ -38,8 +38,10 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as exc:
         print("Port must be a number:", exc)
         return 1
-    from ..utils import configure_logging, from_env
+    from ..utils import configure_logging, ensure_emitter, from_env
     configure_logging(logging.INFO, logfile="log.txt")
+    # Periodic metrics snapshot lines into the same log (DBM_METRICS_*).
+    ensure_emitter()
     cfg = from_env()
     try:
         asyncio.run(serve(port, cfg.params, cfg.lease, cfg.cache))

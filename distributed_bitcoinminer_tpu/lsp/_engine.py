@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import time
 from collections import deque
 from typing import Callable, Optional
 
@@ -30,6 +31,26 @@ from .checksum import make_checksum
 from .errors import ConnectionClosed, ConnectionLost, ConnectTimeout
 from .message import Message, MsgType, new_ack, new_data
 from .params import Params
+from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS,
+                             registry as _registry)
+
+# Process-wide transport metrics (utils/metrics.py). Handles are hoisted to
+# module scope: the receive path runs per packet, so per-call registry
+# lookups would be the one avoidable cost. Counts aggregate over every Conn
+# in the process — per-conn labels would be unbounded cardinality for a
+# long-lived server.
+_M = _registry()
+_MET_EPOCHS = _M.counter("lsp.epochs")
+_MET_HEARTBEATS = _M.counter("lsp.heartbeats_sent")
+_MET_RECV_DUP = _M.counter("lsp.recv_discards", reason="duplicate")
+_MET_CONN_LOST = _M.counter("lsp.conns_lost")
+_MET_SEND_WINDOW = _M.histogram("lsp.send_window_occupancy",
+                                buckets=OCCUPANCY_BUCKETS)
+_MET_RECV_PENDING = _M.histogram("lsp.recv_pending_occupancy",
+                                 buckets=OCCUPANCY_BUCKETS)
+_MET_RTT = _M.histogram("lsp.msg_rtt_s", buckets=LATENCY_BUCKETS_S)
+_MET_DROP_LENGTH = _M.counter("lsp.integrity_drops", reason="length")
+_MET_DROP_CHECKSUM = _M.counter("lsp.integrity_drops", reason="checksum")
 
 
 class ConnState(enum.Enum):
@@ -43,7 +64,8 @@ class ConnState(enum.Enum):
 class _Pending:
     """One unacknowledged outbound message and its retransmit schedule."""
 
-    __slots__ = ("seq", "raw", "cur_backoff", "epochs_passed", "fresh")
+    __slots__ = ("seq", "raw", "cur_backoff", "epochs_passed", "fresh",
+                 "sent_at", "retransmitted")
 
     def __init__(self, seq: int, raw: bytes):
         self.seq = seq
@@ -54,6 +76,11 @@ class _Pending:
         # count toward the retransmit schedule (approximates the reference's
         # per-message timer phase within the graded 4-6 sends/14 epochs law).
         self.fresh = True
+        # RTT metric plane: stamp of the (latest) first transmission; a
+        # retransmitted message's eventual ack is ambiguous (Karn's rule),
+        # so only never-retransmitted messages contribute RTT samples.
+        self.sent_at = 0.0
+        self.retransmitted = False
 
 
 class Conn:
@@ -136,7 +163,9 @@ class Conn:
         pending = _Pending(seq, msg.to_json())
         if self._can_admit(seq):
             self._window[seq] = pending
+            pending.sent_at = time.monotonic()
             self._send_raw(pending.raw)
+            _MET_SEND_WINDOW.observe(len(self._window))
         else:
             self._buffer.append(pending)
 
@@ -152,7 +181,9 @@ class Conn:
         while self._buffer and self._can_admit(self._buffer[0].seq):
             pending = self._buffer.popleft()
             self._window[pending.seq] = pending
+            pending.sent_at = time.monotonic()   # first real transmission
             self._send_raw(pending.raw)
+            _MET_SEND_WINDOW.observe(len(self._window))
 
     @property
     def flushed(self) -> bool:
@@ -188,6 +219,7 @@ class Conn:
             # delivery comes from receive-side dedup, not ack suppression;
             # ref: lsp/server_impl.go:462-470). A retransmit of the parked
             # unacked back-pressure head stays unacked until delivery.
+            _MET_RECV_DUP.inc()
             if seq not in self._recv_unacked:
                 self._send_raw(new_ack(self.conn_id, seq).to_json())
             return
@@ -202,6 +234,7 @@ class Conn:
             return
         self._send_raw(new_ack(self.conn_id, seq).to_json())
         self._recv_pending[seq] = msg.payload or b""
+        _MET_RECV_PENDING.observe(len(self._recv_pending))
         self._drain()
 
     def _drain(self) -> None:
@@ -239,6 +272,9 @@ class Conn:
         pending = self._window.pop(msg.seq_num, None)
         if pending is None:
             return
+        if not pending.retransmitted and pending.sent_at:
+            # Send->ack RTT, Karn-filtered (see _Pending).
+            _MET_RTT.observe(time.monotonic() - pending.sent_at)
         self._refill_window()
         if self.state == ConnState.CLOSING and self.flushed:
             self._finish(ConnState.CLOSED)
@@ -254,6 +290,7 @@ class Conn:
 
     def _tick(self) -> bool:
         """One epoch. Returns False when the connection is finished."""
+        _MET_EPOCHS.inc()
         # Loss detection (ref: lsp/client_impl.go timeRoutine:258-286).
         if self._got_traffic:
             self._silent_epochs = 0
@@ -278,6 +315,7 @@ class Conn:
         if not self._got_payload_traffic and \
                 self.state in (ConnState.UP, ConnState.CLOSING):
             self._send_raw(new_ack(self.conn_id, 0).to_json())
+            _MET_HEARTBEATS.inc()
         self._got_payload_traffic = False
 
         # Retransmits: the Connect request and every unacked window element.
@@ -289,6 +327,12 @@ class Conn:
                 pending.fresh = False
             elif pending.epochs_passed >= pending.cur_backoff:
                 self._send_raw(pending.raw)
+                pending.retransmitted = True
+                # Labeled by the backoff level that TRIGGERED this resend
+                # (0, 1, 2, 4, ... capped): the distribution is the
+                # XXOXOOX retransmission-law shape, observable per process.
+                _M.counter("lsp.retransmits",
+                           backoff=str(pending.cur_backoff)).inc()
                 pending.epochs_passed = 0
                 if pending.cur_backoff == 0:
                     pending.cur_backoff = min(1, self.params.max_backoff_interval)
@@ -319,6 +363,7 @@ class Conn:
             self._finish(ConnState.CLOSED)
 
     def _declare_lost(self) -> None:
+        _MET_CONN_LOST.inc()
         self._finish(ConnState.LOST)
         self._broken(ConnectionLost(f"conn {self.conn_id}: epoch limit reached"))
 
@@ -351,8 +396,13 @@ def integrity_check(msg: Message) -> bool:
         return True
     payload = msg.payload if msg.payload is not None else b""
     if len(payload) < msg.size:
+        _MET_DROP_LENGTH.inc()
         return False
     if len(payload) > msg.size:
         payload = payload[: msg.size]
         msg.payload = payload
-    return make_checksum(msg.conn_id, msg.seq_num, msg.size, payload) == msg.checksum
+    ok = make_checksum(msg.conn_id, msg.seq_num, msg.size,
+                       payload) == msg.checksum
+    if not ok:
+        _MET_DROP_CHECKSUM.inc()
+    return ok

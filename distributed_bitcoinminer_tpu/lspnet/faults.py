@@ -103,10 +103,20 @@ def partition_conn(conn_id: int, *, inbound: bool = True,
     would send to it. One flag = a one-sided partition (the LSP layer
     keeps heartbeating into the void, which is exactly the asymmetric
     failure the chaos suite wants)."""
-    if inbound:
+    opened = False
+    if inbound and conn_id not in knobs.partition_read:
         knobs.partition_read = knobs.partition_read | {conn_id}
-    if outbound:
+        opened = True
+    if outbound and conn_id not in knobs.partition_write:
         knobs.partition_write = knobs.partition_write | {conn_id}
+        opened = True
+    # Metrics plane: per-packet partition DROPS are counted in net.py;
+    # this counts partition EPISODES — only when a direction actually
+    # opens, so re-applying an existing partition doesn't make one long
+    # partition read as flapping in a snapshot.
+    if opened:
+        from ..utils.metrics import registry
+        registry().counter("net.partitions_opened").inc()
 
 
 def heal_conn(conn_id: int, *, inbound: bool = True,

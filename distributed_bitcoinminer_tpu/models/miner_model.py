@@ -27,8 +27,21 @@ from ..bitcoin.hash import MAX_U64
 from ..ops.search import search_span, search_span_until
 from ..ops.sha256_host import sha256_midstate
 from ..ops.sha256_jnp import build_hoist, build_tail_template
+from ..utils.metrics import registry as _registry
 
 _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
+
+# Model-layer metrics (utils/metrics.py): midstate/hoist cache behavior
+# (a miss pays the scalar hoist build; production traffic should be nearly
+# all hits), block dispatch counts, and pallas->jnp until-tier degradation
+# events — previously visible only as one log line and a bench field.
+_M = _registry()
+_MET_PLAN_HIT = _M.counter("model.midstate_cache", result="hit")
+_MET_PLAN_MISS = _M.counter("model.midstate_cache", result="miss")
+_MET_HOIST_ON = _M.counter("model.hoist_plans", enabled="true")
+_MET_HOIST_OFF = _M.counter("model.hoist_plans", enabled="false")
+_MET_BLOCKS = _M.counter("model.blocks_dispatched")
+_MET_DEGRADED = _M.counter("model.until_degraded")
 
 
 def default_tier() -> str:
@@ -122,6 +135,8 @@ class NonceSearcher:
         key = (top, k)
         cached = self._midstate_cache.get(key)
         if cached is None:
+            _MET_PLAN_MISS.inc()
+            (_MET_HOIST_ON if self.use_hoist else _MET_HOIST_OFF).inc()
             prefix = self._prefix + top.encode("ascii")
             midstate, tail = sha256_midstate(prefix)
             template = build_tail_template(tail, k, len(prefix) + k)
@@ -132,6 +147,9 @@ class NonceSearcher:
                      if self.use_hoist else None)
             cached = (midstate, template, len(tail), hoist)
             self._midstate_cache[key] = cached
+        else:
+            _MET_PLAN_HIT.inc()
+        _MET_BLOCKS.inc()
         midstate, template, rem, hoist = cached
         return _BlockPlan(
             base=block_base,
@@ -256,18 +274,20 @@ class NonceSearcher:
         """Exact (min_hash, argmin_nonce) over the inclusive range."""
         return self.finalize(self.dispatch(lower, upper), lower)
 
-    def _degrade_until(self) -> None:
+    def _degrade_until(self, what: str = "pallas until tier") -> None:
         """Sticky pallas->jnp until-tier degradation: a Mosaic lowering or
         runtime regression in the until kernel (its SMEM-flag skip is a
         newer construct than the battle-tested argmin kernel) must not
         take difficulty mode down with it — the jnp tier answers the
         identical contract. Sticky per searcher so one sub's failure
         doesn't retry the broken lowering for every sub of every later
-        block."""
+        block. ``what`` names the failing shape in the log (the sharded
+        model reuses this bookkeeping)."""
         import logging
         logging.getLogger("dbm.model").exception(
-            "pallas until tier failed; degrading this searcher "
-            "to the jnp until tier")
+            "%s failed; degrading this searcher to the jnp until tier",
+            what)
+        _MET_DEGRADED.inc()
         self._until_degraded = True
 
     def _until_sub(self, plan: _BlockPlan, i0: int, nbatches: int,

@@ -73,9 +73,5 @@ class ShardedNonceSearcher(NonceSearcher):
         except Exception:
             if tier != "pallas":
                 raise
-            import logging
-            logging.getLogger("dbm.model").exception(
-                "sharded pallas until tier failed; degrading this "
-                "searcher to the jnp until tier")
-            self._until_degraded = True
+            self._degrade_until("sharded pallas until tier")
             return self._until_sub(plan, i0, nbatches, t_hi, t_lo)

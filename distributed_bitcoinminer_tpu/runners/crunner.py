@@ -63,6 +63,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(normalize_go_flags(argv, parser))
     if args.v:
         lspnet.enable_debug_logs(True)
+    if args.metrics > 0:
+        from ..utils import configure_logging, ensure_emitter
+        # packet_trace echoes -v (configure_logging sets the lspnet trace
+        # switch to exactly its argument; the default would undo -v).
+        configure_logging(packet_trace=args.v)
+        ensure_emitter(args.metrics)
     try:
         asyncio.run(run_client(args))
     except KeyboardInterrupt:

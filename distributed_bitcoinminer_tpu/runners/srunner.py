@@ -40,6 +40,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    default=DEFAULT_MAX_BACKOFF_INTERVAL,
                    help="maximum interval epoch")
     p.add_argument("-v", action="store_true", help="show runner logs")
+    # Observability extension (no reference analog): start the in-process
+    # metrics emitter at this interval — one JSON snapshot line per period
+    # through the dbm.metrics logger (utils/metrics.py). 0 = off (default,
+    # keeping stock-harness stdout byte-compatible).
+    p.add_argument("--metrics", type=float, default=0.0, metavar="SECONDS",
+                   help="metrics snapshot interval in seconds (0 = off)")
     return p
 
 
@@ -102,6 +108,13 @@ def main(argv=None) -> int:
     args = parser.parse_args(normalize_go_flags(argv, parser))
     if args.v:
         lspnet.enable_debug_logs(True)
+    if args.metrics > 0:
+        from ..utils import configure_logging, ensure_emitter
+        # packet_trace must echo -v: configure_logging sets the lspnet
+        # trace switch to EXACTLY its argument, so the default (False)
+        # would silently undo the enable_debug_logs above.
+        configure_logging(packet_trace=args.v)
+        ensure_emitter(args.metrics)
     try:
         asyncio.run(run_server(args))
     except KeyboardInterrupt:

@@ -1,15 +1,21 @@
-"""Cross-cutting utilities: config, structured logging, profiling.
+"""Cross-cutting utilities: config, structured logging, profiling, metrics.
 
 The reference's auxiliary subsystems (SURVEY §5) map here: its opt-in debug
 logs (ref: lspnet/conn.go:32-42, srunner.go:33-37) become ``configure_logging``
 plus the lspnet per-packet trace switch; its file logger
 (ref: bitcoin/server/server.go:428-445) becomes the standard ``logging``
-setup; profiling adds JAX profiler hooks the reference never had.
+setup; profiling adds JAX profiler hooks the reference never had; and
+``metrics`` is the unified in-process registry + request-trace plane
+(counters/gauges/histograms/EWMAs + the periodic JSON-line emitter) that
+every layer — LSP engine, lspnet transport, scheduler, miner, model —
+reports into (ISSUE 3).
 """
 
 from .config import FrameworkConfig, from_env
 from .logging import configure_logging
+from .metrics import Registry, ensure_emitter, registry
 from .profiling import Timer, device_trace
 
 __all__ = ["FrameworkConfig", "from_env", "configure_logging",
+           "Registry", "ensure_emitter", "registry",
            "Timer", "device_trace"]

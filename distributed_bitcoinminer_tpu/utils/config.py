@@ -28,10 +28,30 @@ Environment variables:
   ``(data, lower, upper, target)`` -> Result memoization (bounded LRU):
   a retried/resubmitted request after a lost Result replays in O(1)
   instead of re-running the whole search (apps/scheduler.ResultCache).
-- ``DBM_QUEUE_ALARM_S``: age bound after which a still-queued request
-  emits a structured warning (rides the scheduler's sweep timer), so a
-  stalled queue — empty or fully-quarantined pool — is visible to an
-  operator instead of silent.
+- ``DBM_QUEUE_ALARM_S``: age bound after which a still-queued (or
+  still-in-flight) request emits a structured warning PLUS a full
+  request-trace dump (rides the scheduler's sweep timer), so a stalled
+  queue — empty or fully-quarantined pool, or a wedged in-flight request
+  — explains itself to an operator instead of staying silent.
+- ``DBM_LEASE_FIFO`` (0 disables): position-aware lease clocks — a chunk
+  queued behind other entries in a miner's pending FIFO starts its lease
+  when the miner actually reaches it, so deep FIFOs stop blowing leases
+  spuriously (``leases_blown_spurious`` counts the old failure mode when
+  this is off).
+- ``DBM_DESPERATION`` (0 disables): when the ENTIRE pool is quarantined,
+  dispatch a queued request to the least-bad available quarantined miner
+  as a last resort (``desperation_dispatch`` metric + structured warning)
+  instead of only alarming.
+- ``DBM_METRICS_INTERVAL_S``: period of the in-process metrics emitter
+  (utils/metrics.py) — one JSON snapshot line through the ``dbm.metrics``
+  logger per interval, plus a final atexit dump. Default 30; 0 disables
+  the emitter entirely (the registry still accumulates; ``bench.py``
+  embeds a snapshot either way).
+- ``DBM_METRICS_MAX_SERIES``: per-family label-cardinality bound of the
+  metrics registry (default 64; overflowing label sets collapse into one
+  ``{overflow="true"}`` series).
+- ``DBM_METRICS_TRACE_CAP``: how many request traces the scheduler
+  retains for ``Scheduler.trace(request_id)`` (default 256, LRU).
 - ``DBM_HOIST`` (0 disables): lane-invariant SHA-256 hoist (deep
   midstate + precombined schedule terms, ops/sha256_jnp.build_hoist).
 - ``DBM_UNTIL_PIPELINE`` (0 disables): difficulty-mode sub-dispatch
@@ -46,6 +66,7 @@ import platform
 from dataclasses import dataclass, field
 
 from ..lsp.params import Params
+from ._env import float_env as _float_env, int_env as _int_env
 
 #: Platform names that mean "a real chip" — the axon plugin's registered
 #: name is cwd-dependent in this image (axon vs tpu), and the miner's tier
@@ -183,7 +204,9 @@ class LeaseParams:
     tick_s: float = 1.0            # lease-check cadence
     quarantine_after: int = 3      # consecutive blown leases -> quarantine
     ewma_alpha: float = 0.3        # weight of the newest throughput sample
-    queue_alarm_s: float = 30.0    # queued-request age alarm bound
+    queue_alarm_s: float = 30.0    # queued/in-flight age alarm bound
+    fifo_aware: bool = True        # lease clock starts at FIFO head
+    desperation: bool = True       # all-quarantined last-resort dispatch
 
 
 @dataclass(frozen=True)
@@ -249,26 +272,6 @@ class FrameworkConfig:
         return default_searcher_factory(data, self.batch, tier=tier)
 
 
-def _int_env(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        return default
-
-
-def _float_env(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
-
-
 def lease_from_env() -> LeaseParams:
     d = LeaseParams()
     return LeaseParams(
@@ -279,6 +282,8 @@ def lease_from_env() -> LeaseParams:
         tick_s=_float_env("DBM_LEASE_TICK_S", d.tick_s),
         quarantine_after=_int_env("DBM_LEASE_QUARANTINE", d.quarantine_after),
         queue_alarm_s=_float_env("DBM_QUEUE_ALARM_S", d.queue_alarm_s),
+        fifo_aware=_int_env("DBM_LEASE_FIFO", 1) != 0,
+        desperation=_int_env("DBM_DESPERATION", 1) != 0,
     )
 
 

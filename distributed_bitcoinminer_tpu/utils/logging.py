@@ -10,11 +10,22 @@ same per-packet trace switch on the simulated transport.
 from __future__ import annotations
 
 import logging
+import os
 import sys
+import threading
 from typing import Optional
 
 _FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname).1s %(message)s"
 _DATEFMT = "%H:%M:%S"
+
+# Re-entrancy state: the handler THIS module installed and the sink it
+# points at. configure_logging used to clear the whole handler list and
+# re-add — two configuring components in one process (scheduler + miner in
+# a test, or a test harness wrapping an app main) raced each other's
+# clear/add and duplicated or dropped sinks; and a handler added by someone
+# else (pytest caplog, a user's extra sink) was silently destroyed.
+_state_lock = threading.Lock()
+_installed: dict = {"handler": None, "sink": None}
 
 
 def configure_logging(level: int = logging.INFO,
@@ -22,17 +33,30 @@ def configure_logging(level: int = logging.INFO,
                       packet_trace: bool = False) -> logging.Logger:
     """Set up the ``dbm`` logger tree; returns the root framework logger.
 
-    ``packet_trace`` also flips the lspnet per-packet DROP/DELAY trace (the
-    reference's EnableDebugLogs).
+    Idempotent and symmetric: calling it again with the same sink keeps the
+    existing handler (no clear/re-add race, no duplicate lines); calling it
+    with a different sink replaces only the handler this function
+    installed, leaving foreign handlers (test capture, extra user sinks)
+    alone. ``packet_trace`` sets the lspnet per-packet DROP/DELAY trace
+    (the reference's EnableDebugLogs) to EXACTLY the value given — False
+    now disables a previously-enabled trace instead of leaving it on.
     """
     logger = logging.getLogger("dbm")
-    logger.setLevel(level)
-    logger.handlers.clear()
-    handler = (logging.FileHandler(logfile) if logfile
-               else logging.StreamHandler(sys.stderr))
-    handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
-    logger.addHandler(handler)
-    if packet_trace:
-        from .. import lspnet
-        lspnet.enable_debug_logs(True)
+    sink = ("file", os.path.abspath(logfile)) if logfile else ("stderr",)
+    with _state_lock:
+        logger.setLevel(level)
+        prev = _installed["handler"]
+        if prev is None or _installed["sink"] != sink \
+                or prev not in logger.handlers:
+            if prev is not None and prev in logger.handlers:
+                logger.removeHandler(prev)
+                prev.close()
+            handler = (logging.FileHandler(logfile) if logfile
+                       else logging.StreamHandler(sys.stderr))
+            handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+            logger.addHandler(handler)
+            _installed["handler"] = handler
+            _installed["sink"] = sink
+    from .. import lspnet
+    lspnet.enable_debug_logs(bool(packet_trace))
     return logger

@@ -1,0 +1,479 @@
+"""Unit + end-to-end coverage for the unified metrics/trace plane (ISSUE 3).
+
+Three layers:
+
+1. Registry unit tests — thread-safety of every metric kind, histogram
+   bucket semantics, the label-cardinality bound, mounts, and the emitter.
+2. The snapshot guard — ``snapshot()`` must stay JSON-serializable and
+   stable-keyed, because ``bench.py`` embeds it in ``BENCH_*.json`` and
+   run-over-run diffs rely on a fixed key set.
+3. Scheduler integration — a deterministic scripted request/fault sequence
+   against the FakeServer harness asserting exact snapshot counters and
+   trace contents, plus a seeded wedge storm over real UDP asserting the
+   queue-age alarm's trace dump names the wedged miner and the speculative
+   re-issue that resolved the stall, and that every replied request's
+   trace is closed (span completeness).
+"""
+
+import asyncio
+import json
+import logging
+import threading
+
+from distributed_bitcoinminer_tpu.bitcoin.message import MsgType
+from distributed_bitcoinminer_tpu.utils.config import LeaseParams
+from distributed_bitcoinminer_tpu.utils.metrics import (
+    Emitter, Registry, RequestTrace, TraceBuffer, ensure_emitter,
+    registry as process_registry)
+
+from tests.test_scheduler_recovery import (CLIENT_X, CLIENT_Y, MINER_A,
+                                           MINER_B, MINER_C, FakeServer,
+                                           join, make_scheduler, request,
+                                           result)
+
+
+# ------------------------------------------------------------ registry units
+
+
+def test_counter_and_gauge_basics():
+    r = Registry()
+    c = r.counter("events")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert r.counter("events") is c          # same series, same object
+    g = r.gauge("depth")
+    g.set(3)
+    g.inc(2)
+    assert g.value == 5.0
+    labeled = r.counter("events", kind="x")
+    labeled.inc()
+    assert labeled is not c and labeled.value == 1
+
+
+def test_thread_safety_exact_totals():
+    """8 writers x 5000 increments must lose nothing — the miner updates
+    from worker threads while the asyncio loop updates from the event
+    loop, so '+=' without the registry lock would drop counts."""
+    r = Registry()
+    c = r.counter("hot")
+    h = r.histogram("lat", buckets=(0.5, 1.0))
+    e = r.ewma("rate")
+
+    def hammer():
+        for _ in range(5000):
+            c.inc()
+            h.observe(0.25)
+            e.observe(1.0)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 40_000
+    assert h.count == 40_000
+    assert h._snap()["counts"][0] == 40_000
+    assert e.value == 1.0
+
+
+def test_histogram_bucket_semantics():
+    r = Registry()
+    h = r.histogram("h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 3.0, 100.0):
+        h.observe(v)
+    snap = h._snap()
+    assert snap["le"] == [1.0, 2.0, 4.0]
+    # Cumulative: <=1 holds 0.5 and the boundary value 1.0; <=2 the same;
+    # <=4 adds 3.0; 100.0 only shows in the +Inf total.
+    assert snap["counts"] == [2, 2, 3]
+    assert snap["count"] == 4
+    assert abs(snap["sum"] - 104.5) < 1e-9
+
+
+def test_label_cardinality_bound_collapses_to_overflow():
+    r = Registry(max_series=4)
+    for i in range(10):
+        r.counter("conns", conn=str(i)).inc()
+    snap = r.snapshot()
+    series = [k for k in snap["counters"] if k.startswith("conns")]
+    assert len(series) == 5                      # 4 real + 1 overflow
+    assert "conns{overflow=true}" in series
+    # The 6 collapsed label sets all landed on the overflow series;
+    # series_overflow counts LOOKUPS routed there (one each here).
+    assert snap["counters"]["conns{overflow=true}"] == 6
+    assert snap["series_overflow"] == 6
+    r.counter("conns", conn="99").inc()          # another overflow lookup
+    assert r.snapshot()["series_overflow"] == 7
+
+
+def test_remove_frees_series_and_cardinality_slot():
+    """Dropping a labeled series (a dead miner's gauges) must take it out
+    of snapshots AND free its slot under the cardinality bound, so churn
+    of short-lived label values cannot exhaust a family."""
+    r = Registry(max_series=2)
+    r.gauge("rate", miner="1").set(10)
+    r.gauge("rate", miner="2").set(20)
+    r.remove("rate", miner="1")
+    assert "rate{miner=1}" not in r.snapshot()["gauges"]
+    r.gauge("rate", miner="3").set(30)       # reuses the freed slot
+    snap = r.snapshot()
+    assert snap["gauges"]["rate{miner=3}"] == 30
+    assert snap["series_overflow"] == 0
+    r.remove("rate", miner="nonexistent")    # no-op, no error
+
+
+def test_miner_drop_retires_labeled_gauges():
+    sched, _server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "churn", 199)
+    result(sched, MINER_A)
+    result(sched, MINER_B)
+    assert "miner_rate_nps{miner=1}" in sched.metrics.snapshot()["gauges"]
+    sched._on_drop(MINER_A)
+    gauges = sched.metrics.snapshot()["gauges"]
+    assert "miner_rate_nps{miner=1}" not in gauges
+    assert "miner_rate_nps{miner=2}" in gauges
+
+
+def test_ewma_moves_toward_samples():
+    r = Registry()
+    e = r.ewma("rate", tau_s=0.001)     # tiny tau: near-full weight per obs
+    e.observe(10.0)
+    assert e.value == 10.0
+    e.observe(10.0)
+    assert e.value == 10.0
+    e.observe(0.0)
+    assert 0.0 <= e.value < 10.0
+
+
+def test_snapshot_json_serializable_and_stable_keyed():
+    """The BENCH-diff guard (ISSUE 3 satellite): snapshots must round-trip
+    through JSON unchanged and keep an identical, sorted key set as values
+    evolve."""
+    r = Registry()
+    r.counter("a.count").inc()
+    r.counter("a.count", k="v").inc(2)
+    r.gauge("b.gauge").set(1.5)
+    r.histogram("c.hist").observe(0.2)
+    r.ewma("d.rate").observe(3.0)
+    snap1 = r.snapshot()
+    assert json.loads(json.dumps(snap1)) == snap1     # JSON-native only
+    for section in ("counters", "gauges", "histograms", "ewmas"):
+        keys = list(snap1[section])
+        assert keys == sorted(keys)
+    r.counter("a.count").inc(10)
+    r.histogram("c.hist").observe(5.0)
+    snap2 = r.snapshot()
+    for section in ("counters", "gauges", "histograms", "ewmas"):
+        assert list(snap1[section]) == list(snap2[section])  # stable keys
+    assert snap2["counters"]["a.count"] == 11
+    # The process registry (with the scheduler mounted by other tests)
+    # satisfies the same guard.
+    assert json.loads(json.dumps(process_registry().snapshot())) \
+        == process_registry().snapshot()
+
+
+def test_mount_prefixes_and_replaces():
+    parent, child1, child2 = Registry(), Registry(), Registry()
+    child1.counter("jobs").inc(3)
+    parent.mount("sub", child1)
+    snap = parent.snapshot()
+    assert snap["counters"]["sub.jobs"] == 3
+    child2.counter("jobs").inc(7)
+    parent.mount("sub", child2)                  # latest mount wins
+    assert parent.snapshot()["counters"]["sub.jobs"] == 7
+
+
+def test_emitter_logs_json_lines(caplog):
+    r = Registry()
+    r.counter("ticks").inc()
+    logger = logging.getLogger("test.dbm.metrics.emitter")
+    em = Emitter(r, interval_s=0.02, logger=logger)
+    with caplog.at_level(logging.INFO, logger=logger.name):
+        em.start()
+        em._stop.wait(0.2)
+        em.stop()           # emits the final line
+    docs = []
+    for rec in caplog.records:
+        try:
+            docs.append(json.loads(rec.getMessage()))
+        except ValueError:
+            pass
+    assert docs, "no JSON metric lines emitted"
+    assert all(d["event"] == "metrics" for d in docs)
+    assert docs[-1]["final"] is True
+    assert docs[-1]["snapshot"]["counters"]["ticks"] == 1
+
+
+def test_ensure_emitter_is_idempotent_and_zero_disables():
+    assert ensure_emitter(0) is None
+    assert ensure_emitter(-1) is None
+    em1 = ensure_emitter(600.0)
+    em2 = ensure_emitter(600.0)
+    assert em1 is not None and em1 is em2
+
+
+# --------------------------------------------------------------- trace units
+
+
+def test_trace_events_closure_and_dict():
+    t = RequestTrace(data="x", client=7)
+    t.event("enqueue", queue_depth=0)
+    assert not t.closed
+    t.event("reply", nonce=5)
+    assert t.closed
+    d = t.to_dict()
+    assert json.loads(json.dumps(d)) == d
+    assert [e["event"] for e in d["events"]] == ["enqueue", "reply"]
+    assert d["meta"]["client"] == 7
+    assert d["events"][0]["t"] <= d["events"][1]["t"]
+
+
+def test_trace_buffer_lru_bound():
+    buf = TraceBuffer(cap=2)
+    for i in range(4):
+        tr = buf.new(i=i)
+        tr.event("reply")
+        buf.register(i, tr)
+    assert len(buf) == 2
+    assert buf.get(0) is None and buf.get(1) is None
+    assert buf.get(3) is not None
+
+
+def test_trace_buffer_pins_open_traces_against_bursts():
+    """A burst of short-lived closed traces (the cache-replay retry-storm
+    shape) must evict closed entries, never the live in-flight request's
+    still-open trace — the record the alarm dump exists to preserve."""
+    buf = TraceBuffer(cap=3)
+    live = buf.new(job=1)
+    live.event("dispatch")            # open: no terminal event yet
+    buf.register(1, live)
+    for i in range(10):               # 10 cache replays churn through
+        tr = buf.new(i=i)
+        tr.event("reply")
+        buf.register(f"cache:{i}", tr)
+    assert buf.get(1) is live         # survived the burst
+    assert len(buf) == 3
+
+
+def test_trace_event_cap_counts_drops_but_still_closes():
+    t = RequestTrace()
+    for _ in range(RequestTrace.MAX_EVENTS + 10):
+        t.event("tick")
+    assert len(t.events) == RequestTrace.MAX_EVENTS
+    assert t.to_dict()["events_dropped"] == 10
+    # Terminal events bypass the cap: an event-flooded trace must still
+    # close when the request finally replies.
+    t.event("reply", nonce=1)
+    assert t.closed
+    assert t.events[-1]["event"] == "reply"
+
+
+# -------------------------------------------- scheduler snapshot (scripted)
+
+
+def test_scheduler_snapshot_after_scripted_fault_sequence():
+    """Deterministic end-to-end: a scripted request/fault sequence (lease
+    blow -> re-issue -> duplicate -> cache replay) must land EXACTLY these
+    numbers in the scheduler's registry snapshot, and the same values must
+    be visible through the process registry mount."""
+    sched, server = make_scheduler(grace_s=30.0, quarantine_after=1)
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    join(sched, MINER_C)
+    request(sched, CLIENT_X, "scripted", 299)          # 3 chunks
+    a = sched._find_miner(MINER_A)
+    stuck = a.pending[0]
+    result(sched, MINER_C, h=50, nonce=7)              # C frees
+    stuck.deadline = 0.0                               # force A's expiry
+    sched._check_leases()                              # blow + reissue to C
+    result(sched, MINER_C, h=40, nonce=2)              # the copy answers
+    result(sched, MINER_A, h=40, nonce=2)              # loser: duplicate
+    result(sched, MINER_B, h=60, nonce=9)              # barrier completes
+    request(sched, CLIENT_Y, "scripted", 299)          # identical: memo hit
+    snap = sched.metrics.snapshot()
+    c = snap["counters"]
+    assert c["results_sent"] == 2
+    assert c["leases_blown"] == 1
+    assert c["leases_blown_spurious"] == 0
+    assert c["reissues"] == 1
+    assert c["dup_results"] == 1
+    assert c["quarantines"] == 1
+    assert c["cache_hits"] == 1
+    assert c["cache_stores"] == 1
+    # Exactly ONE miss: the fresh request at enqueue. The dispatch-time
+    # re-check of the same key is not double-counted, so the hit ratio
+    # reflects distinct lookups (1 hit / 2 lookups = 0.5 here).
+    assert c["cache_misses"] == 1
+    assert c["desperation_dispatch"] == 0
+    assert snap["gauges"]["queue_depth"] == 0
+    assert snap["gauges"]["pool_size"] == 3
+    assert snap["histograms"]["queue_wait_s"]["count"] == 1
+    assert 0.0 < snap["gauges"]["cache_hit_ratio"] < 1.0
+    assert json.loads(json.dumps(snap)) == snap
+    # Mounted view: the process snapshot carries the same series under
+    # the "sched." prefix (this scheduler is the latest mount).
+    proc = process_registry().snapshot()
+    assert proc["counters"]["sched.results_sent"] == 2
+    assert proc["counters"]["sched.reissues"] == 1
+
+    # Trace plane: job 1's span is complete and explains the fault.
+    t = sched.trace(1)
+    assert t is not None and t.closed
+    events = t.to_dict()["events"]
+    names = [e["event"] for e in events]
+    assert names[0] == "enqueue" and names[-1] == "reply"
+    assert "dispatch" in names and "merge" in names
+    blow = next(e for e in events if e["event"] == "lease_blown")
+    assert blow["miner"] == MINER_A and blow["spurious"] is False
+    reissue = next(e for e in events if e["event"] == "reissue")
+    assert reissue["from_miner"] == MINER_A
+    assert reissue["to_miner"] == MINER_C
+    dup = [e for e in events if e["event"] == "result"
+           and e.get("duplicate")]
+    assert len(dup) == 1 and dup[0]["miner"] == MINER_A
+    # The memo replay is traced too, under its synthetic key.
+    ct = sched.trace("cache:1")
+    assert ct is not None and ct.closed
+    assert [e["event"] for e in ct.to_dict()["events"]] == \
+        ["enqueue", "cache_hit", "reply"]
+
+
+def test_dispatch_time_cache_replay_keeps_real_trace_history():
+    """A retry that queued behind its in-flight original and replays from
+    the memo at dispatch must complete its OWN trace (real enqueue stamp,
+    real queue wait observed) — not a synthetic zero-age stand-in."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "dup race", 99)   # in flight
+    request(sched, CLIENT_Y, "dup race", 99)   # queued duplicate
+    waited_before = sched.metrics.histogram("queue_wait_s").count
+    result(sched, MINER_A, h=5, nonce=2)       # finish + store + pop queue
+    assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
+    ct = sched.trace("cache:1")
+    assert ct is not None and ct.closed
+    events = [e["event"] for e in ct.to_dict()["events"]]
+    assert events == ["enqueue", "cache_hit", "reply"]
+    assert ct.to_dict()["meta"]["client"] == CLIENT_Y  # the real request
+    # The queue wait it actually served was observed (the original's was
+    # already recorded at its own dispatch, before the snapshot above).
+    assert sched.metrics.histogram("queue_wait_s").count == \
+        waited_before + 1
+
+
+def test_queue_age_alarm_dumps_traces(caplog):
+    """A stalled queued request's alarm must dump its own trace AND the
+    in-flight request's trace (the usual culprit), as parseable JSON."""
+    sched, _server = make_scheduler(queue_alarm_s=5.0)
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "in flight", 99)
+    request(sched, CLIENT_Y, "stuck behind", 199)
+    sched.queue[0].queued_at -= 100.0
+    sched.current.started -= 100.0
+    with caplog.at_level(logging.WARNING, logger="dbm.scheduler"):
+        sched._check_queue_age()
+    assert sched.stats["queue_alarms"] == 1
+    assert sched.stats["inflight_alarms"] == 1
+    dumps = [r.getMessage() for r in caplog.records
+             if "trace dump" in r.getMessage()]
+    # Stalled request + in-flight request, each dumped exactly ONCE: the
+    # "in flight ahead of the stalled one" dump is suppressed when the
+    # in-flight alarm dumps the identical document in the same sweep.
+    assert len(dumps) == 2
+    parsed = [json.loads(d[d.index("{"):]) for d in dumps]
+    # The in-flight trace names the miner holding the pool.
+    flight = next(p for p in parsed
+                  if any(e["event"] == "assign" for e in p["events"]))
+    assign = next(e for e in flight["events"] if e["event"] == "assign")
+    assert assign["miner"] == MINER_A
+
+
+# ------------------------------------------------- chaos e2e (real UDP pool)
+
+
+def test_chaos_wedge_alarm_trace_names_culprit_and_rescue():
+    """ISSUE 3 acceptance: a scripted wedge storm produces a queue-age
+    alarm whose dumped trace names the wedged miner and the speculative
+    re-issue that resolved it; every replied request's span is closed."""
+    from tests.test_chaos import ChaosCluster, expected
+    from distributed_bitcoinminer_tpu.apps.client import submit
+
+    lease = LeaseParams(grace_s=0.6, factor=4.0, floor_s=0.3, tick_s=0.05,
+                        quarantine_after=3, ewma_alpha=0.5,
+                        queue_alarm_s=0.2)
+
+    async def scenario():
+        async with ChaosCluster(lease=lease) as c:
+            wedged = await c.add_miner("wedged")
+            await c.add_miner("healthy")
+            wedged_conn = wedged.conn_id
+            wedged.wedge()                    # compute hangs; LSP lives
+            t1 = asyncio.create_task(
+                submit(c.hostport, "stall one", 799, c.params))
+            await asyncio.sleep(0.1)          # t1 is in flight first
+            t2 = asyncio.create_task(
+                submit(c.hostport, "stall two", 399, c.params))
+            r1 = await asyncio.wait_for(t1, 30)
+            r2 = await asyncio.wait_for(t2, 30)
+            assert r1 == expected("stall one", 799)
+            assert r2 == expected("stall two", 399)
+            s = c.scheduler
+            # The stall was loud: t2 sat behind the wedged request past
+            # the 0.2s bound while the lease (0.6s grace) ran down.
+            assert s.stats["queue_alarms"] + s.stats["inflight_alarms"] \
+                >= 1
+            # The dumped/retrievable trace explains the stall: the wedged
+            # miner blew the lease and the re-issue rescued the chunk.
+            events = s.trace(1).to_dict()["events"]
+            blows = [e for e in events if e["event"] == "lease_blown"]
+            assert any(e["miner"] == wedged_conn for e in blows)
+            reissues = [e for e in events if e["event"] == "reissue"]
+            assert any(e["from_miner"] == wedged_conn for e in reissues)
+            assert events[-1]["event"] == "reply"
+            # Span completeness: every replied request's trace is closed
+            # (the wedged job's late duplicate never reopens it).
+            for _key, tr in s.traces.items():
+                assert tr.closed, f"unclosed trace {_key}"
+            wedged.unwedge()
+            assert await c.settle()
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------- configure_logging bugfix
+
+
+def test_configure_logging_idempotent_and_symmetric(tmp_path):
+    """ISSUE 3 satellite: re-configuration must not clear/re-add handlers
+    (duplicate or dropped lines), must leave foreign handlers alone, and
+    packet_trace=False must disable a previously-enabled trace."""
+    from distributed_bitcoinminer_tpu.utils import logging as dbm_logging
+    from distributed_bitcoinminer_tpu.lspnet.faults import knobs
+
+    logger = logging.getLogger("dbm")
+    before = list(logger.handlers)
+    try:
+        lg = dbm_logging.configure_logging(packet_trace=True)
+        assert lg is logger and knobs.debug
+        ours = dbm_logging._installed["handler"]
+        n = len(logger.handlers)
+        lg2 = dbm_logging.configure_logging(packet_trace=False)
+        assert lg2 is logger
+        assert len(logger.handlers) == n                  # no duplicates
+        assert dbm_logging._installed["handler"] is ours  # same handler
+        assert not knobs.debug                            # symmetric off
+        foreign = logging.NullHandler()
+        logger.addHandler(foreign)
+        dbm_logging.configure_logging(logfile=str(tmp_path / "dbm.log"))
+        assert foreign in logger.handlers        # foreign sink untouched
+        assert dbm_logging._installed["handler"] is not ours  # ours swapped
+        assert len(logger.handlers) == n + 1
+    finally:
+        ours = dbm_logging._installed["handler"]
+        if ours is not None:
+            logger.removeHandler(ours)
+            ours.close()
+        dbm_logging._installed["handler"] = None
+        dbm_logging._installed["sink"] = None
+        logger.handlers = before

@@ -11,7 +11,7 @@ These are the recovery paths the lease machinery extends (ISSUE 1 satellite):
 regressions here historically hid behind timing luck in the e2e tests.
 """
 
-from distributed_bitcoinminer_tpu.apps.scheduler import (Request,
+from distributed_bitcoinminer_tpu.apps.scheduler import (Chunk, Request,
                                                          ResultCache,
                                                          Scheduler)
 from distributed_bitcoinminer_tpu.bitcoin.hash import MAX_U64
@@ -333,8 +333,10 @@ def test_cache_disabled_knob():
 
 def test_no_eligible_miner_latches_once_per_episode():
     """A dispatch pass that finds queued work but an empty (or fully
-    quarantined) pool must say so — once per starvation episode, not per
-    event — and clear when the pool recovers."""
+    quarantined-and-busy) pool must say so — once per starvation episode,
+    not per event — and clear when the pool recovers. (A fully quarantined
+    pool with an AVAILABLE miner no longer starves: desperation dispatch
+    takes over — see the dedicated tests below.)"""
     sched, server = make_scheduler()
     request(sched, CLIENT_X, "starved", 99)        # no miners at all
     assert sched.stats["no_eligible_miner"] == 1
@@ -346,10 +348,14 @@ def test_no_eligible_miner_latches_once_per_episode():
     result(sched, MINER_A)
     assert len(server.sent_to(CLIENT_X, MsgType.RESULT)) == 1
     assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
-    # A fresh starvation episode (fully-quarantined pool) latches again.
-    sched._find_miner(MINER_A).quarantined = True
+    # A fresh starvation episode: the whole pool is quarantined AND busy
+    # (a live chunk still pending), so even desperation has no taker.
+    a = sched._find_miner(MINER_A)
+    a.quarantined = True
+    a.pending.append(Chunk(job_id=999, data="wedged", lower=0, upper=9))
     request(sched, CLIENT_X, "starved again", 99)
     assert sched.stats["no_eligible_miner"] == 2
+    assert sched.stats["desperation_dispatch"] == 0
 
 
 def test_queue_age_alarm_fires_once_per_bound_interval():
@@ -387,3 +393,149 @@ def test_result_cache_replays_at_dispatch_time_too():
     assert sched.stats["cache_hits"] == 1
     assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 1  # no re-run
     assert sched.queue == [] and sched.current is None
+
+
+# ------------------------------------------------- ISSUE 3 scheduling planes
+
+
+def test_desperation_dispatch_to_least_bad_quarantined():
+    """When the ENTIRE pool is quarantined, a queued request goes to the
+    least-bad available quarantined miner (lowest blown streak) as a last
+    resort instead of stalling forever (ROADMAP open item)."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    a, b = sched._find_miner(MINER_A), sched._find_miner(MINER_B)
+    a.quarantined, a.blown_streak = True, 5
+    b.quarantined, b.blown_streak = True, 2
+    request(sched, CLIENT_X, "last resort", 99)
+    assert sched.stats["desperation_dispatch"] == 1
+    assert sched.stats["no_eligible_miner"] == 0
+    assert sched.current is not None and sched.current.num_chunks == 1
+    # Only the least-bad miner (B: shorter blown streak) got the work.
+    assert server.sent_to(MINER_A, MsgType.REQUEST) == []
+    assert len(server.sent_to(MINER_B, MsgType.REQUEST)) == 1
+    result(sched, MINER_B, h=5, nonce=2)       # answer lifts B's quarantine
+    assert not b.quarantined
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(5, 2)]
+
+
+def test_desperation_disabled_knob_keeps_starvation_latch():
+    sched, server = make_scheduler(desperation=False)
+    join(sched, MINER_A)
+    sched._find_miner(MINER_A).quarantined = True
+    request(sched, CLIENT_X, "still starved", 99)
+    assert sched.stats["desperation_dispatch"] == 0
+    assert sched.stats["no_eligible_miner"] == 1
+    assert sched.current is None and len(sched.queue) == 1
+    assert server.sent_to(MINER_A, MsgType.REQUEST) == []
+
+
+def test_desperation_requires_whole_pool_quarantined():
+    """A single healthy-but-busy miner disables desperation: waiting for
+    it to free beats feeding a known-bad quarantined miner."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    sched._find_miner(MINER_A).quarantined = True
+    b = sched._find_miner(MINER_B)
+    b.pending.append(Chunk(job_id=999, data="busy", lower=0, upper=9))
+    request(sched, CLIENT_X, "patience", 99)
+    assert sched.stats["desperation_dispatch"] == 0
+    assert sched.current is None and len(sched.queue) == 1
+    assert server.sent_to(MINER_A, MsgType.REQUEST) == []
+
+
+def test_fifo_aware_lease_budgets_predecessors_then_tightens_at_head():
+    """Position-aware deadline (ROADMAP open item): a chunk assigned
+    BEHIND a cancelled-but-still-computing FIFO entry gets a deadline
+    budgeting the predecessor's remaining lease plus its own — no
+    spurious blow while the miner grinds the entry ahead — and the clock
+    re-stamps to the tight single-chunk lease when it reaches the head."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "doomed", 99)
+    ahead = sched.miners[0].pending[0]
+    sched._on_drop(CLIENT_X)               # cancelled; A still grinding it
+    request(sched, CLIENT_Y, "queued behind", 199)
+    live = sched.miners[0].pending[1]
+    # Budgeted, not started: expiry covers the predecessor's lease too.
+    assert not live.lease_started
+    assert live.deadline > ahead.deadline
+    sched._check_leases()                  # inside the budget: no blow
+    assert sched.stats["leases_blown"] == 0
+    assert sched.stats["leases_blown_spurious"] == 0
+    result(sched, MINER_A)                 # stale pop: A reaches the chunk
+    assert live.lease_started and live.deadline > 0.0
+    result(sched, MINER_A, h=9, nonce=5)   # answers the live chunk
+    replies = server.sent_to(CLIENT_Y, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(9, 5)]
+    t = sched.trace(2)
+    assert t is not None and t.closed
+
+
+def test_fifo_aware_wedged_head_still_expires_deep_chunk():
+    """The budget must RUN OUT when the FIFO head is wedged — a deferred
+    chunk is never exempt from speculation forever (the flaw a pure
+    start-at-head clock would have)."""
+    sched, server = make_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "doomed", 99)
+    sched._on_drop(CLIENT_X)               # A grinding a cancelled entry
+    join(sched, MINER_B)                   # B joins clean
+    request(sched, CLIENT_Y, "stuck deep", 199)
+    live = next(c for c in sched.miners[0].pending if not c.cancelled)
+    result(sched, MINER_B)                 # B frees: an eligible takeover
+    live.deadline = 0.0                    # the whole budget elapsed
+    sched._check_leases()
+    assert sched.stats["leases_blown"] == 1
+    assert sched.stats["leases_blown_spurious"] == 0   # justified, not noise
+    assert sched.stats["reissues"] == 1    # rescued despite never starting
+    result(sched, MINER_B, h=3, nonce=1)   # the re-issued copy answers
+    assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
+
+
+def test_at_assignment_clock_blows_spuriously_and_is_counted():
+    """The pre-fix behavior (fifo_aware=False) is preserved behind the
+    knob, and its failure mode — a lease blowing while the miner had not
+    even reached the chunk — is counted in ``leases_blown_spurious``: the
+    before/after evidence for the position-aware fix."""
+    sched, server = make_scheduler(fifo_aware=False)
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "doomed", 99)
+    sched._on_drop(CLIENT_X)
+    request(sched, CLIENT_Y, "queued behind", 199)
+    live = sched.miners[0].pending[1]
+    assert live.lease_started               # old behavior: clock at assign
+    live.deadline = 0.0                     # force expiry while queued deep
+    sched._check_leases()
+    assert sched.stats["leases_blown"] == 1
+    assert sched.stats["leases_blown_spurious"] == 1
+    # The request still completes (speculation is idempotent; answering
+    # resets the streak) — the spurious blow was noise, now measured.
+    result(sched, MINER_A)
+    result(sched, MINER_A, h=9, nonce=5)
+    assert len(server.sent_to(CLIENT_Y, MsgType.RESULT)) == 1
+
+
+def test_inflight_age_alarm_fires_once_per_interval():
+    sched, _server = make_scheduler(queue_alarm_s=5.0)
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "wedged in flight", 99)
+    curr = sched.current
+    sched._check_queue_age()                 # too young: silent
+    assert sched.stats["inflight_alarms"] == 0
+    curr.started -= 100.0                    # age it past the bound
+    sched._check_queue_age()
+    assert sched.stats["inflight_alarms"] == 1
+    sched._check_queue_age()                 # within the re-warn window
+    assert sched.stats["inflight_alarms"] == 1
+    curr.last_inflight_alarm -= 100.0
+    sched._check_queue_age()
+    assert sched.stats["inflight_alarms"] == 2
+    # The queue-age stamp is independent: a queue alarm before dispatch
+    # must not delay the first in-flight alarm (they use separate stamps).
+    assert curr.last_alarm == 0.0
+    events = [e["event"] for e in curr.trace.to_dict()["events"]]
+    assert events.count("inflight_alarm") == 2
