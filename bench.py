@@ -80,6 +80,142 @@ def _measure_overlapped(searcher, lower: int, upper: int, reps: int,
     return count * reps / t.seconds
 
 
+def _pipeline_probe(data: str, lower: int, count: int, batch: int,
+                    reps: int = 3) -> dict:
+    """END-TO-END dispatch-pipeline before/after (ISSUE 4): a real
+    scheduler + one jnp-tier miner over localhost LSP serve ``reps``
+    requests of the EXACT bench geometry (raw ranged Requests — the
+    ``submit`` helper pins ``Lower`` to 0, which would drag in every
+    small digit class and its compile signatures).
+
+    Three legs, miner-side pipeline being the measured knob:
+
+    - ``on_nps``   — striping (default depth) + pipelined miner;
+    - ``off_nps``  — same striping, ``DBM_PIPELINE=0`` serial miner (the
+      acceptance comparison: identical chunk plan, overlap removed);
+    - ``stock_nps`` — striping AND pipeline off (the pre-ISSUE-4 shape,
+      for context).
+
+    Striping is forced deterministic (tiny ``chunk_s`` -> the depth cap
+    splits every request into ``depth`` equal chunks) so all legs see an
+    identical, small compile-signature set; two warm requests per leg —
+    the first on a cold pool (never striped), the second striped — pay
+    every XLA signature outside the timed window. Leases are relaxed so
+    first-run compiles cannot blow a lease mid-probe and re-issue chunks
+    into the timed window.
+
+    Noise discipline: the bench box's background load swings a single
+    leg's rate by ±25% — more than the overlap win itself on a 2-core
+    container (compute and serialize share the same cores, so only the
+    true idle windows — LSP latency, asyncio gaps, result fetch — are
+    hideable; the ~1.8x chip gap collapses to single digits here). The
+    on and off legs are therefore INTERLEAVED over
+    ``DBM_BENCH_PIPELINE_ROUNDS`` rounds (default 6) with the in-round
+    order swapped each round (kills order bias), and each side reports
+    its MEDIAN round. Median, not best-of: the container's cgroup
+    cpu-shares make the noise two-sided (a leg can burst above its fair
+    share on an idle host just as easily as lose cycles to a neighbor),
+    so max() measures the luckiest burst, and one outlier leg flips the
+    sign of the comparison — observed live while building this. The
+    per-round samples ride the artifact for auditability.
+    """
+    import asyncio
+
+    from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                              MsgType,
+                                                              new_request)
+    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+    from distributed_bitcoinminer_tpu.lsp.params import Params
+    from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+    from distributed_bitcoinminer_tpu.models import NonceSearcher
+    from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
+                                                           LeaseParams,
+                                                           StripeParams)
+    from distributed_bitcoinminer_tpu.utils.metrics import registry
+
+    params = Params(epoch_limit=30, epoch_millis=500, window_size=32,
+                    max_backoff_interval=2)
+    depth = StripeParams().depth
+
+    async def leg(pipeline: bool, stripe: bool) -> float:
+        server = await new_async_server(0, params)
+        sched = Scheduler(
+            server,
+            cache=CacheParams(enabled=False),   # reps repeat the same key
+            lease=LeaseParams(grace_s=120.0, floor_s=30.0,
+                              queue_alarm_s=0.0),
+            stripe=StripeParams(enabled=stripe, chunk_s=0.001,
+                                depth=depth))
+        sched_task = asyncio.create_task(sched.run())
+        worker = MinerWorker(
+            f"127.0.0.1:{server.port}", params=params,
+            searcher_factory=lambda d, b: NonceSearcher(
+                d, batch=batch, tier="jnp"),
+            pipeline=pipeline)
+        await worker.join()
+        worker_task = asyncio.create_task(worker.run())
+        client = await new_async_client(f"127.0.0.1:{server.port}", params)
+        try:
+            async def ask():
+                client.write(
+                    new_request(data, lower, lower + count - 1).to_json())
+                while True:
+                    m = Message.from_json(await client.read())
+                    if m.type == MsgType.RESULT:
+                        return m
+            for _ in range(2):
+                await asyncio.wait_for(ask(), 600)
+            t0 = time.time()
+            for _ in range(reps):
+                await asyncio.wait_for(ask(), 600)
+            return count * reps / (time.time() - t0)
+        finally:
+            await client.close()
+            worker_task.cancel()
+            sched_task.cancel()
+            await worker.close()
+            await server.close()
+
+    rounds = max(1, int(os.environ.get("DBM_BENCH_PIPELINE_ROUNDS", "6")))
+    on_samples, off_samples = [], []
+    # Stock legs BRACKET the rounds (one before, one after, median-of-2):
+    # a single un-interleaved sample would re-import the exact +-25%
+    # noise exposure the interleaving exists to kill.
+    stock_samples = [asyncio.run(leg(False, False))]
+    snap = {}
+    for rnd in range(rounds):
+        order = (True, False) if rnd % 2 == 0 else (False, True)
+        for pipelined in order:
+            (on_samples if pipelined else off_samples).append(
+                asyncio.run(leg(pipelined, True)))
+            if pipelined and not snap:
+                # Occupancy/overlap gauges of the FIRST pipelined leg
+                # (each leg's worker overwrites the process-registry
+                # gauges).
+                snap = registry().snapshot().get("gauges", {})
+    stock_samples.append(asyncio.run(leg(False, False)))
+    from statistics import median
+    on_nps, off_nps = median(on_samples), median(off_samples)
+    stock_nps = median(stock_samples)
+    return {
+        "on_nps": round(on_nps, 1),
+        "off_nps": round(off_nps, 1),
+        "stock_nps": round(stock_nps, 1),
+        "gain": round(on_nps / off_nps - 1, 4),
+        "gain_vs_stock": round(on_nps / stock_nps - 1, 4),
+        "on_samples": [round(x, 1) for x in on_samples],
+        "off_samples": [round(x, 1) for x in off_samples],
+        "stock_samples": [round(x, 1) for x in stock_samples],
+        "occupancy": snap.get("miner.pipeline_occupancy"),
+        "overlap_ratio": snap.get("miner.pipeline_overlap_ratio"),
+        "stripe_depth": depth,
+        "requests": reps,
+        "range": count,
+    }
+
+
 def main() -> int:
     from distributed_bitcoinminer_tpu.utils.config import probe_backend
     from distributed_bitcoinminer_tpu.utils.metrics import ensure_emitter
@@ -88,7 +224,15 @@ def main() -> int:
     # final registry snapshot is embedded in the artifact either way.
     ensure_emitter()
     init_deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
-    probe = probe_backend(init_deadline, _REPO)
+    if os.environ.get("DBM_BENCH_PROBE", "1") == "0":
+        # Probe opt-out (ISSUE 4 satellite): trust JAX_PLATFORMS as-is —
+        # chip-less boxes pin cpu and stop paying the init deadline (and
+        # the artifact stops carrying the recurring probe error).
+        probe = {"skipped": True}
+    else:
+        # probe_backend memoizes per process, so the miner workers the
+        # pipeline probe spawns below never re-pay the deadline.
+        probe = probe_backend(init_deadline, _REPO)
     force_cpu = "error" in probe
 
     if force_cpu:
@@ -310,6 +454,20 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             sweep_detail = {"rem_sweep_error": repr(exc)[:200]}
 
+    # Dispatch-pipeline e2e before/after (ISSUE 4): scheduler striping +
+    # miner pipeline vs the stock even-split serial loop, through real
+    # localhost LSP at the bench geometry. CPU-only (the on-chip 2^29
+    # geometry would cost minutes per leg) and isolated like the other
+    # auxiliary measurements; DBM_BENCH_PIPELINE=0 skips it.
+    pipeline_detail = {}
+    if not on_accel and "jnp" in results \
+            and os.environ.get("DBM_BENCH_PIPELINE", "1") != "0":
+        try:
+            pipeline_detail = {"pipeline": _pipeline_probe(
+                data, lower, count, batch)}
+        except Exception as exc:  # noqa: BLE001
+            pipeline_detail = {"pipeline": {"error": repr(exc)[:300]}}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
@@ -338,6 +496,7 @@ def main() -> int:
                        if "overlapped_rate" in r},
         **until_detail,
         **sweep_detail,
+        **pipeline_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
         # (midstate cache behavior, until-tier degradations) stay

@@ -1,12 +1,29 @@
 """The TPU miner worker: an LSP client wrapped around the device search.
 
 Replaces the reference worker's scalar hot loop (ref: bitcoin/miner/miner.go)
-with the chunk-scheduled JAX program from ``models``: Join, then loop
-{read Request -> device arg-min search -> write Result}, exiting silently on
-transport errors exactly like the reference (miner.go:40-44, 63-66).
+with the chunk-scheduled JAX program from ``models``: Join, then serve
+Requests, exiting silently on transport errors exactly like the reference
+(miner.go:40-44, 63-66).
 
-The device search runs in a worker thread so the asyncio loop keeps serving
-LSP heartbeats/acks while the TPU is busy; JAX dispatch is thread-safe.
+Two serving shapes (``DBM_PIPELINE``, default on):
+
+- **Pipelined** (ISSUE 4): a reader task lands incoming Requests in a
+  bounded local queue while a compute executor overlaps chunk k+1's device
+  DISPATCH with chunk k's result force + JSON serialize + LSP write — the
+  dispatch/finalize split the model layer already exposes (the identical
+  dispatch measured 420M nonces/s on chip where finalize-blocking ran
+  229M), fed by the scheduler's request striping (``DBM_STRIPE``) which
+  keeps the FIFO deep enough to overlap. Results are written strictly in
+  request order, so the scheduler's in-order FIFO pop contract — and
+  therefore every merge rule — is untouched. Difficulty-target chunks and
+  searchers without the dispatch/finalize split degrade to the blocking
+  shape per chunk, still in order.
+- **Serial** (``DBM_PIPELINE=0``): the stock read -> blocking search ->
+  write loop, preserved verbatim for Go-parity conformance and replay.
+
+Either way the compute runs in worker threads so the asyncio loop keeps
+serving LSP heartbeats/acks while the device is busy; JAX dispatch is
+thread-safe.
 
 Bound parity: the received ``Upper`` is treated as INCLUSIVE even though the
 scheduler computed it as an exclusive end — the reference miner does the same
@@ -26,7 +43,9 @@ from ..bitcoin.message import Message, MsgType, new_join, new_result
 from ..lsp.client import AsyncClient, new_async_client
 from ..lsp.errors import LspError
 from ..lsp.params import Params
-from ..utils.metrics import ensure_emitter, registry as _registry
+from ..utils._env import int_env as _int_env
+from ..utils.metrics import (OCCUPANCY_BUCKETS, ensure_emitter,
+                             registry as _registry)
 
 logger = logging.getLogger("dbm.miner")
 
@@ -39,17 +58,92 @@ _MET_NONCES = _M.counter("miner.nonces_scanned")
 _MET_CHUNKS = _M.counter("miner.chunks_served")
 _MET_RATE = _M.ewma("miner.nonces_per_s", tau_s=30.0)
 _MET_FAILURES = _M.counter("miner.search_failures")
+# Dispatch-pipeline plane (ISSUE 4): local queue depth at executor pickup,
+# busy-time fraction of the worker's life, and the overlap ratio (what
+# fraction of summed chunk time was hidden under another chunk).
+_MET_QDEPTH = _M.histogram("miner.dispatch_queue_depth", OCCUPANCY_BUCKETS)
+_MET_OCCUPANCY = _M.gauge("miner.pipeline_occupancy")
+_MET_OVERLAP = _M.gauge("miner.pipeline_overlap_ratio")
+_MET_TWO_PHASE = _M.counter("miner.chunks_two_phase")
+
+
+class _ThroughputWindow:
+    """Windowed wall-clock nonces/s accounting, overlap-safe (ISSUE 4
+    satellite).
+
+    The old per-chunk ``scanned / elapsed`` EWMA double-counted wall clock
+    under the dispatch pipeline: chunk k+1's elapsed window overlaps chunk
+    k's, so per-chunk rates summed to more throughput than the wall clock
+    delivered — and the scheduler's lease EWMA (fed indirectly by result
+    pacing) would have sized leases off an inflated figure. This
+    accumulator instead UNIONS the chunk intervals ``[t0, t1]``
+    (completions arrive in FIFO order with nondecreasing t0, so the union
+    is a single frontier sweep) and observes ``nonces / busy_union`` once
+    at least ``min_window_s`` of busy time has accumulated. Serial
+    execution degenerates to the old numbers (union == sum); overlapped
+    execution reports true wall-clock throughput. Difficulty chunks are
+    excluded exactly as before: their in-kernel early exit makes
+    ``scanned`` an upper bound.
+    """
+
+    def __init__(self, ewma=_MET_RATE, min_window_s: float = 0.5):
+        self._ewma = ewma
+        self._min_window_s = min_window_s
+        self._born: Optional[float] = None   # first chunk's t0
+        self._frontier = 0.0                 # union sweep frontier
+        self._busy_s = 0.0                   # lifetime union of intervals
+        self._sum_s = 0.0                    # lifetime sum of durations
+        self._win_busy = 0.0
+        self._win_nonces = 0
+
+    def observe(self, t0: float, t1: float, scanned: int) -> None:
+        if self._born is None:
+            self._born = t0
+            self._frontier = t0
+        busy = max(0.0, t1 - max(t0, self._frontier))
+        self._frontier = max(self._frontier, t1)
+        self._busy_s += busy
+        self._sum_s += max(0.0, t1 - t0)
+        if self._sum_s > 0.0:
+            _MET_OVERLAP.set(1.0 - self._busy_s / self._sum_s)
+        _MET_OCCUPANCY.set(
+            self._busy_s / max(time.monotonic() - self._born, 1e-9))
+        self._win_busy += busy
+        self._win_nonces += scanned
+        if self._win_busy >= self._min_window_s:
+            self._ewma.observe(self._win_nonces / self._win_busy)
+            self._win_busy, self._win_nonces = 0.0, 0
 
 
 class HostSearcher:
     """Device-free fallback: the native C++ scan (SHA-NI where the CPU has
     it, all cores for large ranges), or the pure-Python oracle when no
     toolchain is present. ``threads``: 0 = auto, 1 = single-threaded,
-    N = pinned worker count."""
+    N = pinned worker count.
+
+    Exposes the same two-phase ``dispatch``/``finalize`` shape as the
+    device searchers (ISSUE 4): ``dispatch`` starts the native scan on a
+    dedicated worker thread and returns immediately, ``finalize`` joins
+    it — so the host compute tier pipelines through the miner executor
+    exactly like the device tiers (the scan of chunk k+1 overlaps chunk
+    k's serialize + LSP write; the native scan manages its own core
+    fan-out, so one extra in-flight scan only deepens the OS scheduler's
+    queue, it does not over-subscribe a pinned ``threads`` count).
+    """
 
     def __init__(self, data: str, threads: int = 0):
         self.data = data
         self.threads = threads
+        self._pool = None
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            # 2 workers: one scan finishing while the next starts — the
+            # same double-buffer depth as the device-tier pipeline.
+            self._pool = ThreadPoolExecutor(max_workers=2,
+                                            thread_name_prefix="host-scan")
+        return self._pool
 
     def search(self, lower: int, upper: int):
         from .. import native
@@ -60,6 +154,17 @@ class HostSearcher:
         from .. import native
         return native.scan_until_native(self.data, lower, upper, target,
                                         threads=self.threads)
+
+    def dispatch(self, lower: int, upper: int):
+        """Start the scan without blocking; returns a handle for
+        :meth:`finalize` (same contract as NonceSearcher.dispatch)."""
+        if lower > upper:
+            raise ValueError("empty range")
+        return self._executor().submit(self.search, lower, upper)
+
+    def finalize(self, handle, lower: int):
+        """Join a dispatched scan -> exact (min_hash, argmin_nonce)."""
+        return handle.result()
 
 
 def default_searcher_factory(data: str, batch: Optional[int] = None,
@@ -101,7 +206,9 @@ class MinerWorker:
 
     def __init__(self, hostport: str, params: Optional[Params] = None,
                  searcher_factory: Callable = default_searcher_factory,
-                 batch: Optional[int] = None):
+                 batch: Optional[int] = None,
+                 pipeline: Optional[bool] = None,
+                 pipeline_depth: Optional[int] = None):
         self.hostport = hostport
         self.params = params
         self.searcher_factory = searcher_factory
@@ -109,6 +216,15 @@ class MinerWorker:
         self._searchers: OrderedDict[str, object] = OrderedDict()
         self.client: Optional[AsyncClient] = None
         self.jobs_done = 0
+        # Dispatch pipeline (ISSUE 4): env-defaulted like the scheduler's
+        # stripe knob so the tier-1 DBM_PIPELINE=0 matrix leg exercises
+        # the stock serial loop through every existing harness.
+        self.pipeline = (pipeline if pipeline is not None
+                         else _int_env("DBM_PIPELINE", 1) != 0)
+        self.pipeline_depth = max(1, pipeline_depth if pipeline_depth
+                                  is not None
+                                  else _int_env("DBM_PIPELINE_DEPTH", 8))
+        self._window = _ThroughputWindow()
         ensure_emitter()   # DBM_METRICS_INTERVAL_S-driven; 0 = no-op
 
     async def join(self) -> None:
@@ -117,9 +233,19 @@ class MinerWorker:
         self.client.write(new_join().to_json())
 
     async def run(self) -> None:
-        """Serve Requests until the connection dies (silent exit, like ref)."""
+        """Serve Requests until the connection dies (silent exit, like
+        ref). ``DBM_PIPELINE`` selects the overlapped executor; 0 the
+        stock serial loop."""
         if self.client is None:
             await self.join()
+        if self.pipeline:
+            await self._run_pipelined()
+        else:
+            await self._run_serial()
+
+    async def _run_serial(self) -> None:
+        """The stock loop: read Request -> blocking search -> write Result
+        (Go-parity path, preserved verbatim under ``DBM_PIPELINE=0``)."""
         while True:
             try:
                 payload = await self.client.read()
@@ -131,45 +257,225 @@ class MinerWorker:
                 continue
             if msg.type != MsgType.REQUEST:
                 continue
-            # Compute off-loop so LSP heartbeats keep flowing mid-search.
-            t0 = time.monotonic()
-            try:
-                best_hash, best_nonce, echo_target = await asyncio.to_thread(
-                    self._search, msg.data, msg.lower, msg.upper, msg.target)
-            except Exception:
-                _MET_FAILURES.inc()
-                # A broken worker must LEAVE the pool — exit so the
-                # scheduler declares the connection lost and reassigns
-                # this exact chunk (ref: the Go miner exits silently on
-                # any failure, miner.go:44-50; recovery = chunk
-                # re-execution, SURVEY §3.4). Round 3 replaced the old
-                # answer-with-sentinel behavior here: a fabricated
-                # (MAX_U64, 0) Result is indistinguishable from a real
-                # empty scan and handed single-miner clients garbage (the
-                # e2e caught exactly that when the device backend failed
-                # to init in the miner process).
-                logger.exception("search failed for %r [%d, %d]; exiting",
-                                 msg.data, msg.lower, msg.upper)
-                await self.client.close()
+            if not await self._serve_blocking(msg):
                 return
-            elapsed = max(time.monotonic() - t0, 1e-9)
-            _MET_CHUNK_S.observe(elapsed)
-            _MET_CHUNKS.inc()
-            if msg.upper >= msg.lower:
-                # Upper is read inclusive (reference bound quirk). A
-                # difficulty early-exit may scan less than `scanned`, so
-                # the EWMA is an upper bound there — same caveat as the
-                # scheduler-side lease EWMA, which excludes target chunks.
-                scanned = msg.upper - msg.lower + 1
-                _MET_NONCES.inc(scanned)
-                if not msg.target:
-                    _MET_RATE.observe(scanned / elapsed)
-            try:
-                self.client.write(
-                    new_result(best_hash, best_nonce, echo_target).to_json())
-            except LspError:
-                return
-            self.jobs_done += 1
+
+    async def _run_pipelined(self) -> None:
+        """Overlapped executor: a reader task lands Requests in a bounded
+        queue; this loop dispatches chunk k+1's device work BEFORE forcing
+        chunk k's results, then writes Results strictly in request order.
+
+        The overlap window is two concurrent worker threads per loop
+        body: the next chunk's dispatch (async device enqueue — or a full
+        jit trace+compile on a cold signature) runs as its own task WHILE
+        the previous chunk's finalize (force + serialize + LSP write)
+        proceeds, so a multi-second compile can never hold an
+        already-computed Result hostage past its head-of-FIFO lease
+        (chunk sizes drift with the rate EWMA, so fresh signatures happen
+        in steady state, not just on new data). The Result write still
+        lands before the next chunk enters finalize — strictly in request
+        order. Chunks that cannot split into dispatch/finalize —
+        difficulty targets (their early-exit pipelining lives inside
+        search_until), inverted ranges, searchers without the two-phase
+        API — drain the in-flight chunk first and run blocking, which
+        keeps every Result in FIFO order.
+
+        Searcher RESOLUTION also happens on the dispatch worker thread,
+        never on the event loop: a cache-miss construction triggers JAX
+        backend init, which a wedged accelerator tunnel can hang for
+        minutes (see utils/config._pin_platform_if_backend_wedged) — on
+        the loop that would starve LSP heartbeats and get this miner
+        declared dead mid-init (the serial loop has always resolved
+        inside ``asyncio.to_thread`` via ``_search`` for the same
+        reason).
+        """
+        queue: asyncio.Queue = asyncio.Queue(
+            maxsize=max(1, self.pipeline_depth))
+        _STOP = object()
+        client = self.client
+
+        async def reader():
+            while True:
+                try:
+                    payload = await client.read()
+                except LspError:
+                    await queue.put(_STOP)
+                    return
+                try:
+                    msg = Message.from_json(payload)
+                except ValueError:
+                    continue
+                if msg.type != MsgType.REQUEST:
+                    continue
+                # A full queue backpressures here; the LSP engine keeps
+                # acking/heartbeating underneath regardless.
+                await queue.put(msg)
+
+        reader_task = asyncio.create_task(reader())
+        _IDLE = object()
+        inflight = None     # (msg, searcher, handle, t0) awaiting finalize
+        try:
+            while True:
+                if inflight is None:
+                    msg = await queue.get()
+                else:
+                    try:
+                        msg = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        msg = _IDLE
+                if msg is _STOP:
+                    return   # transport died; nothing can be written
+                if msg is not _IDLE:
+                    _MET_QDEPTH.observe(queue.qsize())
+                # Start the new chunk's dispatch on its own worker thread
+                # BEFORE draining the previous chunk — this concurrency
+                # is the overlap window, and it also means a dispatch
+                # stuck in jit trace+compile (fresh signature) cannot
+                # delay the in-flight chunk's Result write.
+                dtask = t0 = None
+                if msg is not _IDLE and msg.target == 0 \
+                        and msg.lower <= msg.upper:
+                    t0 = time.monotonic()
+                    dtask = asyncio.create_task(asyncio.to_thread(
+                        self._resolve_and_dispatch, msg))
+                if inflight is not None:
+                    if not await self._finalize_and_reply(*inflight):
+                        if dtask is not None:
+                            # Transport died with a dispatch possibly
+                            # mid-compile on its thread: reap it quietly
+                            # (the thread itself cannot be interrupted).
+                            dtask.cancel()
+                            dtask.add_done_callback(
+                                lambda t: t.cancelled() or t.exception())
+                        return
+                    inflight = None
+                if msg is _IDLE:
+                    continue
+                if dtask is not None:
+                    try:
+                        searcher, handle, dispatch_s = await dtask
+                    except Exception:
+                        await self._exit_broken(msg)
+                        return
+                    if handle is not None:
+                        inflight = (msg, searcher, handle, t0, dispatch_s)
+                        _MET_TWO_PHASE.inc()
+                    elif not await self._serve_blocking(msg):
+                        return   # no two-phase API: degraded, in order
+                elif not await self._serve_blocking(msg):
+                    return
+        finally:
+            reader_task.cancel()
+
+    def _resolve_and_dispatch(self, msg):
+        """Worker-thread half of a two-phase chunk: resolve the searcher
+        — possibly CONSTRUCTING it, which on first touch runs JAX backend
+        init and must therefore never happen on the event loop — and
+        start its dispatch. Returns ``(searcher, handle, dispatch_s)``;
+        ``handle`` is None when the searcher lacks the two-phase API
+        (caller degrades to the blocking path, which finds the searcher
+        cached). ``dispatch_s`` is the dispatch phase's own elapsed time,
+        so the chunk-latency histogram can report busy time (dispatch +
+        finalize) rather than wall time — a pipelined chunk's wall span
+        includes head-of-line wait behind the previous chunk's
+        finalize+write, which would read as a latency regression in
+        BENCH artifact diffs whenever the knob toggles."""
+        t0 = time.monotonic()
+        searcher = self._get_searcher(msg.data)
+        if hasattr(searcher, "dispatch") and hasattr(searcher, "finalize"):
+            handle = searcher.dispatch(msg.lower, msg.upper)
+            return searcher, handle, time.monotonic() - t0
+        return searcher, None, 0.0
+
+    async def _finalize_and_reply(self, msg, searcher, handle, t0: float,
+                                  dispatch_s: float) -> bool:
+        """Force a dispatched chunk's results and write its Result; False
+        ends the serve loop (transport death or broken compute)."""
+        t2 = time.monotonic()
+        try:
+            best_hash, best_nonce = await asyncio.to_thread(
+                searcher.finalize, handle, msg.lower)
+        except Exception:
+            await self._exit_broken(msg)
+            return False
+        busy_s = dispatch_s + (time.monotonic() - t2)
+        return self._reply(msg, best_hash, best_nonce, 0, t0,
+                           busy_s=busy_s)
+
+    async def _serve_blocking(self, msg) -> bool:
+        """One chunk through the stock blocking search; False ends the
+        serve loop. Shared by the serial loop and the pipelined
+        executor's degraded (target / no-two-phase-API) path."""
+        # Compute off-loop so LSP heartbeats keep flowing mid-search.
+        t0 = time.monotonic()
+        try:
+            best_hash, best_nonce, echo_target = await asyncio.to_thread(
+                self._search, msg.data, msg.lower, msg.upper, msg.target)
+        except Exception:
+            # A broken worker must LEAVE the pool — exit so the
+            # scheduler declares the connection lost and reassigns
+            # this exact chunk (ref: the Go miner exits silently on
+            # any failure, miner.go:44-50; recovery = chunk
+            # re-execution, SURVEY §3.4). Round 3 replaced the old
+            # answer-with-sentinel behavior here: a fabricated
+            # (MAX_U64, 0) Result is indistinguishable from a real
+            # empty scan and handed single-miner clients garbage (the
+            # e2e caught exactly that when the device backend failed
+            # to init in the miner process).
+            await self._exit_broken(msg)
+            return False
+        return self._reply(msg, best_hash, best_nonce, echo_target, t0)
+
+    async def _exit_broken(self, msg) -> None:
+        """Compute-failure exit path (must be called from an except
+        block: it logs the active exception)."""
+        _MET_FAILURES.inc()
+        logger.exception("search failed for %r [%d, %d]; exiting",
+                         msg.data, msg.lower, msg.upper)
+        await self.client.close()
+
+    def _reply(self, msg, best_hash: int, best_nonce: int,
+               echo_target: int, t0: float,
+               busy_s: Optional[float] = None) -> bool:
+        """Per-chunk accounting + in-order Result write; False on
+        transport death. ``busy_s`` (pipelined two-phase chunks) keeps
+        the chunk-latency histogram on compute time — dispatch +
+        finalize, excluding head-of-line wait — so its semantics match
+        the serial path's; the throughput window still gets the wall
+        interval ``[t0, t1]`` (its union sweep subtracts overlap
+        itself)."""
+        t1 = time.monotonic()
+        _MET_CHUNK_S.observe(max(busy_s if busy_s is not None
+                                 else t1 - t0, 1e-9))
+        _MET_CHUNKS.inc()
+        if msg.upper >= msg.lower:
+            # Upper is read inclusive (reference bound quirk). A
+            # difficulty early-exit may scan less than `scanned`, so
+            # difficulty chunks are excluded from the throughput window —
+            # same caveat as the scheduler-side lease EWMA.
+            scanned = msg.upper - msg.lower + 1
+            _MET_NONCES.inc(scanned)
+            if not msg.target:
+                self._window.observe(t0, t1, scanned)
+        try:
+            self.client.write(
+                new_result(best_hash, best_nonce, echo_target).to_json())
+        except LspError:
+            return False
+        self.jobs_done += 1
+        return True
+
+    def _get_searcher(self, data: str):
+        """Per-message searcher from the LRU cache (builds on miss)."""
+        searcher = self._searchers.get(data)
+        if searcher is None:
+            searcher = self.searcher_factory(data, self.batch)
+            self._searchers[data] = searcher
+            while len(self._searchers) > self.SEARCHER_CACHE_SIZE:
+                self._searchers.popitem(last=False)
+        else:
+            self._searchers.move_to_end(data)
+        return searcher
 
     def _search(self, data: str, lower: int, upper: int,
                 target: int = 0) -> tuple[int, int, int]:
@@ -183,14 +489,7 @@ class MinerWorker:
             # it reports (maxUint, 0) (ref: miner.go:46-59); match that
             # instead of letting the searcher raise.
             return (MAX_U64, 0, 0)
-        searcher = self._searchers.get(data)
-        if searcher is None:
-            searcher = self.searcher_factory(data, self.batch)
-            self._searchers[data] = searcher
-            while len(self._searchers) > self.SEARCHER_CACHE_SIZE:
-                self._searchers.popitem(last=False)
-        else:
-            self._searchers.move_to_end(data)
+        searcher = self._get_searcher(data)
         if target:
             # Difficulty-target Request (wire extension, message.py): run
             # the early-exiting search. The Result carries the qualifying

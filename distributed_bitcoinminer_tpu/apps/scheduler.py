@@ -14,6 +14,18 @@ channel-coupled goroutines:
   bounds but the miner treats ``Upper`` as inclusive (ref: miner.go:51-52),
   so each chunk scans one extra nonce and the system as a whole scans
   ``[0, maxNonce+1]``.
+- Request striping (ISSUE 4, ``DBM_STRIPE``; no reference analog): each
+  miner's even-split share may be subdivided into up to
+  ``StripeParams.depth`` contiguous chunks sized at
+  ``StripeParams.chunk_s`` seconds of work from its throughput EWMA, so
+  the miner's pending FIFO is deep enough for its dispatch pipeline
+  (``DBM_PIPELINE``, apps/miner.py) to overlap chunk k+1's device work
+  with chunk k's result fetch/serialize — and a blown lease or dead miner
+  forfeits one stripe chunk, not the whole share. Chunk indices still
+  ascend with nonce range globally and boundaries stay contiguous, so the
+  merge rules below (strict-less arg-min, difficulty prefix release) are
+  untouched; a cold pool (no EWMA yet) or ``DBM_STRIPE=0`` reproduces the
+  reference one-chunk-per-miner split bit-for-bit.
 - Result merge: strict ``<`` on the uint64 hash; barrier releases the Result
   to the client when every chunk of the request has been answered
   (ref: server.go:257-325).
@@ -121,9 +133,10 @@ from ..bitcoin.hash import MAX_U64
 from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
-from ..utils.config import CacheParams, LeaseParams
-from ..utils.metrics import (LATENCY_BUCKETS_S, Registry, RequestTrace,
-                             TraceBuffer, ensure_emitter,
+from ..utils.config import CacheParams, LeaseParams, StripeParams, \
+    stripe_from_env
+from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS, Registry,
+                             RequestTrace, TraceBuffer, ensure_emitter,
                              registry as process_registry)
 
 logger = logging.getLogger("dbm.scheduler")
@@ -134,7 +147,7 @@ STAT_COUNTERS = (
     "results_sent", "dup_results", "leases_blown", "reissues",
     "quarantines", "cache_hits", "cache_misses", "cache_stores",
     "queue_alarms", "inflight_alarms", "no_eligible_miner",
-    "desperation_dispatch", "leases_blown_spurious",
+    "desperation_dispatch", "leases_blown_spurious", "chunks_striped",
 )
 
 
@@ -279,10 +292,15 @@ class Scheduler:
 
     def __init__(self, server: AsyncServer,
                  lease: Optional[LeaseParams] = None,
-                 cache: Optional[CacheParams] = None):
+                 cache: Optional[CacheParams] = None,
+                 stripe: Optional[StripeParams] = None):
         self.server = server
         self.lease = lease if lease is not None else LeaseParams()
         self.cache = cache if cache is not None else CacheParams()
+        # Env-defaulted (unlike lease/cache) so the tier-1 knob-off matrix
+        # leg (DBM_STRIPE=0) exercises the Go-parity split through every
+        # existing harness without threading a parameter into each test.
+        self.stripe = stripe if stripe is not None else stripe_from_env()
         self.results: Optional[ResultCache] = (
             ResultCache(self.cache.size) if self.cache.enabled else None)
         self.miners: list[MinerState] = []      # join order, like minersArray
@@ -316,6 +334,9 @@ class Scheduler:
                                                   LATENCY_BUCKETS_S)
         self._lease_wait = self.metrics.histogram("lease_wait_s",
                                                   LATENCY_BUCKETS_S)
+        # Striping plane (dispatch pipeline): chunks per miner share.
+        self._stripe_depth = self.metrics.histogram("stripe_chunks_per_share",
+                                                    OCCUPANCY_BUCKETS)
         self.traces = TraceBuffer()
         self._cache_trace_seq = 0
 
@@ -786,16 +807,51 @@ class Scheduler:
         leftover = total - individual * num
         if individual == 0:  # more miners than nonces
             individual, leftover, num = 1, 0, total
-        request.num_chunks = num
-        request.answered = [False] * num
+        # Striping (dispatch pipeline, ISSUE 4): each miner's even-split
+        # share may be cut into several contiguous chunks so its pending
+        # FIFO is deep enough for the miner-side pipeline to overlap.
+        # The full chunk plan is built FIRST — chunk indices must ascend
+        # with nonce range globally (the difficulty prefix-release merge
+        # depends on it) and ``answered`` must be sized before the first
+        # assignment records a trace event against it.
+        plan: list[tuple[MinerState, int, int]] = []
         start = request.lower
         for i in range(num):
             end = start + individual + (leftover if i == 0 else 0)
-            self._assign_chunk(
-                pool[i],
-                Chunk(request.job_id, request.data, start, end,
-                      target=request.target, idx=i))
+            share = end - start
+            n_i = self._stripe_chunks(pool[i], share)
+            self._stripe_depth.observe(n_i)
+            base = start
+            for j in range(n_i):
+                size = share // n_i + (1 if j < share % n_i else 0)
+                plan.append((pool[i], base, base + size))
+                base += size
             start = end
+        if len(plan) > num:
+            self._count("chunks_striped", len(plan) - num)
+        request.num_chunks = len(plan)
+        request.answered = [False] * len(plan)
+        for idx, (miner, lo, up) in enumerate(plan):
+            self._assign_chunk(
+                miner,
+                Chunk(request.job_id, request.data, lo, up,
+                      target=request.target, idx=idx))
+
+    def _stripe_chunks(self, miner: MinerState, share: int) -> int:
+        """Chunk count for one miner's share: ``ceil(share / (rate *
+        chunk_s))`` capped at ``stripe.depth``. 1 (the stock even split)
+        when striping is off, the share is trivial, or no throughput has
+        been observed yet — a cold pool's first request is always
+        bit-identical to the reference split, so the parity/conformance
+        shape needs no knob to reproduce."""
+        if not self.stripe.enabled or share <= 1:
+            return 1
+        rate = miner.rate_ewma if miner.rate_ewma is not None \
+            else self._pool_rate
+        if rate is None or rate <= 0:
+            return 1
+        target = max(1, int(rate * self.stripe.chunk_s))
+        return max(1, min(self.stripe.depth, -(-share // target)))
 
     def _assign_chunk(self, miner: MinerState, chunk: Chunk,
                       kind: str = "initial") -> None:

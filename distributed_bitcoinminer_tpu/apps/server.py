@@ -12,16 +12,17 @@ import sys
 
 from ..lsp.params import Params
 from ..lsp.server import new_async_server
-from ..utils.config import CacheParams, LeaseParams
+from ..utils.config import CacheParams, LeaseParams, StripeParams
 from .scheduler import Scheduler
 
 
 async def serve(port: int, params: Params | None = None,
                 lease: LeaseParams | None = None,
-                cache: CacheParams | None = None) -> None:
+                cache: CacheParams | None = None,
+                stripe: StripeParams | None = None) -> None:
     server = await new_async_server(port, params or Params())
     print("Server listening on port", server.port, flush=True)
-    scheduler = Scheduler(server, lease=lease, cache=cache)
+    scheduler = Scheduler(server, lease=lease, cache=cache, stripe=stripe)
     try:
         await scheduler.run()
     finally:
@@ -44,7 +45,8 @@ def main(argv: list[str] | None = None) -> int:
     ensure_emitter()
     cfg = from_env()
     try:
-        asyncio.run(serve(port, cfg.params, cfg.lease, cfg.cache))
+        asyncio.run(serve(port, cfg.params, cfg.lease, cfg.cache,
+                          cfg.stripe))
     except KeyboardInterrupt:
         pass
     return 0

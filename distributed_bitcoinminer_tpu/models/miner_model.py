@@ -238,7 +238,10 @@ class NonceSearcher:
         enqueue several ranges back-to-back and keep the device busy while
         earlier results transfer — the host<->device overlap knob
         (SURVEY §7 "double-buffer chunks"; bench measures it automatically
-        whenever a searcher exposes dispatch/finalize).
+        whenever a searcher exposes dispatch/finalize). As of ISSUE 4 the
+        production consumer is the miner's pipelined executor
+        (apps/miner.MinerWorker, ``DBM_PIPELINE``), which dispatches chunk
+        k+1 here while chunk k sits in :meth:`finalize`.
         """
         if lower > upper:
             raise ValueError("empty range")

@@ -22,6 +22,15 @@ class ShardedNonceSearcher(NonceSearcher):
 
     ``batch`` is the per-device lane count per step; the per-block work is
     ``n_devices * batch * nbatches`` lanes.
+
+    The two-phase ``dispatch``/``finalize`` split (the miner pipeline's
+    contract, ISSUE 4) is inherited from :class:`NonceSearcher` verbatim:
+    ``dispatch`` routes through this class's ``search_block`` override, so
+    each handle is a replicated ``shard_map`` triple that ``finalize``'s
+    single batched ``device_get`` forces exactly like the single-device
+    tier — a pipelined miner overlaps whole-mesh dispatches the same way
+    it overlaps single-device ones (pinned by
+    tests/test_pipeline.py::test_sharded_dispatch_finalize_equivalence).
     """
 
     def __init__(self, data: str, batch: int = 1 << 20, mesh=None,

@@ -157,16 +157,25 @@ _K64 = np.asarray(SHA256_K, dtype=np.uint32)
 _TAPS = ((("w", -16), ("s0", -15), ("w", -7), ("s1", -2)))
 
 
-def hoist_structure(rem: int, k: int, nblocks: int):
+def hoist_structure(rem: int, k: int, nblocks: int, static_rounds: int = 32):
     """Static constancy analysis of the tail blocks.
 
     Returns one ``(varying_words, var_taps, full_const)`` triple per
     block: the initial window words carrying digit bytes, and — for
-    rounds 16..31 — the subset of each round's schedule taps that is
-    lane-varying (the constant rest is folded into the host-built
-    ``cw`` operand). ``full_const`` marks a digit-free block whose
-    entire schedule hoists (see ``build_hoist``).
+    rounds 16..``static_rounds``-1 — the subset of each round's schedule
+    taps that is lane-varying (the constant rest is folded into the
+    host-built ``cw`` operand). ``full_const`` marks a digit-free block
+    whose entire schedule hoists (see ``build_hoist``).
+
+    ``static_rounds`` widens the static window past the default 32 (the
+    ``DBM_HOIST_DEEP`` experiment: for large ``rem`` a few taps — e.g.
+    rem=60: w16/w18/w20 — stay constant past round 31, which only an
+    extended static window can exploit); must be a multiple of 16 so the
+    rolled remainder starts on a 16-round block boundary. The pallas peel
+    kernel always analyses at the default 32 — its chip-validated SMEM
+    layout fixes 16 ``cw`` scalars per block.
     """
+    assert static_rounds % 16 == 0 and 32 <= static_rounds <= 64
     pos = digit_positions(rem, k)
     blocks = []
     for b in range(nblocks):
@@ -176,7 +185,7 @@ def hoist_structure(rem: int, k: int, nblocks: int):
             continue
         var = [w in varying for w in range(16)]
         taps = []
-        for t in range(16, 32):
+        for t in range(16, static_rounds):
             tv = tuple((kind, t + off) for kind, off in _TAPS
                        if var[t + off])
             var.append(bool(tv))
@@ -202,7 +211,8 @@ class HoistPlan:
     ops: dict                   #: jit operands: deep/kw/cw (+ckw)
 
 
-def build_hoist(midstate, template: np.ndarray, rem: int, k: int) -> HoistPlan:
+def build_hoist(midstate, template: np.ndarray, rem: int, k: int,
+                deep_window: bool | None = None) -> HoistPlan:
     """Precompute the hoist operands for one (midstate, template) pair.
 
     ``ops`` holds: ``deep`` (8,) — the round state after the first
@@ -212,15 +222,41 @@ def build_hoist(midstate, template: np.ndarray, rem: int, k: int) -> HoistPlan:
     ``cw`` (nblocks, 16) — the constant part of each expanded word
     w[16..31]; ``ckw`` (64,) — full K+W precombination of the one
     fully-constant block, when present.
+
+    ``deep_window`` extends the static schedule window to rounds 16..47:
+    the constant terms of w[32..47] ride an extra ``cw2`` (nblocks, 16)
+    operand that only the jnp tier consumes (``compress_tail_hoisted``
+    keys its structure analysis off the operand's presence; the pallas
+    peel layout ignores unknown keys and keeps its 16-scalar-per-block
+    ``cw`` section). Default: ``DBM_HOIST_DEEP`` when set, else ON for
+    CPU backends and OFF on chip. The measured verdict (ROADMAP "hoist
+    rounds 32+", ISSUE 4 satellite) is lopsided per platform: on XLA:CPU
+    the residual constant taps are a rounding error but the widened
+    static window leaves only ONE rolled 16-round iteration, which XLA
+    inlines into a straight-line 64-round chain that vectorizes ~5x
+    faster than the rolled carry (rem=60: 1.25M -> 7.08M nps; rem=7:
+    2.40M -> 12.19M at the bench geometry, bit-identical results) — while
+    on TPU the same unrolling is the known-catastrophic live-chain spill
+    from round 1 (BASELINE.md), so the chip default stays rolled.
     """
     from .sha256_host import compress_rounds, schedule_words, sigma0, sigma1
 
+    import os
+    if deep_window is None:
+        env = os.environ.get("DBM_HOIST_DEEP", "")
+        if env:
+            deep_window = env == "1"
+        else:
+            from ..utils.config import CHIP_PLATFORMS, jax_devices_robust
+            deep_window = (jax_devices_robust()[0].platform
+                           not in CHIP_PLATFORMS)
+    static_rounds = 48 if deep_window else 32
     nblocks = int(template.shape[0])
-    struct = hoist_structure(rem, k, nblocks)
+    struct = hoist_structure(rem, k, nblocks, static_rounds)
     wd0 = struct[0][0][0]   # first digit word of block 0 == rem // 4
     deep = compress_rounds(midstate, [int(x) for x in template[0]], 0, wd0)
     kw = np.zeros((nblocks, 16), dtype=np.uint32)
-    cw = np.zeros((nblocks, 16), dtype=np.uint32)
+    cw = np.zeros((nblocks, static_rounds - 16), dtype=np.uint32)
     ckw = None
     terms = 0
     for b, (varying, taps, full) in enumerate(struct):
@@ -232,7 +268,7 @@ def build_hoist(midstate, template: np.ndarray, rem: int, k: int) -> HoistPlan:
             terms += 4 * 48   # every tap of every expanded word
             continue
         kw[b] = [(SHA256_K[j] + words[j]) & _M32 for j in range(16)]
-        vals: list = words + [None] * 16
+        vals: list = words + [None] * (static_rounds - 16)
         for i, tv in enumerate(taps):
             t = 16 + i
             acc = 0
@@ -246,7 +282,10 @@ def build_hoist(midstate, template: np.ndarray, rem: int, k: int) -> HoistPlan:
             cw[b, i] = acc & _M32
             if not tv:
                 vals[t] = int(cw[b, i])
-    ops = {"deep": np.asarray(deep, dtype=np.uint32), "kw": kw, "cw": cw}
+    ops = {"deep": np.asarray(deep, dtype=np.uint32), "kw": kw,
+           "cw": cw[:, :16]}
+    if static_rounds > 32:
+        ops["cw2"] = cw[:, 16:]
     if ckw is not None:
         ops["ckw"] = ckw
     return HoistPlan(wd0=wd0, nblocks=nblocks,
@@ -295,9 +334,11 @@ def _compress_block_hoisted(ff, entry, wd, varying, taps, contribs, tw,
     ``entry`` the round state the device enters at round ``wd`` (block
     0: the host-extended deep midstate; later blocks: ``ff`` itself with
     ``wd == 0``). Rounds wd..15 run schedule-free off the precombined
-    ``kwv``; rounds 16..31 are static with only the varying taps
-    computed per lane (constant terms ride ``cwv``); rounds 32..63 stay
-    rolled — by then the window is carried as full tiles either way.
+    ``kwv``; rounds 16..15+len(taps) are static with only the varying
+    taps computed per lane (constant terms ride ``cwv`` — 16 entries for
+    the default window, 32 under ``DBM_HOIST_DEEP``); the remaining
+    rounds stay rolled — by then the window is carried as full tiles
+    either way.
     """
     st = tuple(entry)
     for j in range(wd, 16):
@@ -316,7 +357,9 @@ def _compress_block_hoisted(ff, entry, wd, varying, taps, contribs, tw,
                          else _sig0(x) if kind == "s0" else _sig1(x))
         wv[t] = acc
         st = _round(*st, acc + np.uint32(SHA256_K[t]))
-    w = [jnp.broadcast_to(jnp.asarray(wv[16 + j], jnp.uint32), shape)
+    static_rounds = 16 + len(taps)
+    w = [jnp.broadcast_to(jnp.asarray(wv[static_rounds - 16 + j],
+                                      jnp.uint32), shape)
          for j in range(16)]
     st = [jnp.broadcast_to(jnp.asarray(x, jnp.uint32), shape) for x in st]
     if vary_axes:
@@ -330,7 +373,8 @@ def _compress_block_hoisted(ff, entry, wd, varying, taps, contribs, tw,
         st, w = _schedule_block(st, list(w), kvec)
         return st, tuple(w)
 
-    st, _ = jax.lax.fori_loop(2, 4, block, (tuple(st), tuple(w)))
+    st, _ = jax.lax.fori_loop(static_rounds // 16, 4, block,
+                              (tuple(st), tuple(w)))
     return tuple(f + s for f, s in zip(ff, st))
 
 
@@ -345,7 +389,12 @@ def compress_tail_hoisted(midstate, template, contrib, hoist_ops, *,
     tests/test_hoist.py pins that across rem/k/block boundaries.
     """
     nblocks = template.shape[0]
-    struct = hoist_structure(rem, k, nblocks)
+    # The static-window width is keyed off the OPERANDS (a ``cw2`` section
+    # is only built under DBM_HOIST_DEEP), so trace-time structure always
+    # matches the host precompute — and a changed knob forces a retrace
+    # through the changed operand shapes, never a silent mismatch.
+    static_rounds = 48 if "cw2" in hoist_ops else 32
+    struct = hoist_structure(rem, k, nblocks, static_rounds)
     # Coerce to jnp up front: a no-op under jit, and in eager use it keeps
     # the scalar-plane adds on jnp's wrapping uint32 instead of numpy
     # scalars (whose wraparound spams RuntimeWarnings).
@@ -354,6 +403,8 @@ def compress_tail_hoisted(midstate, template, contrib, hoist_ops, *,
     hoist_ops = {k_: jnp.asarray(v, jnp.uint32)
                  for k_, v in hoist_ops.items()}
     deep, kw, cw = hoist_ops["deep"], hoist_ops["kw"], hoist_ops["cw"]
+    if static_rounds > 32:
+        cw = jnp.concatenate([cw, hoist_ops["cw2"]], axis=1)
     out = None
     for b, (varying, taps, full) in enumerate(struct):
         ff = (tuple(midstate[r] for r in range(8)) if b == 0 else out)
@@ -368,7 +419,7 @@ def compress_tail_hoisted(midstate, template, contrib, hoist_ops, *,
             ff, entry, wd, varying, taps, contribs,
             tw=[template[b, j] for j in range(16)],
             kwv=[kw[b, j] for j in range(16)],
-            cwv=[cw[b, i] for i in range(16)],
+            cwv=[cw[b, i] for i in range(static_rounds - 16)],
             shape=shape, vary_axes=vary_axes)
     return out
 

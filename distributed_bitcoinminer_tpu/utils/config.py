@@ -54,8 +54,42 @@ Environment variables:
   retains for ``Scheduler.trace(request_id)`` (default 256, LRU).
 - ``DBM_HOIST`` (0 disables): lane-invariant SHA-256 hoist (deep
   midstate + precombined schedule terms, ops/sha256_jnp.build_hoist).
+- ``DBM_HOIST_DEEP`` (0/1 overrides): extend the hoist's static schedule
+  window from rounds 16..31 to 16..47 in the jnp tier
+  (ops/sha256_jnp.build_hoist). Unset = platform default: ON for CPU
+  backends — the widened window leaves one rolled iteration, which XLA
+  inlines into a straight-line chain measuring ~5x the rolled carry
+  (ROADMAP "hoist rounds 32+" verdict) — and OFF on chip, where the
+  same unroll is the known live-chain HBM spill.
 - ``DBM_UNTIL_PIPELINE`` (0 disables): difficulty-mode sub-dispatch
   pipelining (models.miner_model._until_block).
+- ``DBM_PIPELINE`` (0 disables) / ``DBM_PIPELINE_DEPTH``: miner-side
+  dispatch pipeline (apps/miner.MinerWorker): incoming Requests land in
+  a bounded local queue (depth = ``DBM_PIPELINE_DEPTH``, default 8) and
+  a compute executor dispatches chunk k+1's device work while chunk k's
+  results force and serialize; Results are written strictly in request
+  order. 0 restores the stock read -> blocking search -> write loop.
+- ``DBM_STRIPE`` (0 disables) / ``DBM_STRIPE_CHUNK_S`` /
+  ``DBM_STRIPE_DEPTH``: scheduler-side request striping
+  (apps/scheduler._load_balance): each miner's even-split share is cut
+  into up to ``DBM_STRIPE_DEPTH`` contiguous chunks sized at
+  ``DBM_STRIPE_CHUNK_S`` seconds of work from the miner's throughput
+  EWMA, so its pending FIFO is deep enough for the dispatch pipeline to
+  overlap. A cold pool (no EWMA yet) always falls back to the stock
+  one-chunk-per-miner split; ``DBM_STRIPE=0`` — or a non-positive
+  ``DBM_STRIPE_CHUNK_S`` — pins that split unconditionally.
+- ``DBM_BENCH_PROBE`` (0 disables): the bench's deadlined accelerator
+  probe subprocess; 0 skips it entirely (trust ``JAX_PLATFORMS``) so
+  chip-less boxes stop paying the init deadline every run.
+- ``DBM_BENCH_PIPELINE`` (0 disables) / ``DBM_BENCH_PIPELINE_ROUNDS``:
+  the bench's end-to-end dispatch-pipeline before/after probe
+  (bench.py ``_pipeline_probe``; CPU-only) and its interleaved
+  round count (default 6; the on/off legs alternate order per round
+  and report medians, the noise discipline the probe docstring
+  explains).
+- ``DBM_TIER1_MATRIX`` (0 disables): scripts/tier1.sh's knob-off
+  matrix leg, which re-runs the recovery/chaos/parity modules with
+  ``DBM_PIPELINE=0 DBM_STRIPE=0`` after a green main leg.
 """
 
 from __future__ import annotations
@@ -137,7 +171,12 @@ def jax_devices_robust():
         return jax.devices()
 
 
-def probe_backend(timeout_s: float, repo_dir: str | None = None) -> dict:
+#: Process-wide memo of the first probe outcome (see probe_backend).
+_probe_cache: dict | None = None
+
+
+def probe_backend(timeout_s: float, repo_dir: str | None = None,
+                  refresh: bool = False) -> dict:
     """Resolve the JAX backend in a CHILD process with a deadline.
 
     Uses the SAME resolution order as the apps — ``apply_jax_platform_env``
@@ -148,10 +187,21 @@ def probe_backend(timeout_s: float, repo_dir: str | None = None) -> dict:
     accelerator can never hang the caller: that is the whole point of the
     subprocess (bench round-1 failure mode). Returns ``{"platform", "n"}``
     or ``{"error": ...}``.
+
+    The outcome is memoized for the PROCESS: a wedged tunnel does not heal
+    mid-process, and before the memo every probe caller — the bench, then
+    each in-process MinerWorker it spawns for the pipeline probe — re-paid
+    the full init deadline on chip-less boxes (the recurring ``backend
+    init exceeded 300s deadline`` artifact error). ``refresh=True`` forces
+    a fresh child probe.
     """
     import json
     import subprocess
     import sys
+
+    global _probe_cache
+    if _probe_cache is not None and not refresh:
+        return _probe_cache
     repo = repo_dir or os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     # The child hard-exits after printing: this image's axon/jax stack
@@ -172,13 +222,19 @@ def probe_backend(timeout_s: float, repo_dir: str | None = None) -> dict:
             [sys.executable, "-c", code], capture_output=True, text=True,
             timeout=timeout_s, cwd=repo)
     except subprocess.TimeoutExpired:
-        return {"error": f"backend init exceeded {timeout_s:.0f}s deadline"}
-    if proc.returncode != 0:
-        return {"error": f"backend init failed: {proc.stderr.strip()[-400:]}"}
-    try:
-        return json.loads(proc.stdout.strip().splitlines()[-1])
-    except (ValueError, IndexError):
-        return {"error": f"unparseable probe output: {proc.stdout[-200:]}"}
+        out = {"error": f"backend init exceeded {timeout_s:.0f}s deadline"}
+    else:
+        if proc.returncode != 0:
+            out = {"error":
+                   f"backend init failed: {proc.stderr.strip()[-400:]}"}
+        else:
+            try:
+                out = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                out = {"error":
+                       f"unparseable probe output: {proc.stdout[-200:]}"}
+    _probe_cache = out
+    return out
 
 
 def host_cache_dir(root: str) -> str:
@@ -224,6 +280,35 @@ class CacheParams:
 
 
 @dataclass(frozen=True)
+class StripeParams:
+    """Scheduler request-striping knobs (apps/scheduler._load_balance).
+
+    With striping on, each miner's even-split share of a request is cut
+    into up to ``depth`` contiguous chunks, each sized at ``chunk_s``
+    seconds of work from that miner's observed throughput EWMA (pool EWMA
+    when unobserved), so the miner's pending FIFO is deep enough for its
+    dispatch pipeline (``DBM_PIPELINE``) to overlap chunk k+1's device
+    work with chunk k's result fetch + serialize — and a blown lease
+    forfeits one stripe chunk, not the whole share. A COLD rate (nothing
+    observed yet) always falls back to the stock one-chunk-per-miner
+    split, which keeps the off-path conformance shape for first requests;
+    ``enabled=False`` pins that split unconditionally (Go-parity mode).
+    Chunk boundaries stay contiguous and ascending, so the merge rules
+    (arg-min, difficulty first-hit prefix release) are untouched.
+    """
+    enabled: bool = True
+    chunk_s: float = 1.0           # target seconds of work per stripe chunk
+    depth: int = 8                 # max chunks per miner share
+
+    def __post_init__(self):
+        # chunk_s <= 0 disables striping (the repo-wide 0-disables env
+        # convention) rather than targeting 0 seconds of work per chunk,
+        # which would split every share to the full depth cap.
+        if self.chunk_s <= 0:
+            object.__setattr__(self, "enabled", False)
+
+
+@dataclass(frozen=True)
 class RetryParams:
     """Client submit-with-retry knobs (apps/client.py submit_with_retry).
 
@@ -251,6 +336,7 @@ class FrameworkConfig:
     lease: LeaseParams = field(default_factory=LeaseParams)
     retry: RetryParams = field(default_factory=RetryParams)
     cache: CacheParams = field(default_factory=CacheParams)
+    stripe: StripeParams = field(default_factory=StripeParams)
 
     def make_searcher(self, data: str):
         """Build the configured searcher for one message string.
@@ -295,6 +381,15 @@ def cache_from_env() -> CacheParams:
     )
 
 
+def stripe_from_env() -> StripeParams:
+    d = StripeParams()
+    return StripeParams(
+        enabled=_int_env("DBM_STRIPE", 1) != 0,
+        chunk_s=_float_env("DBM_STRIPE_CHUNK_S", d.chunk_s),
+        depth=max(1, _int_env("DBM_STRIPE_DEPTH", d.depth)),
+    )
+
+
 def retry_from_env() -> RetryParams:
     d = RetryParams()
     return RetryParams(
@@ -323,4 +418,5 @@ def from_env() -> FrameworkConfig:
         lease=lease_from_env(),
         retry=retry_from_env(),
         cache=cache_from_env(),
+        stripe=stripe_from_env(),
     )

@@ -11,6 +11,9 @@
 # emitter is exercised under the full suite's load (every scheduler/miner
 # construction starts it) instead of only in its own unit tests.
 # Override by exporting DBM_METRICS_INTERVAL_S yourself (0 disables).
+# A second deliberate addition (ISSUE 4): after a green main leg, a
+# knob-off matrix leg re-runs the recovery/chaos/parity modules with
+# DBM_PIPELINE=0 DBM_STRIPE=0 (see below; DBM_TIER1_MATRIX=0 skips).
 #
 # Usage: scripts/tier1.sh            (from anywhere; cd's to the repo root)
 # Exit code is pytest's (or timeout's 124/143 on budget exhaustion).
@@ -26,4 +29,24 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# Knob-off matrix leg (ISSUE 4): the dispatch pipeline and request
+# striping default ON, so the full run above exercises the overlapped
+# path — re-run the recovery/chaos/parity-sensitive modules with
+# DBM_PIPELINE=0 DBM_STRIPE=0 so the stock serial loop + reference
+# even split (the Go-parity shape) stays covered in CI too. Skipped
+# when the main leg already blew the budget. DBM_TIER1_MATRIX=0 opts
+# out.
+if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
+        python -m pytest -q -m 'not slow' \
+        tests/test_scheduler_recovery.py tests/test_chaos.py \
+        tests/test_conformance.py tests/test_go_replay.py \
+        tests/test_apps.py \
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+        | tee /tmp/_t1_matrix.log
+    mrc=${PIPESTATUS[0]}
+    echo "MATRIX_KNOBS_OFF_RC=$mrc"
+    [ "$mrc" -ne 0 ] && rc=$mrc
+fi
 exit $rc

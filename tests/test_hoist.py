@@ -210,6 +210,64 @@ def test_searcher_oracle_equivalence_pallas_full(rem, monkeypatch):
         _searcher_sweep(rem, k, "pallas")
 
 
+class TestDeepStaticWindow:
+    """ISSUE 4 satellite: the rounds-16..47 static window (DBM_HOIST_DEEP;
+    CPU default on). The structure analysis must stay consistent between
+    build and trace (keyed off the ``cw2`` operand), and results must be
+    bit-identical to the default window for every structural rem class."""
+
+    @pytest.mark.parametrize("rem", (0, 4, 31, 55, 60, 62))
+    def test_deep_window_lanes_match_default_window(self, rem):
+        import jax.numpy as jnp
+
+        from distributed_bitcoinminer_tpu.ops.search import _hash_lanes
+        k = 5
+        data, midstate, template, _ = _mk(rem, k)
+        deep = build_hoist(midstate, template, rem, k, deep_window=True)
+        std = build_hoist(midstate, template, rem, k, deep_window=False)
+        assert "cw2" in deep.ops and "cw2" not in std.ops
+        # Residual constant taps past round 31 exist for large rem — the
+        # taps the deep window is for (e.g. rem=60: w16/w18/w20 const).
+        if rem >= 55:
+            assert deep.schedule_terms_hoisted > std.schedule_terms_hoisted
+        lo, _hi = _class_range(k)
+        i = np.uint32(max(lo - 13, 0)) + jnp.arange(64, dtype=jnp.uint32)
+        mid32 = np.asarray(midstate, np.uint32)
+        hi_d, lo_d = _hash_lanes(mid32, jnp.asarray(template), i, rem, k,
+                                 hoist=deep.ops)
+        hi_s, lo_s = _hash_lanes(mid32, jnp.asarray(template), i, rem, k,
+                                 hoist=std.ops)
+        assert bool(jnp.all(hi_d == hi_s) & jnp.all(lo_d == lo_s)), rem
+
+    def test_deep_window_searcher_equivalence(self, monkeypatch):
+        """Searcher-level argmin/until equivalence deep vs default window
+        at a boundary rem (the env knob drives build_hoist's default)."""
+        data = "d" * 59                      # rem = 60: 2-block digit spill
+        lo, hi = 10_000, 11_000
+        monkeypatch.setenv("DBM_HOIST_DEEP", "1")
+        s_deep = NonceSearcher(data, batch=64, tier="jnp")
+        assert "cw2" in next(s_deep.plan(lo, hi)).hoist.ops
+        monkeypatch.setenv("DBM_HOIST_DEEP", "0")
+        s_std = NonceSearcher(data, batch=64, tier="jnp")
+        assert "cw2" not in next(s_std.plan(lo, hi)).hoist.ops
+        want = scan_min(data, lo, hi)
+        assert s_deep.search(lo, hi) == s_std.search(lo, hi) == want
+        t = want[0] + 1
+        assert s_deep.search_until(lo, hi, t) == \
+            s_std.search_until(lo, hi, t) == scan_until(data, lo, hi, t)
+
+    def test_pallas_peel_ignores_deep_operands(self, monkeypatch):
+        """The pallas peel kernel's chip-validated SMEM layout reads only
+        deep/kw/cw/ckw — a deep-window plan (cw2 present) must lower and
+        answer exactly under the simulator."""
+        monkeypatch.setenv("DBM_PEEL", "1")
+        monkeypatch.setenv("DBM_HOIST_DEEP", "1")
+        data, lo, hi = "peeldeep", 100, 499
+        s = NonceSearcher(data, batch=64, tier="pallas")
+        assert "cw2" in next(s.plan(lo, hi)).hoist.ops
+        assert s.search(lo, hi) == scan_min(data, lo, hi)
+
+
 def test_hoist_off_knob_restores_plain_path():
     s_on = NonceSearcher("cmu440", batch=64, tier="jnp")
     s_off = NonceSearcher("cmu440", batch=64, tier="jnp", hoist=False)

@@ -151,7 +151,12 @@ def test_pod_joins_as_one_miner_and_matches_oracle(tmp_path):
         client = _spawn(
             [f"{pkg}.client", f"127.0.0.1:{lsp_port}", "podjob", "20000"],
             lsp_env)
-        out, err = client.communicate(timeout=180)
+        # 90s covers pod init + first-job compiles with several-x margin
+        # (the steady-state second leg below completes in seconds); on a
+        # box whose multi-process jax.distributed cannot init at all the
+        # full deadline is burned, so a tighter bound keeps the tier-1
+        # suite inside its wall budget there.
+        out, err = client.communicate(timeout=90)
         want_hash, want_nonce = scan_min("podjob", 0, 20001)  # +1 ref quirk
         assert out.strip() == f"Result {want_hash} {want_nonce}", (out, err)
 

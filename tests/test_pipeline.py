@@ -1,0 +1,439 @@
+"""Dispatch-pipeline property suite (ISSUE 4).
+
+Covers both sides of the overlapped dispatch pipeline:
+
+- **Scheduler striping** (``DBM_STRIPE``): unit-level chunk-plan shape —
+  cold-pool parity with the reference even split, EWMA-sized stripe chunks
+  that stay contiguous/ascending and merge exactly, stripe-chunk recovery
+  on miner drop.
+- **Miner pipeline** (``DBM_PIPELINE``): two-phase dispatch/finalize
+  equivalence across compute tiers (host native, jnp, mesh-sharded),
+  strictly in-order Result writes under a slow-chunk shuffle, and
+  end-to-end bit-equivalence of arg-min and difficulty first-hit answers
+  with the knobs on vs off.
+- **Chaos leg**: wedge and kill mid-pipeline over striped chunks — blown
+  leases re-issue single stripe chunks and the merge stays exact and
+  idempotent.
+
+The tier-1 knob-off matrix leg (scripts/tier1.sh) re-runs the scheduler
+recovery + chaos + conformance modules with ``DBM_PIPELINE=0 DBM_STRIPE=0``
+so the stock serial/even-split path stays exercised in CI; the tests here
+that force striping pass explicit params and are knob-independent.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.client import submit, submit_until
+from distributed_bitcoinminer_tpu.apps.miner import HostSearcher, MinerWorker
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min, scan_until
+from distributed_bitcoinminer_tpu.bitcoin.message import MsgType, new_request
+from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+from distributed_bitcoinminer_tpu.utils.config import (LeaseParams,
+                                                       StripeParams)
+
+from tests.test_apps import Cluster, fast_params
+from tests.test_scheduler_recovery import (CLIENT_X, MINER_A, MINER_B,
+                                           FakeServer, join, request, result)
+
+#: Forces striping regardless of rate magnitude: the per-chunk target size
+#: collapses to ~rate*1ms nonces, so any observed EWMA splits a share into
+#: the depth cap. Tests that need the split deterministic use this.
+FORCED_STRIPE = StripeParams(enabled=True, chunk_s=0.001, depth=3)
+
+
+def make_striped_scheduler(stripe=FORCED_STRIPE, **lease_kw):
+    lease = LeaseParams(**lease_kw) if lease_kw else LeaseParams()
+    server = FakeServer()
+    return Scheduler(server, lease=lease, stripe=stripe), server
+
+
+def seed_rate(sched, conn_id, rate=1_000_000.0):
+    """Pretend the miner has an observed throughput EWMA."""
+    sched._find_miner(conn_id).rate_ewma = rate
+
+
+# ---------------------------------------------------------- scheduler stripes
+
+
+def test_cold_pool_falls_back_to_even_split():
+    """Before any throughput is observed, the chunk plan is bit-identical
+    to the reference even split — the conformance/parity shape needs no
+    knob for first requests."""
+    sched, server = make_striped_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "cold", 199)
+    assert sched.current.num_chunks == 2
+    reqs = server.sent_to(MINER_A, MsgType.REQUEST)
+    assert [(m.lower, m.upper) for m in reqs] == [(0, 100)]
+    reqs = server.sent_to(MINER_B, MsgType.REQUEST)
+    assert [(m.lower, m.upper) for m in reqs] == [(100, 200)]
+    assert sched.stats["chunks_striped"] == 0
+
+
+def test_stripe_plan_contiguous_ascending_and_merges_exactly():
+    """With an observed EWMA the share splits into depth-capped contiguous
+    chunks, indices ascend with nonce range globally, and the barrier
+    merge over all stripe chunks is exact."""
+    sched, server = make_striped_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    seed_rate(sched, MINER_A)
+    seed_rate(sched, MINER_B)
+    request(sched, CLIENT_X, "striped", 199_999)
+    assert sched.current.num_chunks == 6      # 2 miners x depth 3
+    assert sched.stats["chunks_striped"] == 4
+    a = [(m.lower, m.upper)
+         for m in server.sent_to(MINER_A, MsgType.REQUEST)]
+    b = [(m.lower, m.upper)
+         for m in server.sent_to(MINER_B, MsgType.REQUEST)]
+    bounds = a + b
+    # Contiguous cover of [0, 200000) in ascending order.
+    assert bounds[0][0] == 0 and bounds[-1][1] == 200_000
+    for (lo1, up1), (lo2, up2) in zip(bounds, bounds[1:]):
+        assert up1 == lo2 and lo1 < up1
+    # FIFO pops answer in stripe order; the merged min is exact.
+    for i, _ in enumerate(a):
+        result(sched, MINER_A, h=100 + i, nonce=10 + i)
+    for i, _ in enumerate(b):
+        result(sched, MINER_B, h=50 - i, nonce=20 + i)
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(48, 22)]
+
+
+def test_stripe_chunk_count_tracks_rate_and_chunk_s():
+    """The sizing rule: ceil(share / (rate * chunk_s)), depth-capped."""
+    sched, _server = make_striped_scheduler(
+        stripe=StripeParams(enabled=True, chunk_s=1.0, depth=8))
+    join(sched, MINER_A)
+    m = sched._find_miner(MINER_A)
+    assert sched._stripe_chunks(m, 10_000) == 1          # cold: parity
+    m.rate_ewma = 1000.0
+    assert sched._stripe_chunks(m, 10_000) == 8          # capped at depth
+    assert sched._stripe_chunks(m, 2_500) == 3           # ceil(2.5)
+    assert sched._stripe_chunks(m, 1_000) == 1           # exactly chunk_s
+    assert sched._stripe_chunks(m, 1) == 1               # trivial share
+    off = Scheduler(FakeServer(),
+                    stripe=StripeParams(enabled=False))
+    off._on_join(MINER_A)
+    off_m = off._find_miner(MINER_A)
+    off_m.rate_ewma = 1000.0
+    assert off._stripe_chunks(off_m, 10_000) == 1        # knob off
+
+
+def test_striped_chunks_recover_individually_on_miner_drop():
+    """A dead miner forfeits its stripe chunks one by one: each unanswered
+    stripe chunk is reassigned/parked individually, and the merge stays
+    exact — the shrunken blast radius the striping buys."""
+    sched, server = make_striped_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    seed_rate(sched, MINER_A)
+    seed_rate(sched, MINER_B)
+    request(sched, CLIENT_X, "blast radius", 119_999)
+    assert sched.current.num_chunks == 6
+    # B answers its first stripe chunk, then dies: its 2 remaining chunks
+    # must be recovered (A busy -> parked), not lost with the share.
+    result(sched, MINER_B, h=70, nonce=3)
+    sched._on_drop(MINER_B)
+    assert len(sched.parked) == 2
+    # A drains its own 3 chunks, absorbing parked chunks as it frees.
+    for h in (60, 61, 62, 63, 64):
+        result(sched, MINER_A, h=h, nonce=h)
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(60, 60)]
+    assert sched.parked == []
+
+
+# ------------------------------------------------------- two-phase searchers
+
+
+def test_host_searcher_two_phase_matches_blocking():
+    s = HostSearcher("two phase")
+    want = s.search(0, 5000)
+    handles = [s.dispatch(0, 2500), s.dispatch(2501, 5000)]
+    got = [s.finalize(h, lo) for h, lo in zip(handles, (0, 2501))]
+    assert min(got) == want
+    with pytest.raises(ValueError):
+        s.dispatch(5, 3)
+
+
+def test_sharded_dispatch_finalize_equivalence():
+    """The mesh-sharded searcher pipelines through the SAME inherited
+    dispatch/finalize contract: overlapped handles force to the exact
+    sequential results (8-device virtual CPU mesh)."""
+    from distributed_bitcoinminer_tpu.models import ShardedNonceSearcher
+
+    s = ShardedNonceSearcher("sharded pipe", batch=256)
+    ranges = [(0, 2999), (3000, 5999), (6000, 8999)]
+    handles = [(s.dispatch(lo, hi), lo) for lo, hi in ranges]
+    got = [s.finalize(h, lo) for h, lo in handles]
+    for (lo, hi), g in zip(ranges, got):
+        assert g == scan_min("sharded pipe", lo, hi)
+
+
+# ----------------------------------------------------- miner executor order
+
+
+class _ShuffleSearcher:
+    """Two-phase searcher whose finalize times vary per chunk (earlier
+    chunks slower), so an executor that wrote Results as they finish —
+    instead of in request order — would be caught."""
+
+    def __init__(self, data: str, delays):
+        self.data = data
+        self.delays = list(delays)
+        self.finalized = []
+
+    def dispatch(self, lower, upper):
+        return (lower, upper)
+
+    def finalize(self, handle, lower):
+        delay = self.delays.pop(0) if self.delays else 0.0
+        time.sleep(delay)
+        self.finalized.append(handle)
+        return scan_min(self.data, handle[0], handle[1])
+
+
+class _ScriptClient:
+    """Fake AsyncClient: serves a scripted list of Requests, records
+    writes, then blocks forever (the test cancels the worker)."""
+
+    def __init__(self, payloads):
+        self._payloads = list(payloads)
+        self.writes = []
+        self._forever = asyncio.get_running_loop().create_future()
+
+    async def read(self):
+        if self._payloads:
+            return self._payloads.pop(0)
+        await self._forever            # park: transport stays "alive"
+
+    def write(self, payload):
+        self.writes.append(payload)
+
+    async def close(self):
+        pass
+
+
+def test_results_written_in_request_order_under_slow_chunk_shuffle():
+    """In-order Result writes (the scheduler's FIFO pop contract): chunk
+    0's finalize is slowest, later chunks are instant — the pipelined
+    executor must still write 0, 1, 2, 3."""
+    from distributed_bitcoinminer_tpu.bitcoin.message import Message
+
+    async def scenario():
+        searcher = _ShuffleSearcher("order", [0.3, 0.0, 0.0, 0.0])
+        worker = MinerWorker("unused:0",
+                             searcher_factory=lambda d, b: searcher,
+                             pipeline=True, pipeline_depth=4)
+        ranges = [(0, 999), (1000, 1999), (2000, 2999), (3000, 3999)]
+        worker.client = _ScriptClient(
+            [new_request("order", lo, up).to_json() for lo, up in ranges])
+        task = asyncio.create_task(worker.run())
+        for _ in range(400):
+            if len(worker.client.writes) == 4:
+                break
+            await asyncio.sleep(0.01)
+        task.cancel()
+        assert len(worker.client.writes) == 4
+        replies = [Message.from_json(w) for w in worker.client.writes]
+        # Each reply is the exact answer of ITS request, in request order.
+        for (lo, up), m in zip(ranges, replies):
+            want_h, want_n = scan_min("order", lo, up)
+            assert (m.hash, m.nonce) == (want_h, want_n), (lo, up)
+        # And the pipeline really dispatched ahead: chunk 1 finished
+        # finalize after chunk 0 (order list), but all were dispatched.
+        assert [h[0] for h in searcher.finalized] == [lo for lo, _ in ranges]
+    asyncio.run(scenario())
+
+
+def test_slow_dispatch_does_not_hold_inflight_result():
+    """A dispatch stuck in jit trace+compile (fresh signature — chunk
+    sizes drift with the rate EWMA, so this happens in steady state) must
+    not delay the in-flight chunk's already-computed Result write: the
+    Result would otherwise wait out its head-of-FIFO lease behind a
+    multi-second compile and be spuriously re-issued. Pinned: the first
+    chunk's write lands BEFORE the second chunk's slow dispatch
+    completes."""
+    from distributed_bitcoinminer_tpu.bitcoin.message import Message
+
+    events = []
+
+    class _Searcher:
+        def __init__(self, data):
+            self.data = data
+
+        def dispatch(self, lower, upper):
+            if self.data == "cold":
+                time.sleep(0.4)        # the trace+compile stand-in
+            events.append(("dispatch_done", self.data))
+            return (lower, upper)
+
+        def finalize(self, handle, lower):
+            return scan_min(self.data, handle[0], handle[1])
+
+    class _Client(_ScriptClient):
+        def write(self, payload):
+            events.append(("write", Message.from_json(payload).nonce))
+            super().write(payload)
+
+    async def scenario():
+        worker = MinerWorker("unused:0",
+                             searcher_factory=lambda d, b: _Searcher(d),
+                             pipeline=True, pipeline_depth=4)
+        worker.client = _Client(
+            [new_request("warm", 0, 999).to_json(),
+             new_request("cold", 0, 999).to_json()])
+        task = asyncio.create_task(worker.run())
+        for _ in range(300):
+            if len(worker.client.writes) == 2:
+                break
+            await asyncio.sleep(0.01)
+        task.cancel()
+        assert len(worker.client.writes) == 2
+        # Replies are exact and in request order…
+        for data, m in zip(("warm", "cold"),
+                           (Message.from_json(w)
+                            for w in worker.client.writes)):
+            assert (m.hash, m.nonce) == scan_min(data, 0, 999)
+        # …and the warm Result was written while "cold" still compiled.
+        d_cold = events.index(("dispatch_done", "cold"))
+        w_warm = next(i for i, e in enumerate(events) if e[0] == "write")
+        assert w_warm < d_cold, events
+    asyncio.run(scenario())
+
+
+# -------------------------------------------------------- e2e equivalence
+
+
+def _e2e_cluster_answers(pipeline: bool, stripe: StripeParams,
+                         factory=None):
+    """Drive arg-min + difficulty requests through a 2-miner cluster with
+    the given knob settings; returns the (argmin, until) answers."""
+    async def scenario():
+        params = fast_params()
+        async with Cluster(params) as c:
+            c.scheduler.stripe = stripe
+            for _ in range(2):
+                worker = MinerWorker(
+                    c.hostport, params=params,
+                    searcher_factory=factory or
+                    (lambda d, b: HostSearcher(d)),
+                    pipeline=pipeline)
+                await worker.join()
+                c.tasks.append(asyncio.create_task(worker.run()))
+                c.miners.append(worker)
+            # Request 1 seeds the rate EWMA; request 2 stripes (when on).
+            r0 = await asyncio.wait_for(
+                submit(c.hostport, "equiv warm", 999, params), 30)
+            r1 = await asyncio.wait_for(
+                submit(c.hostport, "equiv main", 49_999, params), 60)
+            ru = await asyncio.wait_for(
+                submit_until(c.hostport, "equiv until", 2999, 1 << 59,
+                             params), 60)
+            return r0, r1, ru, c.scheduler.stats["chunks_striped"]
+    return asyncio.run(scenario())
+
+
+def test_e2e_bit_equivalence_knobs_on_vs_off():
+    """The acceptance property: arg-min and difficulty first-hit answers
+    are bit-identical with the pipeline+striping on vs off (and both
+    match the host oracle); the on-leg actually striped."""
+    on = _e2e_cluster_answers(True, FORCED_STRIPE)
+    off = _e2e_cluster_answers(False, StripeParams(enabled=False))
+    assert on[:3] == off[:3]
+    assert on[0] == scan_min("equiv warm", 0, 1000)
+    assert on[1] == scan_min("equiv main", 0, 50_000)
+    assert on[2] == scan_until("equiv until", 0, 3000, 1 << 59)
+    assert on[3] > 0 and off[3] == 0     # striping engaged only on-leg
+
+
+def test_e2e_equivalence_real_jnp_searcher():
+    """Same equivalence through the real jnp device tier (compiled once
+    outside the wire deadline, like test_end_to_end_with_real_jax_searcher)."""
+    from distributed_bitcoinminer_tpu.models import NonceSearcher
+
+    # Precompile every signature the striped chunks can hit.
+    warm = NonceSearcher("pipe jnp", batch=1 << 10)
+    warm.search(0, 3000)
+
+    factory = lambda d, b: NonceSearcher(d, batch=1 << 10)  # noqa: E731
+
+    async def scenario():
+        params = fast_params()
+        async with Cluster(params) as c:
+            c.scheduler.stripe = FORCED_STRIPE
+            worker = MinerWorker(c.hostport, params=params,
+                                 searcher_factory=factory, pipeline=True)
+            await worker.join()
+            c.tasks.append(asyncio.create_task(worker.run()))
+            c.miners.append(worker)
+            r0 = await asyncio.wait_for(
+                submit(c.hostport, "pipe jnp", 999, params), 120)
+            assert r0 == scan_min("pipe jnp", 0, 1000)
+            r1 = await asyncio.wait_for(
+                submit(c.hostport, "pipe jnp", 2999, params), 120)
+            assert r1 == scan_min("pipe jnp", 0, 3000)
+            assert c.scheduler.stats["chunks_striped"] > 0
+    asyncio.run(scenario())
+
+
+# --------------------------------------------------------------- chaos leg
+
+
+def test_chaos_wedge_mid_pipeline_reissues_striped_chunk():
+    """A wedged miner mid-pipeline blows ONE stripe chunk's lease; the
+    re-issue covers exactly that range, merges idempotently, and the
+    answer stays the oracle arg-min."""
+    from tests.test_chaos import ChaosCluster, tight_lease
+
+    async def scenario():
+        async with ChaosCluster(lease=tight_lease()) as c:
+            c.scheduler.stripe = FORCED_STRIPE
+            wedged = await c.add_miner("wedged")
+            await c.add_miner("healthy")
+            # Seed both rate EWMAs so the next request stripes.
+            r0 = await asyncio.wait_for(
+                submit(c.hostport, "chaos warm", 799, c.params), 20)
+            assert r0 == scan_min("chaos warm", 0, 800)
+            wedged.wedge()
+            result = await asyncio.wait_for(
+                submit(c.hostport, "chaos striped", 999, c.params), 30)
+            assert result == scan_min("chaos striped", 0, 1000)
+            assert c.scheduler.stats["chunks_striped"] > 0
+            assert c.scheduler.stats["reissues"] >= 1
+            assert c.scheduler.stats["leases_blown"] >= 1
+            wedged.unwedge()
+            assert await c.settle()
+            assert c.scheduler.stats["results_sent"] == 2
+    asyncio.run(scenario())
+
+
+def test_chaos_kill_mid_pipeline_recovers_striped_chunks():
+    """A miner killed mid-pipeline with several striped chunks pending:
+    every unanswered stripe chunk re-executes elsewhere exactly once and
+    the merge stays exact."""
+    from tests.test_chaos import ChaosCluster, tight_lease
+
+    async def scenario():
+        async with ChaosCluster(lease=tight_lease()) as c:
+            c.scheduler.stripe = FORCED_STRIPE
+            doomed = await c.add_miner("doomed", delay=0.15)
+            await c.add_miner("survivor", delay=0.01)
+            r0 = await asyncio.wait_for(
+                submit(c.hostport, "kill warm", 599, c.params), 20)
+            assert r0 == scan_min("kill warm", 0, 600)
+            pending = asyncio.create_task(
+                submit(c.hostport, "kill striped", 1999, c.params))
+            await asyncio.sleep(0.2)        # chunks assigned; doomed busy
+            await doomed.kill()
+            result = await asyncio.wait_for(pending, 30)
+            assert result == scan_min("kill striped", 0, 2000)
+            await doomed.restart()
+            assert await c.settle()
+    asyncio.run(scenario())
