@@ -216,6 +216,244 @@ def _pipeline_probe(data: str, lower: int, count: int, batch: int,
     }
 
 
+def _qos_probe(data: str, lower: int, batch: int) -> dict:
+    """Mixed-load QoS before/after (ISSUE 5): one ELEPHANT plus a train
+    of MICE through a real scheduler + two jnp-tier miners over localhost
+    LSP, with the fair-share plane off vs on.
+
+    Off leg: the reference one-request-in-flight FIFO — every mouse
+    queues behind the elephant's last merge. On leg: the elephant is
+    split into ``max_chunks`` equal chunks granted by DRR, so mice
+    interleave mid-elephant and their reply latency collapses to ~one
+    chunk of queueing; the elephant pays the interleaved mice's compute
+    plus grant overhead (the acceptance bound: <= 10% completion-time
+    regression at the median).
+
+    Determinism discipline (same spirit as ``_pipeline_probe``):
+    ``chunk_s`` is pinned so the ``max_chunks`` cap — not the throughput
+    EWMA — sizes the elephant plan (always exactly 8 x 2^22, ~the
+    production default of one second of pool work per chunk) while a
+    whole mouse fits ONE chunk (2^14): one compile signature each,
+    warmed by an untimed storm before the timed rounds, and a mouse
+    pays one grant round-trip instead of eight. Striping is pinned
+    OFF in both legs — stripe chunks are EWMA-sized, so their XLA
+    signatures drift between warm and timed rounds and the off leg
+    would mostly measure recompiles.
+    Legs are INTERLEAVED over ``DBM_BENCH_QOS_ROUNDS`` rounds with the
+    in-round order swapped (the box's cgroup noise is two-sided, see
+    _pipeline_probe) and every aggregate is a MEDIAN across rounds;
+    mice p99 additionally pools every round's latencies. The result
+    cache is OFF in both legs — rounds repeat identical keys.
+    """
+    import asyncio
+    from statistics import median
+
+    from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                              MsgType,
+                                                              new_request)
+    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+    from distributed_bitcoinminer_tpu.lsp.params import Params
+    from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+    from distributed_bitcoinminer_tpu.models import NonceSearcher
+    from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
+                                                           LeaseParams,
+                                                           QosParams,
+                                                           StripeParams)
+
+    params = Params(epoch_limit=30, epoch_millis=500, window_size=32,
+                    max_backoff_interval=2)
+    elephant_count = 1 << 25        # ~1-2s of pool work on the jnp tier
+    mouse_count = 1 << 14
+    n_mice = 4
+
+    def qos_params(enabled: bool) -> QosParams:
+        # chunk_s is picked so pool_rate * chunk_s lands in
+        # [mouse_count, elephant_count / max_chunks] across ±10x rate
+        # drift (pool EWMA ~5-15M nps on this box): the MAX_CHUNKS cap —
+        # not the EWMA — then sizes the elephant plan (8 x 2^22, one
+        # signature) while a whole mouse fits ONE chunk (2^14, also one
+        # signature — and one grant round-trip, not eight).
+        return QosParams(enabled=enabled, wholesale_s=0.3, chunk_s=0.03,
+                         max_chunks=8, depth=2)
+
+    async def leg(qos_on: bool) -> dict:
+        server = await new_async_server(0, params)
+        sched = Scheduler(
+            server,
+            cache=CacheParams(enabled=False),
+            # Leases OFF: the probe measures queueing, not fault
+            # tolerance — a first-in-process compile can run minutes on
+            # this box, and a blown lease mid-warm-storm would drag
+            # re-issue/quarantine state into the timed round.
+            lease=LeaseParams(enabled=False, queue_alarm_s=0.0),
+            # Striping OFF in BOTH legs: stripe chunks are sized from the
+            # live throughput EWMA, so their XLA signatures drift between
+            # the warm storms and the timed round — on this 2-core box
+            # the off leg then measures mostly recompiles (~20s for a
+            # ~2s elephant). With stock even-split wholesale the off leg
+            # runs exactly the warmed 2^24-per-miner signature and the
+            # comparison isolates the QoS plane.
+            stripe=StripeParams(enabled=False),
+            qos=qos_params(qos_on))
+        sched_task = asyncio.create_task(sched.run())
+        hostport = f"127.0.0.1:{server.port}"
+        workers = []
+        try:
+            for _ in range(2):
+                w = MinerWorker(
+                    hostport, params=params,
+                    searcher_factory=lambda d, b: NonceSearcher(
+                        d, batch=probe_batch, tier="jnp"))
+                await w.join()
+                workers.append(asyncio.create_task(w.run()))
+                workers.append(w)
+
+            def ask_blocking(count):
+                # Raw ranged Request on a FRESH conn: the `submit` helper
+                # pins Lower to 0 (dragging in every small digit class
+                # and its compile signatures, see _pipeline_probe), and a
+                # fresh conn per request is exactly the multi-tenant
+                # shape — each mouse is its own tenant. Each client runs
+                # on its OWN thread + event loop: the main loop shares
+                # the GIL with the miners' jit-dispatch threads and
+                # stalls for up to a second at a time, so clients
+                # scheduled on it submit LATE (an off-leg mouse would
+                # land just before the elephant's merge and record a
+                # near-zero FIFO wait) — client-side stamps are honest
+                # only off the compute loop.
+                async def go():
+                    client = await new_async_client(hostport, params)
+                    try:
+                        client.write(new_request(
+                            data, lower, lower + count - 1).to_json())
+                        while True:
+                            m = Message.from_json(
+                                await asyncio.wait_for(client.read(), 600))
+                            if m.type == MsgType.RESULT:
+                                return m
+                    finally:
+                        await client.close()
+                return asyncio.run(go())
+
+            async def storm():
+                # Every submit self-schedules on its own thread from a
+                # common t0 (time.sleep, not asyncio.sleep: the main
+                # loop's timers drift ~a second under compute, which
+                # would slide the mice to the elephant's merge and
+                # record near-zero FIFO waits in the off leg).
+                t0 = time.time()
+                mice_lat = []
+
+                def run_one(count, delay):
+                    time.sleep(max(0.0, t0 + delay - time.time()))
+                    m0 = time.time()
+                    ask_blocking(count)
+                    return time.time() - m0
+
+                def mouse(delay):
+                    mice_lat.append(run_one(mouse_count, delay))
+
+                tasks = [asyncio.create_task(
+                    asyncio.to_thread(run_one, elephant_count, 0.0))]
+                for i in range(n_mice):
+                    # The elephant holds the pool before the mice land.
+                    tasks.append(asyncio.create_task(
+                        asyncio.to_thread(mouse, 0.2 + 0.05 * i)))
+                elephant_s = await tasks[0]
+                await asyncio.gather(*tasks[1:])
+                return elephant_s, mice_lat
+
+            # TWO warm storms (untimed). The first runs on a COLD pool —
+            # everything dispatches wholesale by design (reference
+            # parity), warming the wholesale split signatures and
+            # seeding the throughput EWMA. The second runs warm, so the
+            # on-leg's elephant/mice actually take the CHUNKED path and
+            # pay the 2^22-chunk and 2^14-chunk signatures outside the
+            # timed window.
+            await storm()
+            await storm()
+            elephant_s, mice_lat = await storm()
+            grants = sched.stats["qos_grants"]
+            return {"elephant_s": elephant_s, "mice": sorted(mice_lat),
+                    "qos_grants": grants}
+        finally:
+            for item in workers:
+                if isinstance(item, asyncio.Task):
+                    item.cancel()
+                else:
+                    await item.close()
+            sched_task.cancel()
+            await server.close()
+
+    # The probe's own batch: at the bench's 8192 a 2^24 share is 2048
+    # Python-level device dispatches whose GIL churn starves the
+    # scheduler/client loops for ~second-long stretches; at 2^16 the
+    # same share is 256 dispatches and the compute stays inside XLA
+    # (GIL released), so the latencies measure queueing, not
+    # interpreter contention.
+    probe_batch = max(batch, 1 << 16)
+
+    # Precompile every signature a leg can hit OUTSIDE the legs (the
+    # jit cache is process-wide, same idiom as test_pipeline's jnp
+    # warm): a first-in-process compile can run minutes on this box —
+    # inside a leg that lands mid-warm-storm and skews it.
+    warm = NonceSearcher(data, batch=probe_batch, tier="jnp")
+    for span in (elephant_count // 2,      # wholesale share, 2 miners
+                 elephant_count // 8,      # QoS elephant chunk (cap 8)
+                 mouse_count,              # QoS mouse chunk (whole mouse)
+                 mouse_count // 2):        # wholesale mouse share
+        warm.search(lower, lower + span)
+
+    rounds = max(1, int(os.environ.get("DBM_BENCH_QOS_ROUNDS", "3")))
+    on_rounds, off_rounds = [], []
+    for rnd in range(rounds):
+        order = (True, False) if rnd % 2 == 0 else (False, True)
+        for qos_on in order:
+            (on_rounds if qos_on else off_rounds).append(
+                asyncio.run(leg(qos_on)))
+
+    def pool(legs):
+        return sorted(x for r in legs for x in r["mice"])
+
+    def pct(xs, q):
+        return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+
+    on_mice, off_mice = pool(on_rounds), pool(off_rounds)
+    on_eleph = median(r["elephant_s"] for r in on_rounds)
+    off_eleph = median(r["elephant_s"] for r in off_rounds)
+    return {
+        "elephant_range": elephant_count,
+        "mouse_range": mouse_count,
+        "mice_per_round": n_mice,
+        "rounds": rounds,
+        "on": {
+            "mice_p50_s": round(median(on_mice), 4),
+            "mice_p99_s": round(pct(on_mice, 0.99), 4),
+            "elephant_s": round(on_eleph, 4),
+            "qos_grants": on_rounds[0]["qos_grants"],
+        },
+        "off": {
+            "mice_p50_s": round(median(off_mice), 4),
+            "mice_p99_s": round(pct(off_mice, 0.99), 4),
+            "elephant_s": round(off_eleph, 4),
+        },
+        # The two acceptance numbers: mice latency improvement and the
+        # elephant's completion-time cost, both at the median.
+        "mice_p50_speedup": round(median(off_mice) / median(on_mice), 3),
+        "mice_p99_speedup": round(pct(off_mice, 0.99) / pct(on_mice, 0.99),
+                                  3),
+        "elephant_regression": round(on_eleph / off_eleph - 1, 4),
+        "on_samples": [[round(x, 4) for x in r["mice"]] for r in on_rounds],
+        "off_samples": [[round(x, 4) for x in r["mice"]]
+                        for r in off_rounds],
+        "elephant_samples": {
+            "on": [round(r["elephant_s"], 3) for r in on_rounds],
+            "off": [round(r["elephant_s"], 3) for r in off_rounds]},
+    }
+
+
 def main() -> int:
     from distributed_bitcoinminer_tpu.utils.config import probe_backend
     from distributed_bitcoinminer_tpu.utils.metrics import ensure_emitter
@@ -468,6 +706,19 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             pipeline_detail = {"pipeline": {"error": repr(exc)[:300]}}
 
+    # Fair-share QoS mixed-load before/after (ISSUE 5): one elephant + a
+    # mice train through real localhost LSP, DBM_QOS off vs on —
+    # recording mice p50/p99 reply latency and the elephant's completion
+    # time. CPU-only and isolated like the other auxiliary measurements;
+    # DBM_BENCH_QOS=0 skips it.
+    qos_detail = {}
+    if not on_accel and "jnp" in results \
+            and os.environ.get("DBM_BENCH_QOS", "1") != "0":
+        try:
+            qos_detail = {"qos": _qos_probe(data, lower, batch)}
+        except Exception as exc:  # noqa: BLE001
+            qos_detail = {"qos": {"error": repr(exc)[:300]}}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
@@ -497,6 +748,7 @@ def main() -> int:
         **until_detail,
         **sweep_detail,
         **pipeline_detail,
+        **qos_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
         # (midstate cache behavior, until-tier degradations) stay

@@ -1,0 +1,302 @@
+"""Fair-share QoS plane: per-tenant accounting the scheduler mounts in
+front of dispatch (ISSUE 5).
+
+The stock coordinator drains ONE global FIFO with whole-request dispatch:
+a 2^40-range elephant parks every later request until its last chunk
+merges, and nothing bounds intake — an overload storm just grows the
+deque until every client times out at once (the queue-age alarm from
+ISSUE 2 *names* that starvation; this plane fixes it). PNPCoin
+(PAPERS.md, arXiv 2208.12628) frames the same coordinator as a general
+multi-tenant compute service, and what makes such a service multi-tenant
+is exactly this layer — the fairness + admission plane any
+inference-serving stack runs in front of its batch scheduler.
+
+Three mechanisms, all tenant-keyed by the client conn id (no wire
+change; ``utils.config.QosParams`` holds the knobs):
+
+- **Deficit-round-robin at chunk granularity.** Each tenant carries a
+  deficit counter in NONCES. :meth:`QosPlane.pick` walks the active ring:
+  a tenant whose deficit covers its head item's cost is granted; one that
+  cannot afford it is topped up by ``weight * quantum`` once per pass and
+  the ring rotates. The quantum is the largest candidate cost of the
+  pass, so the classic DRR guarantee holds: every tenant with backlog is
+  granted within ``ceil(1/weight)`` ring passes — no starvation — and
+  sustained grant share converges to the weight ratio. The *items* being
+  granted are chunks (the EWMA-sized pieces the striping plane of ISSUE 4
+  introduced), so an elephant yields the pool to a mouse between chunks
+  instead of at its last merge. The scheduler owns chunk planning and
+  miner selection; this plane only answers "whose turn is it".
+
+- **Token-bucket admission.** Per-tenant bucket of ``burst`` tokens
+  refilled at ``rate``/s; a request arriving on an empty bucket is shed
+  at admission (the scheduler never queues it). ResultCache replays are
+  checked BEFORE admission in the scheduler, so a retry storm of
+  already-answered requests never burns quota.
+
+- **In-flight caps + shed bookkeeping.** ``max_inflight`` bounds each
+  tenant's granted-but-unanswered chunks (the scheduler filters
+  candidates on it); the scheduler's oldest-first overload shedding
+  (``max_queued``) reports here so the per-tenant counters and the
+  ``qos_shed`` totals ride the ISSUE 3 metrics registry.
+
+Metric series (scheduler registry, mounted under ``sched.``):
+``qos_tenants`` gauge, ``qos_grant_share{tenant=}`` gauges (cumulative
+granted-nonce share), ``qos_granted_chunks{tenant=}`` counters,
+``qos_shed_reason{reason=}`` counters, and the plane-neutral
+``qos_grants`` / ``qos_shed`` totals the scheduler keeps in its stats
+view. Tenant series are removed when the tenant is forgotten (conn drop
+or idle GC), so conn churn can never exhaust the registry's cardinality
+bound.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..utils.metrics import Registry
+
+__all__ = ["TokenBucket", "TenantState", "QosPlane"]
+
+
+class TokenBucket:
+    """Classic token bucket: ``burst`` capacity, ``rate`` tokens/s refill.
+
+    ``rate <= 0`` means admission is disabled — :meth:`take` always
+    grants and the bucket reports full.
+    """
+
+    __slots__ = ("rate", "burst", "_tokens", "_t", "_clock")
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = rate
+        self.burst = max(1.0, burst)
+        self._tokens = self.burst
+        self._clock = clock
+        self._t = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def take(self, n: float = 1.0) -> bool:
+        """Spend ``n`` tokens; False (and no spend) when short."""
+        if self.rate <= 0:
+            return True
+        self._refill()
+        if self._tokens < n:
+            return False
+        self._tokens -= n
+        return True
+
+    @property
+    def level(self) -> float:
+        if self.rate <= 0:
+            return self.burst
+        self._refill()
+        return self._tokens
+
+    @property
+    def full(self) -> bool:
+        return self.level >= self.burst - 1e-9
+
+
+class TenantState:
+    """Per-tenant DRR + admission state (one per live client conn)."""
+
+    __slots__ = ("tenant", "weight", "deficit", "inflight",
+                 "granted_nonces", "granted_chunks", "shed", "bucket")
+
+    def __init__(self, tenant, weight: float, bucket: TokenBucket):
+        self.tenant = tenant
+        self.weight = max(weight, 1e-3)
+        self.deficit = 0.0
+        self.inflight = 0          # granted, not yet answered, chunks
+        self.granted_nonces = 0
+        self.granted_chunks = 0
+        self.shed = 0
+        self.bucket = bucket
+
+
+class QosPlane:
+    """Tenant registry + DRR scheduler state. The Scheduler executes
+    every decision (it owns chunk plans, miners, and the wire); the plane
+    owns whose-turn-is-it and the per-tenant accounting."""
+
+    #: Safety valve on the DRR walk: weights are clamped to >= 1e-3 in
+    #: TenantState, but a pick must terminate even on corrupted state.
+    MAX_PASSES = 1024
+
+    def __init__(self, metrics: Registry,
+                 clock: Callable[[], float] = time.monotonic):
+        self.metrics = metrics
+        self._clock = clock
+        self.tenants: Dict[object, TenantState] = {}
+        self.ring: deque = deque()        # active tenant ids, DRR order
+        self.total_granted_nonces = 0
+        # Tenants already topped up in the CURRENT ring cycle (classic
+        # DRR adds quantum once per round, not once per missed pick).
+        self._topped: set = set()
+        self._g_tenants = metrics.gauge("qos_tenants")
+
+    # ------------------------------------------------------------- tenants
+
+    def tenant(self, tenant, weight: float = 1.0, rate: float = 0.0,
+               burst: float = 8.0) -> TenantState:
+        """The tenant's state, created on first sight with the given
+        weight/bucket parameters (later calls ignore them — use
+        :meth:`set_weight` to change a live tenant)."""
+        st = self.tenants.get(tenant)
+        if st is None:
+            st = TenantState(tenant, weight,
+                             TokenBucket(rate, burst, self._clock))
+            self.tenants[tenant] = st
+            self.ring.append(tenant)
+            self._g_tenants.set(len(self.tenants))
+        return st
+
+    def set_weight(self, tenant, weight: float) -> None:
+        if tenant in self.tenants:
+            self.tenants[tenant].weight = max(weight, 1e-3)
+
+    def forget(self, tenant) -> None:
+        """Drop a tenant for good (conn closed, or idle GC): frees its
+        metric series so conn churn cannot exhaust the cardinality
+        bound."""
+        if self.tenants.pop(tenant, None) is None:
+            return
+        self._topped.discard(tenant)
+        try:
+            self.ring.remove(tenant)
+        except ValueError:
+            pass
+        self.metrics.remove("qos_grant_share", tenant=str(tenant))
+        self.metrics.remove("qos_granted_chunks", tenant=str(tenant))
+        self._g_tenants.set(len(self.tenants))
+
+    def gc(self, busy: set) -> None:
+        """Forget every tenant that is not in ``busy`` (no queued or
+        in-flight work), has nothing granted outstanding, and whose
+        admission bucket is full (nothing left to remember). Called from
+        the scheduler's sweep so a long server life stays bounded by the
+        live tenant set. Also refreshes every live tenant's grant-share
+        gauge (one O(tenants) pass per sweep tick): :meth:`on_grant`
+        only re-sets the granted tenant's gauge, so the others go stale
+        against the grown total between sweeps."""
+        for tenant in [t for t, st in self.tenants.items()
+                       if t not in busy and st.inflight == 0
+                       and st.bucket.full]:
+            self.forget(tenant)
+        self._update_shares()
+
+    # ----------------------------------------------------------- admission
+
+    def admit(self, tenant) -> bool:
+        """Spend one admission token; False = shed at admission."""
+        return self.tenants[tenant].bucket.take(1.0)
+
+    def on_shed(self, tenant, reason: str) -> None:
+        st = self.tenants.get(tenant)
+        if st is not None:
+            st.shed += 1
+        self.metrics.counter("qos_shed_reason", reason=reason).inc()
+
+    # ----------------------------------------------------------------- DRR
+
+    def pick(self, candidates: Dict[object, int]) -> Optional[object]:
+        """DRR selection among ``{tenant: next_item_cost_in_nonces}``.
+
+        Classic deficit-round-robin with a PERSISTENT ring head: the
+        tenant at the head is granted while its deficit covers its head
+        item's cost (the ring does not advance on a grant — a tenant
+        serves its quantum's worth of chunks contiguously), is topped up
+        by ``weight * quantum`` at most ONCE per full ring cycle, and
+        the ring rotates past it once it cannot afford even after the
+        cycle's top-up. The quantum is the largest candidate cost of
+        this pick, so at least one tenant can always eventually afford,
+        every backlogged tenant is granted within ``ceil(1/weight)``
+        cycles (no starvation), and sustained grant share in NONCES
+        converges to the weight ratio. (Topping up once per MISS instead
+        of once per CYCLE — the naive loop — banks unbounded credit for
+        whichever tenant sits at the head, and one mispriced cost then
+        starves the rest of the ring; see test_qos.py.)
+
+        The caller must already have filtered candidates down to
+        EXECUTABLE work (a miner with capacity, under the in-flight cap).
+        Returns the granted tenant — the caller then performs the grant
+        and reports it via :meth:`on_grant`, which debits the deficit —
+        or None when there are no candidates.
+        """
+        if not candidates:
+            return None
+        for tenant in candidates:
+            self.tenant(tenant)      # ring membership for late joiners
+        quantum = max(candidates.values()) or 1
+        visited = 0
+        for _ in range(self.MAX_PASSES * max(1, len(self.ring))):
+            tenant = self.ring[0]
+            cost = candidates.get(tenant)
+            if cost is not None:
+                st = self.tenants[tenant]
+                if st.deficit >= cost:
+                    return tenant
+                if tenant not in self._topped:
+                    self._topped.add(tenant)
+                    st.deficit += st.weight * quantum
+                    if st.deficit >= cost:
+                        return tenant
+            # Not grantable (no backlog, at cap, no miner capacity) or
+            # cannot afford this cycle: move the head on.
+            self.ring.rotate(-1)
+            visited += 1
+            if visited >= len(self.ring):
+                visited = 0
+                self._topped.clear()   # a new cycle may top up afresh
+        return next(iter(candidates))   # unreachable safety valve
+
+    def on_grant(self, tenant, nonces: int) -> None:
+        """Account one executed grant: debit the deficit, bump in-flight
+        and the granted tenant's share gauge. Only the GRANTED tenant's
+        gauge is re-set here (O(1) per grant — a full recompute would
+        make every grant O(tenants)); the other tenants' gauges, stale
+        by the grown total, are refreshed once per sweep from :meth:`gc`."""
+        st = self.tenant(tenant)
+        st.deficit = max(0.0, st.deficit - nonces)
+        st.inflight += 1
+        st.granted_chunks += 1
+        st.granted_nonces += nonces
+        self.total_granted_nonces += nonces
+        self.metrics.counter("qos_granted_chunks", tenant=str(tenant)).inc()
+        self.metrics.gauge("qos_grant_share", tenant=str(tenant)).set(
+            st.granted_nonces / self.total_granted_nonces)
+
+    def on_chunk_answered(self, tenant) -> None:
+        st = self.tenants.get(tenant)
+        if st is not None and st.inflight > 0:
+            st.inflight -= 1
+
+    def release(self, tenant, outstanding: int) -> None:
+        """A request retired with ``outstanding`` granted-but-unanswered
+        chunks (prefix release, client drop): free the tenant's slots."""
+        st = self.tenants.get(tenant)
+        if st is not None:
+            st.inflight = max(0, st.inflight - max(0, outstanding))
+
+    def grant_share(self, tenant) -> float:
+        """Cumulative granted-nonce share of one tenant (0 when nothing
+        has been granted process-wide)."""
+        st = self.tenants.get(tenant)
+        if st is None or not self.total_granted_nonces:
+            return 0.0
+        return st.granted_nonces / self.total_granted_nonces
+
+    def _update_shares(self) -> None:
+        if not self.total_granted_nonces:
+            return
+        for tenant, st in self.tenants.items():
+            self.metrics.gauge("qos_grant_share", tenant=str(tenant)).set(
+                st.granted_nonces / self.total_granted_nonces)

@@ -91,6 +91,38 @@ channel-coupled goroutines:
   ``desperation_dispatch`` and logged as a structured warning. Gated by
   ``LeaseParams.desperation``; any non-quarantined miner disables it.
 
+Fair-share QoS dispatch plane (ISSUE 5, ``DBM_QOS``; no reference
+analog): the reference's one-request-in-flight FIFO lets a 2^40-range
+elephant park every later request until its last chunk merges, and
+nothing bounds intake. With QoS on, every request is keyed to a TENANT
+(its client conn id — no wire change) and dispatch runs through
+``apps/qos.py``:
+
+- Requests whose estimated scan exceeds ``QosParams.wholesale_s`` are
+  CHUNKED: split into pool-EWMA-sized chunks (``chunk_s`` seconds each,
+  at most ``max_chunks``) held centrally and granted to miners
+  incrementally — each miner's live FIFO capped at ``QosParams.depth``
+  so the rest of the pool stays grantable. Multiple requests are then in
+  flight CONCURRENTLY, their chunks interleaved across the miner pool by
+  deficit-round-robin over tenants (grant share converges to the
+  configured weights; DRR's quantum guarantee means no tenant starves).
+  Chunk indices still ascend with nonce range per request and every
+  merge rule — strict-less arg-min barrier, difficulty prefix release,
+  speculative re-issue dedup — is per-request and untouched, so answers
+  are bit-identical to the FIFO scheduler's.
+- Smaller requests (and any request on a COLD pool) dispatch WHOLESALE
+  through the stock path below, and a wholesale request in flight blocks
+  later starts exactly like the reference — so single-tenant traffic,
+  the conformance/parity shape, and everything with ``DBM_QOS=0``
+  reproduce today's FIFO dispatch order bit-for-bit.
+- Admission + shedding: a per-tenant token bucket (``rate``/``burst``)
+  sheds at arrival when drained; a total ``max_queued`` bound sheds the
+  OLDEST queued request (cancelled through the trace/cancel path, conn
+  closed) so ``submit_with_retry`` clients back off and resubmit instead
+  of hanging into their wire deadline. ResultCache replays are answered
+  BEFORE admission and are never shed — a retry storm of already-
+  answered requests burns no quota.
+
 Observability plane (ISSUE 3): every counter that used to live in the
 ad-hoc ``stats`` dict is now a series in a per-scheduler metrics
 :class:`~..utils.metrics.Registry`, mounted into the process registry under
@@ -133,11 +165,12 @@ from ..bitcoin.hash import MAX_U64
 from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
-from ..utils.config import CacheParams, LeaseParams, StripeParams, \
-    stripe_from_env
+from ..utils.config import CacheParams, LeaseParams, QosParams, \
+    StripeParams, qos_from_env, stripe_from_env
 from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS, Registry,
                              RequestTrace, TraceBuffer, ensure_emitter,
                              registry as process_registry)
+from .qos import QosPlane
 
 logger = logging.getLogger("dbm.scheduler")
 
@@ -148,6 +181,7 @@ STAT_COUNTERS = (
     "quarantines", "cache_hits", "cache_misses", "cache_stores",
     "queue_alarms", "inflight_alarms", "no_eligible_miner",
     "desperation_dispatch", "leases_blown_spurious", "chunks_striped",
+    "qos_grants", "qos_shed",
 )
 
 
@@ -230,6 +264,13 @@ class MinerState:
     rate_ewma: Optional[float] = None
     blown_streak: int = 0
     quarantined: bool = False
+    # Windowed throughput sampling (ISSUE 5; see _observe_result): the
+    # wall-clock window currently accumulating answered nonces. Per-pop
+    # size/elapsed sampling is a lie under the pipelined miner — a
+    # prefetched chunk's Result lands ~1ms after its lease re-stamp and
+    # reads as 10^9 nonces/s.
+    win_t0: float = 0.0
+    win_nonces: int = 0
 
     @property
     def available(self) -> bool:
@@ -276,6 +317,13 @@ class Request:
     # a full extra bound after dispatch.
     last_inflight_alarm: float = 0.0
     trace: object = None           # RequestTrace (utils/metrics.py)
+    # QoS dispatch plane (ISSUE 5). ``qos_mode`` is "" until dispatch,
+    # then "wholesale" (stock path: every chunk assigned at dispatch) or
+    # "chunked" (chunk plan held centrally, granted incrementally).
+    qos_mode: str = ""
+    chunk_bounds: list = None      # chunked mode: [(lo, up_excl), ...]
+    next_chunk: int = 0            # chunked mode: first ungranted idx
+    granted_chunks: int = 0        # chunks handed to miners so far
 
     def __post_init__(self):
         # Every Request carries a trace from birth, even when constructed
@@ -293,7 +341,8 @@ class Scheduler:
     def __init__(self, server: AsyncServer,
                  lease: Optional[LeaseParams] = None,
                  cache: Optional[CacheParams] = None,
-                 stripe: Optional[StripeParams] = None):
+                 stripe: Optional[StripeParams] = None,
+                 qos: Optional[QosParams] = None):
         self.server = server
         self.lease = lease if lease is not None else LeaseParams()
         self.cache = cache if cache is not None else CacheParams()
@@ -301,12 +350,20 @@ class Scheduler:
         # leg (DBM_STRIPE=0) exercises the Go-parity split through every
         # existing harness without threading a parameter into each test.
         self.stripe = stripe if stripe is not None else stripe_from_env()
+        # Env-defaulted like stripe: DBM_QOS=0 pins the stock FIFO path
+        # through every existing harness (the tier-1 matrix leg).
+        self.qos = qos if qos is not None else qos_from_env()
         self.results: Optional[ResultCache] = (
             ResultCache(self.cache.size) if self.cache.enabled else None)
         self.miners: list[MinerState] = []      # join order, like minersArray
         self.parked: list[Chunk] = []           # chunks of dropped miners
         self.queue: list[Request] = []
-        self.current: Optional[Request] = None
+        # In-flight requests by job_id, oldest first (dict preserves
+        # insertion order). The stock FIFO path keeps AT MOST ONE entry
+        # — the reference's one-request-in-flight invariant — while the
+        # QoS plane runs several concurrently; ``current`` (below) stays
+        # the single-request view every existing caller reads.
+        self._inflight: dict[int, Request] = {}
         self._next_job_id = 0
         self._pool_rate: Optional[float] = None   # pool-wide throughput EWMA
         self._dispatching = False                 # _maybe_dispatch guard
@@ -339,6 +396,25 @@ class Scheduler:
                                                     OCCUPANCY_BUCKETS)
         self.traces = TraceBuffer()
         self._cache_trace_seq = 0
+        # Fair-share QoS plane (ISSUE 5): always constructed (tenant
+        # accounting is a few dicts), consulted only when qos.enabled.
+        self.qos_plane = QosPlane(self.metrics)
+        self._tenant_weights: dict = {}    # programmatic overrides
+
+    # ---------------------------------------------------------- public view
+
+    @property
+    def current(self) -> Optional[Request]:
+        """The OLDEST in-flight request, or None. Under the stock FIFO
+        path this is the reference's single in-flight request; under QoS
+        several may be in flight — callers that need them all read
+        :attr:`inflight`."""
+        return next(iter(self._inflight.values()), None)
+
+    @property
+    def inflight(self) -> dict:
+        """Read-only view of every in-flight request by job id."""
+        return dict(self._inflight)
 
     # ------------------------------------------------------- stats / metrics
 
@@ -426,6 +502,15 @@ class Scheduler:
                 if self.lease.enabled:
                     self._check_leases()
                 self._check_queue_age()
+                if self.qos.enabled:
+                    # Idle-tenant GC: a tenant with no queued or in-flight
+                    # work, nothing granted outstanding, and a full
+                    # admission bucket carries no state worth keeping —
+                    # dropping it frees its metric series so conn churn
+                    # stays bounded over a long server life.
+                    self.qos_plane.gc(
+                        {r.conn_id for r in self.queue}
+                        | {r.conn_id for r in self._inflight.values()})
             except Exception:   # noqa: BLE001 — the sweep must never die
                 logger.exception("lease sweep failed; continuing")
 
@@ -451,9 +536,26 @@ class Scheduler:
                           lower=msg.lower, upper=msg.upper,
                           target=msg.target, cache_key=key,
                           queued_at=time.monotonic())
+        if self.qos.enabled:
+            # Admission (cache replays above never reach here — an
+            # already-answered retry must not burn quota, ISSUE 5
+            # satellite). A drained bucket sheds the NEW request;
+            # overload sheds the OLDEST queued one (their client is
+            # nearest its own deadline; shedding it now gives its
+            # backed-off resubmission the best chance of landing in a
+            # drained queue).
+            self.qos_plane.tenant(conn_id, self._weight_for(conn_id),
+                                  self.qos.rate, self.qos.burst)
+            if not self.qos_plane.admit(conn_id):
+                self._shed(request, "admission")
+                return
         request.trace.event("enqueue", queue_depth=len(self.queue))
         self.queue.append(request)
         self._queue_depth.set(len(self.queue))
+        if self.qos.enabled and self.qos.max_queued > 0:
+            while len(self.queue) > self.qos.max_queued:
+                self._shed(self.queue.pop(0), "overload")
+            self._queue_depth.set(len(self.queue))
         self._maybe_dispatch()
 
     def _trace_cache_replay(self, conn_id: int, key, h: int,
@@ -503,11 +605,14 @@ class Scheduler:
             parked = self._next_parked(skip_key=(chunk.job_id, chunk.idx))
             if parked is not None:
                 self._assign_chunk(miner, parked, kind="parked")
-        curr = self.current
-        if curr is None or chunk.job_id != curr.job_id:
+        curr = self._inflight.get(chunk.job_id)
+        if curr is None:
             stale = self.traces.get(chunk.job_id)
             if stale is not None:
                 stale.event("stale_result", miner=conn_id, idx=chunk.idx)
+            # A freed miner may unblock a queued/ungranted chunk.
+            if self.qos.enabled:
+                self._maybe_dispatch()
             return  # stale Result for a cancelled/finished request
         if curr.answered[chunk.idx]:
             # Loser of a speculative re-issue race: another assignment of
@@ -520,11 +625,16 @@ class Scheduler:
             logger.info("duplicate Result for job %d chunk %d from miner %d "
                         "(speculation loser)", curr.job_id, chunk.idx,
                         conn_id)
+            if self.qos.enabled:
+                # The duplicate still freed a live-FIFO slot on this miner.
+                self._maybe_dispatch()
             return
         if msg.hash < curr.min_hash:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
         curr.answered[chunk.idx] = True
+        if self.qos.enabled:
+            self.qos_plane.on_chunk_answered(curr.conn_id)
         curr.trace.event("result", miner=conn_id, idx=chunk.idx)
         curr.trace.event("merge", idx=chunk.idx,
                          answered=sum(curr.answered))
@@ -546,11 +656,15 @@ class Scheduler:
                 nonce, q_hash = curr.chunk_q[c]
                 self._finish(curr, q_hash, nonce, early=True)
                 return
-        if all(curr.answered):
+        if curr.answered and all(curr.answered):
             # Full barrier: stock request, or target missed everywhere —
             # the exact arg-min. (A difficulty hit always releases above:
             # at the barrier, its qualifying prefix is trivially complete.)
             self._finish(curr, curr.min_hash, curr.min_nonce)
+        elif self.qos.enabled:
+            # The answering miner freed a live-FIFO slot: grant the next
+            # chunk (this request's or another tenant's, per DRR).
+            self._maybe_dispatch()
 
     def _on_drop(self, conn_id: int) -> None:
         miner = self._find_miner(conn_id)
@@ -564,26 +678,28 @@ class Scheduler:
             # bound over a long server life.
             self.metrics.remove("miner_rate_nps", miner=str(conn_id))
             self.metrics.remove("lease_remaining_s", miner=str(conn_id))
-            curr = self.current
-            if curr is None:
+            if not self._inflight:
                 return
-            curr.trace.event("miner_drop", miner=conn_id)
-            # Recover every unanswered chunk of the current request
-            # (ref: server.go:326-376, single-chunk version). Chunks whose
-            # idx already merged (speculation winner landed first) and
-            # chunks with a live speculative copy in another FIFO need no
-            # recovery — the copy is tracked independently.
+            for req in self._inflight.values():
+                req.trace.event("miner_drop", miner=conn_id)
+            # Recover every unanswered chunk of each in-flight request
+            # (ref: server.go:326-376, single-chunk version; the stock
+            # FIFO path has exactly one). Chunks whose idx already merged
+            # (speculation winner landed first) and chunks with a live
+            # speculative copy in another FIFO need no recovery — the
+            # copy is tracked independently.
             for chunk in miner.pending:
-                if chunk.job_id != curr.job_id or chunk.cancelled:
+                req = self._inflight.get(chunk.job_id)
+                if req is None or chunk.cancelled:
                     continue
-                if curr.answered[chunk.idx] or chunk.reissued:
+                if req.answered[chunk.idx] or chunk.reissued:
                     continue
                 takeover = next((m for m in self._eligible()), None)
                 if takeover is not None:
                     self._assign_chunk(takeover, chunk, kind="recovered")
                 else:
                     self.parked.append(chunk)
-                    curr.trace.event("park", idx=chunk.idx)
+                    req.trace.event("park", idx=chunk.idx)
         else:
             logger.info("client %d dropped", conn_id)
             # Purge the dead client's queued requests FIRST so cancelling its
@@ -593,11 +709,13 @@ class Scheduler:
                     req.trace.event("cancel", reason="client_drop")
             self.queue = [r for r in self.queue if r.conn_id != conn_id]
             self._queue_depth.set(len(self.queue))
-            curr = self.current
-            if curr is not None and curr.conn_id == conn_id:
+            if self.qos.enabled:
+                self.qos_plane.forget(conn_id)
+            for req in [r for r in self._inflight.values()
+                        if r.conn_id == conn_id]:
                 # Cancel immediately (divergence, see module docstring).
-                curr.trace.event("cancel", reason="client_drop")
-                self._retire()
+                req.trace.event("cancel", reason="client_drop")
+                self._retire(req)
 
     # -------------------------------------------------------------- internal
 
@@ -622,31 +740,37 @@ class Scheduler:
             curr.lower, curr.upper, curr.num_chunks,
             " (prefix release)" if early else "",
             " (weak merge)" if curr.weak else "")
-        self._retire()
+        self._retire(curr)
 
-    def _retire(self) -> None:
-        """Retire the in-flight request and start the next.
+    def _retire(self, curr: Request) -> None:
+        """Retire one in-flight request and pump the queue.
 
         Any still-pending chunks of the retiring job (prefix release,
         client drop, or the unanswered losers of speculative re-issues at
         a full-barrier finish) are marked cancelled: the pool frees
         immediately (availability is derived), the FIFO pop discipline for
         their late Results is preserved (they drop at the job_id check),
-        and parked chunks — which can only belong to the job in flight —
-        are discarded."""
-        curr = self.current
+        and the job's parked chunks are discarded. Under QoS the tenant's
+        in-flight slots for granted-but-unanswered chunks are released
+        and any UNGRANTED chunks simply evaporate (a difficulty prefix
+        release on a chunked elephant skips their scans entirely)."""
         for m in self.miners:
             for c in m.pending:
                 if c.job_id == curr.job_id:
                     c.cancelled = True
-        self.parked.clear()
-        self.current = None
-        # No live leases remain: clear the remaining-lease gauges so an
-        # idle system's snapshot doesn't keep reporting the retired job's
-        # last sweep values as work in flight.
-        for m in self.miners:
-            self.metrics.remove("lease_remaining_s", miner=str(m.conn_id))
-        self._lease_min_remaining.set(0.0)
+        self.parked = [c for c in self.parked if c.job_id != curr.job_id]
+        self._inflight.pop(curr.job_id, None)
+        if self.qos.enabled:
+            self.qos_plane.release(
+                curr.conn_id, curr.granted_chunks - sum(curr.answered))
+        if not self._inflight:
+            # No live leases remain: clear the remaining-lease gauges so
+            # an idle system's snapshot doesn't keep reporting the
+            # retired job's last sweep values as work in flight.
+            for m in self.miners:
+                self.metrics.remove("lease_remaining_s",
+                                    miner=str(m.conn_id))
+            self._lease_min_remaining.set(0.0)
         self._maybe_dispatch()
 
     def _find_miner(self, conn_id: int) -> Optional[MinerState]:
@@ -662,11 +786,10 @@ class Scheduler:
         re-issue landed first) — or whose ``(job_id, idx)`` matches
         ``skip_key``, the assignment the caller is answering right now —
         would only burn a full scan to pop as a duplicate."""
-        curr = self.current
         while self.parked:
             chunk = self.parked.pop(0)
-            if curr is None or chunk.job_id != curr.job_id or \
-                    curr.answered[chunk.idx]:
+            req = self._inflight.get(chunk.job_id)
+            if req is None or req.answered[chunk.idx]:
                 continue
             if skip_key is not None and \
                     (chunk.job_id, chunk.idx) == skip_key:
@@ -696,59 +819,26 @@ class Scheduler:
                                           -(m.rate_ewma or 0.0)))]
 
     def _maybe_dispatch(self) -> None:
-        """Start the next queued request when the pool can take one.
+        """Start queued work when the pool can take it: the stock FIFO
+        pump (one wholesale request at a time), or the QoS grant pump.
 
         Re-entrancy guard: an empty-range request finishes INSIDE its own
         dispatch (_load_balance -> _finish -> _retire -> here), so without
         the guard a burst of empty-range requests would recurse one stack
         frame set per request and overflow; with it, the inner call
-        returns immediately and the OUTER while loop drains the queue
+        returns immediately and the OUTER pump loop drains the queue
         iteratively."""
         if self._dispatching:
             return
         self._dispatching = True
         try:
-            while self.current is None and self.queue:
-                pool = self._eligible()
-                desperate = False
-                if not pool:
-                    pool = self._desperation_pool()
-                    if not pool:
-                        break
-                    desperate = True
-                req = self.queue.pop(0)
-                self._queue_depth.set(len(self.queue))
-                if self.results is not None and req.cache_key is not None:
-                    hit = self._cache_lookup(req.cache_key,
-                                             count_miss=False)
-                    if hit is not None:
-                        # A duplicate that queued BEHIND its original
-                        # (retry raced the still-in-flight first copy)
-                        # replays at pop time: the original finished and
-                        # stored while this one waited. The request's OWN
-                        # trace is completed and registered (under a
-                        # cache:N key — it never gets a job id) so the
-                        # real queue wait stays on record.
-                        self._write(req.conn_id, new_result(*hit))
-                        self._count("results_sent")
-                        self._queue_wait.observe(
-                            time.monotonic() - req.queued_at)
-                        req.trace.event("cache_hit", at="dispatch")
-                        req.trace.event("reply", hash=hit[0], nonce=hit[1],
-                                        cached=True)
-                        self._cache_trace_seq += 1
-                        self.traces.register(
-                            f"cache:{self._cache_trace_seq}", req.trace)
-                        logger.info(
-                            "queued request %r [%d, %d] answered from "
-                            "the result cache at dispatch", req.data,
-                            req.lower, req.upper)
-                        continue
-                self._load_balance(req, pool, desperate=desperate)
-                self._starved = False
+            if self.qos.enabled:
+                self._qos_pump()
+            else:
+                self._fifo_pump()
         finally:
             self._dispatching = False
-        if self.current is None and self.queue and not self._eligible():
+        if not self._inflight and self.queue and not self._eligible():
             # A dispatch pass found work but no taker: latch so the
             # condition logs once per starvation episode (every later
             # event re-enters here until a miner joins/frees/answers),
@@ -767,6 +857,317 @@ class Scheduler:
         elif not self.queue:
             self._starved = False
 
+    def _fifo_pump(self) -> None:
+        """The stock dispatch loop: pop the queue head whenever nothing
+        is in flight — the reference's FIFO order, bit-for-bit."""
+        while not self._inflight and self.queue:
+            pool = self._eligible()
+            desperate = False
+            if not pool:
+                pool = self._desperation_pool()
+                if not pool:
+                    break
+                desperate = True
+            req = self.queue.pop(0)
+            self._queue_depth.set(len(self.queue))
+            if self._replay_at_dispatch(req):
+                continue
+            self._load_balance(req, pool, desperate=desperate)
+            self._starved = False
+
+    def _replay_at_dispatch(self, req: Request) -> bool:
+        """Dispatch-time memo re-check: a duplicate that queued BEHIND
+        its original (retry raced the still-in-flight first copy) replays
+        at pop time — the original finished and stored while this one
+        waited. The request's OWN trace is completed and registered
+        (under a cache:N key — it never gets a job id) so the real queue
+        wait stays on record. True = replayed (the caller drops it)."""
+        if self.results is None or req.cache_key is None:
+            return False
+        hit = self._cache_lookup(req.cache_key, count_miss=False)
+        if hit is None:
+            return False
+        self._write(req.conn_id, new_result(*hit))
+        self._count("results_sent")
+        self._queue_wait.observe(time.monotonic() - req.queued_at)
+        req.trace.event("cache_hit", at="dispatch")
+        req.trace.event("reply", hash=hit[0], nonce=hit[1], cached=True)
+        self._cache_trace_seq += 1
+        self.traces.register(f"cache:{self._cache_trace_seq}", req.trace)
+        logger.info(
+            "queued request %r [%d, %d] answered from "
+            "the result cache at dispatch", req.data,
+            req.lower, req.upper)
+        return True
+
+    # ------------------------------------------------------------ QoS plane
+
+    def _tenant(self, conn_id):
+        """The QoS tenant state for a conn, created with the configured
+        weight and admission bucket on first sight."""
+        return self.qos_plane.tenant(conn_id, self._weight_for(conn_id),
+                                     self.qos.rate, self.qos.burst)
+
+    def _weight_for(self, tenant) -> float:
+        w = self._tenant_weights.get(tenant)
+        return w if w is not None else self.qos.weight_for(tenant)
+
+    def set_tenant_weight(self, tenant, weight: float) -> None:
+        """Programmatic per-tenant DRR weight override (tests and
+        embedded drivers; the env path is ``DBM_QOS_WEIGHTS``)."""
+        self._tenant_weights[tenant] = max(weight, 1e-3)
+        self.qos_plane.set_weight(tenant, weight)
+
+    def _miner_live(self, miner: MinerState) -> int:
+        """Live (non-cancelled) chunks in a miner's pending FIFO."""
+        return sum(1 for c in miner.pending if not c.cancelled)
+
+    def _qos_capacity_pool(self) -> list[MinerState]:
+        """Miners that may take an incremental QoS chunk: not
+        quarantined, below the per-miner live-FIFO cap, and not sitting
+        on a blown-lease chunk (a wedged miner's blown original stays
+        live in its FIFO awaiting the in-order pop — the stock path's
+        ``available`` never feeds such a miner either, and a mouse
+        granted behind it would stall a full lease period), least-loaded
+        first (ties keep join order — the reference's assignment
+        order)."""
+        depth = self.qos.depth
+        pool = [m for m in self.miners
+                if not m.quarantined and self._miner_live(m) < depth
+                and not any(c.lease_blown and not c.cancelled
+                            for c in m.pending)]
+        pool.sort(key=self._miner_live)
+        return pool
+
+    def _qos_est_s(self, req: Request) -> Optional[float]:
+        """Estimated pool-seconds to scan ``req``; None on a cold pool."""
+        total = req.upper - req.lower + 1    # still inclusive pre-dispatch
+        if total <= 0:
+            return 0.0
+        if self._pool_rate is None or self._pool_rate <= 0:
+            return None
+        n = max(1, len(self._eligible()) or len(self.miners) or 1)
+        return total / (self._pool_rate * n)
+
+    def _qos_small(self, req: Request) -> bool:
+        """Small enough for the stock wholesale dispatch: the estimated
+        scan fits ``wholesale_s``, or the pool is cold (no throughput
+        observed — wholesale preserves reference parity for first
+        requests, exactly like the striping plane's cold fallback)."""
+        est = self._qos_est_s(req)
+        return est is None or est <= self.qos.wholesale_s
+
+    def _qos_chunk_plan(self, total: int, pool_n: int) -> tuple[int, int]:
+        """``(n_chunks, first_chunk_size)`` for a chunked activation of
+        ``total`` nonces: chunks sized at ``chunk_s`` seconds of one
+        miner's pool-EWMA work, capped at ``max_chunks`` (a request too
+        large for the cap gets proportionally larger chunks); an even
+        split over ``pool_n`` when cold. Shared by the activation (the
+        actual plan) and the DRR head cost (what one grant will debit) —
+        the two MUST agree, or a chunked start banks the whole request's
+        cost as unearned deficit and starves every other tenant."""
+        rate = self._pool_rate if self._pool_rate else 0.0
+        if rate > 0:
+            n = -(-total // max(1, int(rate * self.qos.chunk_s)))
+        else:
+            n = max(1, pool_n)
+        n = max(1, min(self.qos.max_chunks, n, total))
+        return n, total // n + (1 if total % n else 0)
+
+    def _qos_heads(self) -> dict:
+        """Each tenant's next grantable work item:
+        ``{tenant: (kind, request, cost_nonces)}``.
+
+        - ``("chunk", req, n)`` — the next ungranted chunk of the
+          tenant's oldest chunked in-flight request.
+        - ``("start", req, n)`` — the tenant's oldest queued request
+          (tenants serve their own requests FIFO; fairness is across
+          tenants). Starts are withheld while a WHOLESALE request is in
+          flight — that is the stock one-at-a-time order, which keeps
+          single-tenant and small-request traffic bit-identical to the
+          FIFO scheduler — but flow freely alongside chunked requests.
+
+        Tenants at their ``max_inflight`` cap are skipped.
+        """
+        heads: dict = {}
+        cap = self.qos.max_inflight
+        any_chunked = any(r.qos_mode == "chunked"
+                          for r in self._inflight.values())
+        for req in self._inflight.values():     # oldest first
+            if req.qos_mode != "chunked" or \
+                    req.next_chunk >= req.num_chunks:
+                continue
+            t = req.conn_id
+            if t in heads:
+                continue
+            if cap > 0 and self._tenant(t).inflight >= cap:
+                continue
+            lo, up = req.chunk_bounds[req.next_chunk]
+            heads[t] = ("chunk", req, up - lo)
+        busy = {r.conn_id for r in self._inflight.values()}
+        for req in self.queue:
+            if self._inflight and not any_chunked:
+                break               # wholesale in flight: stock FIFO wait
+            t = req.conn_id
+            if t in heads or t in busy:
+                continue
+            if cap > 0 and self._tenant(t).inflight >= cap:
+                continue
+            # The head COST is what granting it will actually DEBIT —
+            # the same branch the pump executes: the whole range for a
+            # start that will dispatch wholesale (nothing in flight and
+            # small — every chunk is assigned at dispatch), but only the
+            # FIRST planned chunk for one that will activate chunked.
+            # Pricing a to-be-chunked start at its full 2^40 range banks
+            # the difference as unearned deficit, and quantum (the max
+            # candidate cost) balloons with it — one mispriced start
+            # then outbids every tenant for the rest of its life.
+            total = max(1, req.upper - req.lower + 1)
+            if not self._inflight and self._qos_small(req):
+                cost = total
+            else:
+                _, cost = self._qos_chunk_plan(
+                    total, len(self.miners) or 1)
+            heads[t] = ("start", req, cost)
+        return heads
+
+    def _qos_pump(self) -> None:
+        """The QoS grant loop: while grantable work and pool capacity
+        exist, pick the next tenant by deficit-round-robin and execute
+        ONE grant — an incremental chunk, a chunked activation, or a
+        stock wholesale dispatch for small/cold requests."""
+        plane = self.qos_plane
+        # Classic DRR: a tenant whose backlog empties forfeits its
+        # accumulated deficit — idle time must not bank credit. Backlog =
+        # a queued request or an in-flight chunked request with ungranted
+        # chunks (NOT merely capacity-blocked tenants, which keep theirs).
+        backlogged = {r.conn_id for r in self.queue} | {
+            r.conn_id for r in self._inflight.values()
+            if r.qos_mode == "chunked" and r.next_chunk < r.num_chunks}
+        for t, st in plane.tenants.items():
+            if t not in backlogged:
+                st.deficit = 0.0
+        while True:
+            heads = self._qos_heads()
+            if not heads:
+                break
+            eligible = self._eligible()
+            cap_pool = self._qos_capacity_pool()
+            candidates = {}
+            for t, (kind, req, cost) in heads.items():
+                if kind == "chunk":
+                    if cap_pool:
+                        candidates[t] = cost
+                elif not self._inflight and self._qos_small(req):
+                    # Wholesale start: needs the stock eligibility (or
+                    # the desperation fallback), exactly like the FIFO
+                    # pump.
+                    if eligible or self._desperation_pool():
+                        candidates[t] = cost
+                elif cap_pool:
+                    candidates[t] = cost
+            if not candidates:
+                break
+            t = plane.pick(candidates)
+            kind, req, cost = heads[t]
+            if kind == "chunk":
+                self._qos_grant(req, cap_pool)
+                continue
+            self.queue.remove(req)
+            self._queue_depth.set(len(self.queue))
+            if self._replay_at_dispatch(req):
+                continue
+            if not self._inflight and self._qos_small(req):
+                pool, desperate = self._eligible(), False
+                if not pool:
+                    pool, desperate = self._desperation_pool(), True
+                self._load_balance(req, pool, desperate=desperate)
+            else:
+                self._qos_activate(req, cap_pool)
+            self._starved = False
+
+    def _qos_activate(self, req: Request, pool: list[MinerState]) -> None:
+        """Activate a request in CHUNKED mode: plan contiguous ascending
+        chunks sized at ``chunk_s`` seconds of pool-EWMA work (capped at
+        ``max_chunks``; an even split over the capacity pool when cold)
+        and grant the first one. Later chunks are granted by subsequent
+        pump turns, so concurrent tenants' chunks interleave."""
+        self._next_job_id += 1
+        req.job_id = self._next_job_id
+        req.qos_mode = "chunked"
+        req.started = time.monotonic()
+        self._queue_wait.observe(req.started - req.queued_at)
+        self.traces.register(req.job_id, req.trace)
+        self._inflight[req.job_id] = req
+        req.upper += 1  # inclusive -> exclusive
+        total = req.upper - req.lower
+        req.trace.event("dispatch", job=req.job_id, mode="chunked",
+                        miners=[m.conn_id for m in pool])
+        if total <= 0:
+            # Empty/inverted range, same answer as the wholesale path.
+            self._finish(req, MAX_U64, 0)
+            return
+        n, _ = self._qos_chunk_plan(total, len(pool))
+        bounds = []
+        base = req.lower
+        size, rem = divmod(total, n)
+        for i in range(n):
+            step = size + (1 if i < rem else 0)
+            bounds.append((base, base + step))
+            base += step
+        req.chunk_bounds = bounds
+        req.num_chunks = n
+        req.answered = [False] * n
+        req.next_chunk = 0
+        self._qos_grant(req, pool)
+
+    def _qos_grant(self, req: Request, pool: list[MinerState]) -> None:
+        """Hand the request's next planned chunk to the least-loaded
+        capacity miner and account the grant with the DRR plane."""
+        miner = pool[0]
+        idx = req.next_chunk
+        lo, up = req.chunk_bounds[idx]
+        req.next_chunk += 1
+        req.granted_chunks += 1
+        self._count("qos_grants")
+        self.qos_plane.on_grant(req.conn_id, up - lo)
+        self._assign_chunk(
+            miner, Chunk(req.job_id, req.data, lo, up,
+                         target=req.target, idx=idx), kind="qos")
+
+    def _shed(self, req: Request, reason: str) -> None:
+        """Shed one request under admission/overload pressure: cancel it
+        through the trace/cancel path and CLOSE its conn. Classic LSP has
+        no reject message, so the conn close is the signal — the client's
+        transport declares the conn dead within its epoch window and
+        ``submit_with_retry`` backs off and resubmits, instead of hanging
+        into its wire deadline. The tenant's other QUEUED requests ride
+        the same dying conn and are purged with it (in-flight work
+        finishes; its reply write fails harmlessly)."""
+        victims = [req] + [r for r in self.queue
+                           if r.conn_id == req.conn_id and r is not req]
+        self.queue = [r for r in self.queue if r.conn_id != req.conn_id]
+        self._queue_depth.set(len(self.queue))
+        for i, victim in enumerate(victims):
+            self._count("qos_shed")
+            self.qos_plane.on_shed(victim.conn_id,
+                                   reason if i == 0 else "conn")
+            victim.trace.event("cancel", reason="shed", shed_reason=reason)
+            self._cache_trace_seq += 1
+            self.traces.register(f"shed:{self._cache_trace_seq}",
+                                 victim.trace)
+        logger.warning(
+            "QoS shed (%s): request %r [%d, %d] from tenant %d "
+            "(+%d queued sibling(s)); closing its conn so the client "
+            "backs off and resubmits", reason, req.data, req.lower,
+            req.upper, req.conn_id, len(victims) - 1)
+        close = getattr(self.server, "close_conn", None)
+        if close is not None:
+            try:
+                close(req.conn_id)
+            except Exception:  # noqa: BLE001 — conn may already be gone
+                logger.info("shed: conn %d already closed", req.conn_id)
+
     def _load_balance(self, request: Request, pool: list[MinerState],
                       desperate: bool = False) -> None:
         """Split the range over ``pool`` (the eligible miners, or the
@@ -776,9 +1177,10 @@ class Scheduler:
         request in flight, so every miner is free at dispatch); quarantined
         or still-busy miners (wedged compute holding a live lease-blown
         chunk) are excluded."""
-        self.current = request
         self._next_job_id += 1
         request.job_id = self._next_job_id
+        request.qos_mode = "wholesale"
+        self._inflight[request.job_id] = request
         request.started = time.monotonic()
         self._queue_wait.observe(request.started - request.queued_at)
         self.traces.register(request.job_id, request.trace)
@@ -831,6 +1233,15 @@ class Scheduler:
             self._count("chunks_striped", len(plan) - num)
         request.num_chunks = len(plan)
         request.answered = [False] * len(plan)
+        request.granted_chunks = len(plan)
+        if self.qos.enabled:
+            # Wholesale chunks count against the tenant's in-flight cap
+            # and grant share like incremental ones — an elephant that
+            # slipped through wholesale (cold pool) still pays its DRR
+            # deficit, so later contended rounds stay fair.
+            self._tenant(request.conn_id)
+            for _, lo, up in plan:
+                self.qos_plane.on_grant(request.conn_id, up - lo)
         for idx, (miner, lo, up) in enumerate(plan):
             self._assign_chunk(
                 miner,
@@ -898,29 +1309,58 @@ class Scheduler:
         chunk.deadline = now + self._lease_for(miner, chunk)
         chunk.lease_started = True
 
+    #: Wall-clock span one throughput sample must cover (window-union
+    #: accounting, the scheduler-side analog of the miner's
+    #: _ThroughputWindow from ISSUE 4).
+    RATE_WINDOW_S = 0.5
+
     def _observe_result(self, miner: MinerState, chunk: Chunk) -> None:
-        """Per-pop bookkeeping: throughput EWMA, streak reset, quarantine
-        lift. Runs for EVERY pop — stale and cancelled chunks were computed
-        too, so they are valid throughput samples, and an answer is an
-        answer for quarantine purposes ("until it answers again")."""
+        """Per-pop bookkeeping: throughput sampling, streak reset,
+        quarantine lift. Runs for EVERY pop — stale and cancelled chunks
+        were computed too, so they are valid throughput samples, and an
+        answer is an answer for quarantine purposes ("until it answers
+        again").
+
+        Throughput is sampled over a WALL-CLOCK WINDOW per miner, not per
+        pop: the pipelined miner computes chunk k+1 while k's result is
+        in flight, so k+1's Result arrives milliseconds after its lease
+        re-stamp and a per-pop size/elapsed sample reads as 10^9
+        nonces/s — which then poisons every consumer (stripe plans grow
+        one-giant-chunk, the QoS wholesale gate misclassifies elephants,
+        leases collapse to the floor). Accumulating answered nonces until
+        ``RATE_WINDOW_S`` of wall clock has passed measures the miner's
+        true OUTPUT rate regardless of internal overlap."""
         alpha = self.lease.ewma_alpha
+        now = time.monotonic()
         if chunk.assigned_at and not chunk.lease_blown and not chunk.target:
-            # Two exclusions keep the sample set honest. Blown-lease
-            # answers: a wedged miner's eventual 60s "sample" would
-            # inflate its (and the pool's) lease to minutes and blunt
-            # re-wedge detection. Difficulty chunks: an in-kernel early
-            # exit may scan 1% of the range, so size/elapsed would
-            # overestimate throughput ~100x and starve every later
-            # stock chunk's lease.
-            elapsed = max(time.monotonic() - chunk.assigned_at, 1e-6)
-            rate = chunk.size / elapsed
-            miner.rate_ewma = rate if miner.rate_ewma is None else \
-                alpha * rate + (1 - alpha) * miner.rate_ewma
-            self._pool_rate = rate if self._pool_rate is None else \
-                alpha * rate + (1 - alpha) * self._pool_rate
-            self.metrics.gauge("miner_rate_nps",
-                               miner=str(miner.conn_id)).set(miner.rate_ewma)
-            self.metrics.gauge("pool_rate_nps").set(self._pool_rate)
+            # Two exclusions keep the sample set honest (they also RESET
+            # the window below). Blown-lease answers: a wedged miner's
+            # eventual 60s "sample" would inflate its (and the pool's)
+            # lease to minutes and blunt re-wedge detection. Difficulty
+            # chunks: an in-kernel early exit may scan 1% of the range,
+            # so size/elapsed would overestimate throughput ~100x and
+            # starve every later stock chunk's lease.
+            if miner.win_nonces == 0 \
+                    or now - miner.win_t0 > 4 * self.RATE_WINDOW_S:
+                # Fresh (or stale — an idle gap must not deflate the
+                # sample) window, anchored at this chunk's lease start.
+                miner.win_t0 = chunk.assigned_at or now
+                miner.win_nonces = 0
+            miner.win_nonces += chunk.size
+            elapsed = now - miner.win_t0
+            if elapsed >= self.RATE_WINDOW_S:
+                rate = miner.win_nonces / elapsed
+                miner.win_t0, miner.win_nonces = now, 0
+                miner.rate_ewma = rate if miner.rate_ewma is None else \
+                    alpha * rate + (1 - alpha) * miner.rate_ewma
+                self._pool_rate = rate if self._pool_rate is None else \
+                    alpha * rate + (1 - alpha) * self._pool_rate
+                self.metrics.gauge(
+                    "miner_rate_nps",
+                    miner=str(miner.conn_id)).set(miner.rate_ewma)
+                self.metrics.gauge("pool_rate_nps").set(self._pool_rate)
+        else:
+            miner.win_t0, miner.win_nonces = 0.0, 0
         miner.blown_streak = 0
         if miner.quarantined:
             miner.quarantined = False
@@ -942,64 +1382,90 @@ class Scheduler:
         return max(self.lease.floor_s, chunk.size / rate * self.lease.factor)
 
     def _check_queue_age(self) -> None:
-        """Age alarms (ROADMAP open item + ISSUE 3): a request still QUEUED
-        past ``lease.queue_alarm_s`` — or still IN FLIGHT past the same
-        bound — emits a structured warning, once per bound interval per
-        request, plus a full trace dump so the stall explains itself (a
-        queued request's stall is usually the in-flight request's wedged
-        miner, so its trace is dumped alongside). Observability only:
-        never changes scheduling."""
+        """Age alarms (ROADMAP open item + ISSUE 3; per-tenant since
+        ISSUE 5): the OLDEST queued request of each TENANT past
+        ``lease.queue_alarm_s`` — and any request still IN FLIGHT past the
+        same bound — emits a structured warning, once per bound interval
+        per request, plus a full trace dump so the stall explains itself
+        (a queued request's stall is usually an in-flight request's wedged
+        miner, so the oldest in-flight trace is dumped alongside).
+
+        The alarm and its dump carry the tenant's cumulative GRANT SHARE,
+        so a starved mouse (near-zero share despite backlog) is
+        distinguishable from a busy elephant (large share, long queue by
+        its own volume). Observability only: never changes scheduling."""
         bound = self.lease.queue_alarm_s
         if bound <= 0:
             return
         now = time.monotonic()
         curr = self.current
         queue_alarmed = False
+        # Oldest queued request per tenant (queue is FIFO: first seen
+        # wins). Under the stock FIFO path every tenant still alarms on
+        # its own oldest request — the pre-ISSUE-5 behavior alarmed on
+        # every over-age request; per-tenant-oldest is strictly the more
+        # readable subset (later same-tenant requests are queued behind
+        # the alarmed one by definition).
+        oldest: dict = {}
         for req in self.queue:
+            oldest.setdefault(req.conn_id, req)
+        for req in oldest.values():
             age = now - req.queued_at
             if age < bound or now - req.last_alarm < bound:
                 continue
             req.last_alarm = now
             queue_alarmed = True
+            share = self.qos_plane.grant_share(req.conn_id)
             self._count("queue_alarms")
             logger.warning(
-                "request %r [%d, %d] from client %d queued for %.1fs "
-                "(bound %.1fs): pool=%d eligible=%d in_flight=%s",
-                req.data, req.lower, req.upper, req.conn_id, age, bound,
-                len(self.miners), len(self._eligible()),
-                curr is not None)
-            req.trace.event("queue_alarm", age_s=round(age, 3))
+                "tenant %d: oldest request %r [%d, %d] queued for %.1fs "
+                "(bound %.1fs): grant_share=%.3f pool=%d eligible=%d "
+                "in_flight=%d",
+                req.conn_id, req.data, req.lower, req.upper, age, bound,
+                share, len(self.miners), len(self._eligible()),
+                len(self._inflight))
+            req.trace.event("queue_alarm", age_s=round(age, 3),
+                            tenant=req.conn_id,
+                            grant_share=round(share, 4))
             self._dump_trace("queue-age alarm: stalled request", req.trace)
-        inflight_due = (curr is not None
-                        and now - curr.started >= bound
-                        and now - curr.last_inflight_alarm >= bound)
-        if queue_alarmed and curr is not None and not inflight_due:
-            # The in-flight request is the usual culprit; its trace is the
-            # same document for every stalled request, so dump it once per
-            # sweep — and not at all when the in-flight alarm below dumps
-            # the identical document anyway.
+        inflight_due = [
+            r for r in self._inflight.values()
+            if now - r.started >= bound
+            and now - r.last_inflight_alarm >= bound]
+        if queue_alarmed and curr is not None and curr not in inflight_due:
+            # An in-flight request is the usual culprit; the oldest one's
+            # trace is the same document for every stalled request, so
+            # dump it once per sweep — and not at all when the in-flight
+            # alarm below dumps the identical document anyway.
             self._dump_trace("queue-age alarm: request in flight "
                              "ahead of the stalled one", curr.trace)
-        if inflight_due:
-            age = now - curr.started
-            curr.last_inflight_alarm = now
+        for req in inflight_due:
+            age = now - req.started
+            req.last_inflight_alarm = now
+            share = self.qos_plane.grant_share(req.conn_id)
             self._count("inflight_alarms")
             logger.warning(
-                "request %d in flight for %.1fs (bound %.1fs): "
-                "%d/%d chunks answered",
-                curr.job_id, age, bound, sum(curr.answered),
-                curr.num_chunks)
-            curr.trace.event("inflight_alarm", age_s=round(age, 3))
-            self._dump_trace("in-flight age alarm", curr.trace)
+                "request %d (tenant %d) in flight for %.1fs (bound %.1fs): "
+                "%d/%d chunks answered, %d granted, grant_share=%.3f",
+                req.job_id, req.conn_id, age, bound, sum(req.answered),
+                req.num_chunks, req.granted_chunks, share)
+            req.trace.event("inflight_alarm", age_s=round(age, 3),
+                            tenant=req.conn_id,
+                            grant_share=round(share, 4))
+            self._dump_trace("in-flight age alarm", req.trace)
 
     def _check_leases(self) -> None:
         """One lease sweep: blow expired leases (quarantining repeat
         offenders) and speculatively re-issue each blown chunk to an
         eligible miner — first Result wins, the loser pops as a duplicate
         (``_on_result``). A blown chunk with no taker stays watched and is
-        re-issued on a later sweep once a miner frees up or joins."""
-        curr = self.current
-        if curr is None:
+        re-issued on a later sweep once a miner frees up or joins.
+
+        Every in-flight job is swept: the stock FIFO path has at most one,
+        but the QoS plane (ISSUE 5) runs several concurrently — a wedged
+        miner holding a mouse's chunk must blow even while an elephant's
+        chunks are also live."""
+        if not self._inflight:
             return
         now = time.monotonic()
         # Per-miner MINIMUM remaining lease (a deep budgeted chunk must not
@@ -1007,9 +1473,10 @@ class Scheduler:
         per_miner_remaining: dict[int, float] = {}
         for miner in list(self.miners):
             for chunk in list(miner.pending):
-                if chunk.cancelled or chunk.job_id != curr.job_id:
+                if chunk.cancelled:
                     continue
-                if curr.answered[chunk.idx]:
+                curr = self._inflight.get(chunk.job_id)
+                if curr is None or curr.answered[chunk.idx]:
                     continue
                 if not chunk.lease_blown:
                     if now < chunk.deadline:

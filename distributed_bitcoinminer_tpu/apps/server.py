@@ -12,17 +12,19 @@ import sys
 
 from ..lsp.params import Params
 from ..lsp.server import new_async_server
-from ..utils.config import CacheParams, LeaseParams, StripeParams
+from ..utils.config import CacheParams, LeaseParams, QosParams, StripeParams
 from .scheduler import Scheduler
 
 
 async def serve(port: int, params: Params | None = None,
                 lease: LeaseParams | None = None,
                 cache: CacheParams | None = None,
-                stripe: StripeParams | None = None) -> None:
+                stripe: StripeParams | None = None,
+                qos: QosParams | None = None) -> None:
     server = await new_async_server(port, params or Params())
     print("Server listening on port", server.port, flush=True)
-    scheduler = Scheduler(server, lease=lease, cache=cache, stripe=stripe)
+    scheduler = Scheduler(server, lease=lease, cache=cache, stripe=stripe,
+                          qos=qos)
     try:
         await scheduler.run()
     finally:
@@ -46,7 +48,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg = from_env()
     try:
         asyncio.run(serve(port, cfg.params, cfg.lease, cfg.cache,
-                          cfg.stripe))
+                          cfg.stripe, cfg.qos))
     except KeyboardInterrupt:
         pass
     return 0

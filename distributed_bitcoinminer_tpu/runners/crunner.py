@@ -30,6 +30,17 @@ async def run_client(args) -> None:
     except LspError as exc:
         print(f"Failed to connect to server at {hostport}: {exc}")
         return
+    # QoS tenant plumbing (ISSUE 5): tenancy is keyed off the conn id the
+    # server assigned this client — no wire change, so the id IS the
+    # tenant id. With --qos-weight the runner surfaces that id and the
+    # exact DBM_QOS_WEIGHTS fragment to export on the scheduler side
+    # (weights live with the scheduler, never on the wire). Gated on the
+    # flag so default stdout stays byte-compatible with the stock harness.
+    if args.qos_weight > 0:
+        print(f"Connected as tenant {client.conn_id()}", flush=True)
+        print(f"QoS weight {args.qos_weight:g}: export "
+              f"DBM_QOS_WEIGHTS={client.conn_id()}:{args.qos_weight:g} "
+              f"on the scheduler", flush=True)
     try:
         loop = asyncio.get_running_loop()
         while True:
@@ -60,6 +71,15 @@ def main(argv=None) -> int:
     parser = build_parser("crunner")
     parser.add_argument("--host", type=str, default="127.0.0.1",
                         help="server host address")
+    # Fair-share QoS plumbing (ISSUE 5): tenant identity is the conn id
+    # (printed after connect); the weight itself is scheduler-side
+    # configuration (DBM_QOS_WEIGHTS / Scheduler.set_tenant_weight), so
+    # the flag emits the mapping line for the operator.
+    parser.add_argument("--qos-weight", type=float, default=0.0,
+                        metavar="W", dest="qos_weight",
+                        help="intended DRR weight for this tenant "
+                             "(prints the scheduler-side DBM_QOS_WEIGHTS "
+                             "mapping; 0 = unset)")
     args = parser.parse_args(normalize_go_flags(argv, parser))
     if args.v:
         lspnet.enable_debug_logs(True)

@@ -78,6 +78,55 @@ Environment variables:
   overlap. A cold pool (no EWMA yet) always falls back to the stock
   one-chunk-per-miner split; ``DBM_STRIPE=0`` — or a non-positive
   ``DBM_STRIPE_CHUNK_S`` — pins that split unconditionally.
+- ``DBM_QOS`` (0 disables): the fair-share QoS dispatch plane
+  (apps/qos.py + apps/scheduler.py). With it on, the scheduler keys every
+  request to a TENANT (its client conn id — no wire change), admits
+  requests through a per-tenant token bucket, bounds total intake, and
+  drains tenants by deficit-round-robin at CHUNK granularity: a large
+  request whose estimated scan exceeds ``DBM_QOS_WHOLESALE_S`` is split
+  into EWMA-sized chunks held in the scheduler and granted to miners
+  incrementally (per-miner live FIFO capped at ``DBM_QOS_DEPTH``), so
+  concurrent tenants' chunks interleave across the pool instead of a
+  2^40 elephant parking every mouse behind its last chunk. Small or
+  cold-pool requests dispatch through the stock wholesale path, so
+  single-tenant traffic — and every request with ``DBM_QOS=0`` — keeps
+  today's FIFO dispatch order bit-for-bit.
+- ``DBM_QOS_CHUNK_S``: target seconds of work per QoS grant chunk, from
+  the pool throughput EWMA (default 1.0; <=0 disables chunking, pinning
+  the wholesale path like ``DBM_QOS=0`` but keeping admission/shedding).
+- ``DBM_QOS_MAX_CHUNKS``: upper bound on chunks planned per request
+  (default 4096); a request too large for ``chunk_s``-sized chunks under
+  the cap gets proportionally larger chunks.
+- ``DBM_QOS_DEPTH``: per-miner live-chunk cap for incremental grants
+  (default 2 — one computing, one prefetched so the miner dispatch
+  pipeline still overlaps).
+- ``DBM_QOS_WHOLESALE_S``: estimated-duration threshold below which a
+  request dispatches wholesale exactly like the stock scheduler (default
+  5.0 seconds; a cold pool — no throughput observed — always dispatches
+  wholesale, preserving reference parity for first requests).
+- ``DBM_QOS_MAX_QUEUED``: total queued-request bound (default 1024;
+  0 = unbounded). Above it the OLDEST queued request is shed: cancelled
+  through the trace/cancel path and its conn closed, so a
+  ``submit_with_retry`` client backs off and resubmits instead of
+  hanging into its wire deadline.
+- ``DBM_QOS_RATE`` / ``DBM_QOS_BURST``: per-tenant token-bucket
+  admission — ``rate`` requests/second refill (default 0 = admission
+  off) with ``burst`` capacity (default 8). A request arriving on an
+  empty bucket is shed at admission. ResultCache replays bypass the
+  bucket entirely: an already-answered retry never burns quota.
+- ``DBM_QOS_MAX_INFLIGHT``: per-tenant cap on granted-but-unanswered
+  chunks (default 256; 0 = unlimited).
+- ``DBM_QOS_WEIGHT_DEFAULT`` / ``DBM_QOS_WEIGHTS``: deficit-round-robin
+  weights. ``DBM_QOS_WEIGHTS`` is ``tenant:weight`` pairs separated by
+  commas (tenant = conn id as decimal string); everything else gets the
+  default (1.0). Programmatic drivers use
+  ``Scheduler.set_tenant_weight`` instead.
+- ``DBM_BENCH_QOS`` (0 disables) / ``DBM_BENCH_QOS_ROUNDS``: the bench's
+  mixed-load QoS probe (``bench.py detail.qos``; CPU-only): one elephant
+  plus a train of mice through a real localhost LSP stack, QoS off vs
+  on, legs interleaved per round and median-aggregated like
+  ``detail.pipeline``, recording mice p50/p99 reply latency and the
+  elephant's completion time.
 - ``DBM_BENCH_PROBE`` (0 disables): the bench's deadlined accelerator
   probe subprocess; 0 skips it entirely (trust ``JAX_PLATFORMS``) so
   chip-less boxes stop paying the init deadline every run.
@@ -309,6 +358,60 @@ class StripeParams:
 
 
 @dataclass(frozen=True)
+class QosParams:
+    """Fair-share QoS dispatch knobs (apps/qos.py + apps/scheduler.py).
+
+    Tenancy is the client conn id (no wire change). Three planes:
+
+    - **Fairness**: deficit-round-robin across tenants at chunk
+      granularity. A request estimated to scan longer than
+      ``wholesale_s`` (pool throughput EWMA) is split into
+      ``chunk_s``-seconds chunks (at most ``max_chunks``) held centrally
+      and granted to miners incrementally, each miner's live FIFO capped
+      at ``depth`` — so chunks of concurrent tenants interleave across
+      the pool. Smaller (or cold-pool) requests dispatch wholesale
+      through the stock path, which keeps single-tenant traffic — and
+      everything with ``enabled=False`` — bit-identical to the stock
+      FIFO scheduler.
+    - **Admission**: per-tenant token bucket (``rate`` requests/s refill,
+      ``burst`` capacity; rate 0 = off) plus a per-tenant cap of
+      ``max_inflight`` granted-but-unanswered chunks (0 = off).
+      ResultCache replays bypass admission entirely.
+    - **Shedding**: when more than ``max_queued`` requests are queued
+      (0 = unbounded), the OLDEST queued request is cancelled through
+      the trace/cancel path and its conn closed, so a retrying client
+      backs off and resubmits instead of hanging into its wire deadline.
+
+    ``weights`` maps tenant id strings to DRR weights (grant share is
+    proportional to weight under sustained contention); unlisted tenants
+    get ``default_weight``.
+    """
+    enabled: bool = True
+    chunk_s: float = 1.0           # target seconds of work per grant chunk
+    max_chunks: int = 4096         # chunk-plan cap per request
+    depth: int = 2                 # per-miner live chunks for QoS grants
+    wholesale_s: float = 5.0       # below this estimate: stock dispatch
+    max_queued: int = 1024         # total queued bound (0 = unbounded)
+    max_inflight: int = 256        # per-tenant granted-unanswered cap
+    rate: float = 0.0              # admission tokens/s (0 = admission off)
+    burst: float = 8.0             # admission bucket capacity
+    default_weight: float = 1.0
+    weights: tuple = ()            # ((tenant_id_str, weight), ...)
+
+    def __post_init__(self):
+        # chunk_s <= 0 pins the wholesale path (the repo-wide 0-disables
+        # convention) rather than planning zero-second chunks.
+        if self.chunk_s <= 0:
+            object.__setattr__(self, "wholesale_s", float("inf"))
+
+    def weight_for(self, tenant) -> float:
+        for key, w in self.weights:
+            if key == str(tenant):
+                return max(w, 1e-3)
+        return max(self.default_weight, 1e-3)
+
+
+@dataclass(frozen=True)
 class RetryParams:
     """Client submit-with-retry knobs (apps/client.py submit_with_retry).
 
@@ -337,6 +440,7 @@ class FrameworkConfig:
     retry: RetryParams = field(default_factory=RetryParams)
     cache: CacheParams = field(default_factory=CacheParams)
     stripe: StripeParams = field(default_factory=StripeParams)
+    qos: QosParams = field(default_factory=QosParams)
 
     def make_searcher(self, data: str):
         """Build the configured searcher for one message string.
@@ -390,6 +494,35 @@ def stripe_from_env() -> StripeParams:
     )
 
 
+def qos_from_env() -> QosParams:
+    d = QosParams()
+    weights = []
+    for part in os.environ.get("DBM_QOS_WEIGHTS", "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        tenant, _, raw = part.partition(":")
+        try:
+            weights.append((tenant.strip(), float(raw)))
+        except ValueError:
+            continue   # malformed pair: ignored, like every other knob
+    return QosParams(
+        enabled=_int_env("DBM_QOS", 1) != 0,
+        chunk_s=_float_env("DBM_QOS_CHUNK_S", d.chunk_s),
+        max_chunks=max(1, _int_env("DBM_QOS_MAX_CHUNKS", d.max_chunks)),
+        depth=max(1, _int_env("DBM_QOS_DEPTH", d.depth)),
+        wholesale_s=_float_env("DBM_QOS_WHOLESALE_S", d.wholesale_s),
+        max_queued=max(0, _int_env("DBM_QOS_MAX_QUEUED", d.max_queued)),
+        max_inflight=max(0, _int_env("DBM_QOS_MAX_INFLIGHT",
+                                     d.max_inflight)),
+        rate=max(0.0, _float_env("DBM_QOS_RATE", d.rate)),
+        burst=max(1.0, _float_env("DBM_QOS_BURST", d.burst)),
+        default_weight=_float_env("DBM_QOS_WEIGHT_DEFAULT",
+                                  d.default_weight),
+        weights=tuple(weights),
+    )
+
+
 def retry_from_env() -> RetryParams:
     d = RetryParams()
     return RetryParams(
@@ -419,4 +552,5 @@ def from_env() -> FrameworkConfig:
         retry=retry_from_env(),
         cache=cache_from_env(),
         stripe=stripe_from_env(),
+        qos=qos_from_env(),
     )

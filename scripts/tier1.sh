@@ -30,19 +30,22 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
-# Knob-off matrix leg (ISSUE 4): the dispatch pipeline and request
-# striping default ON, so the full run above exercises the overlapped
-# path — re-run the recovery/chaos/parity-sensitive modules with
-# DBM_PIPELINE=0 DBM_STRIPE=0 so the stock serial loop + reference
-# even split (the Go-parity shape) stays covered in CI too. Skipped
-# when the main leg already blew the budget. DBM_TIER1_MATRIX=0 opts
-# out.
+# Knob-off matrix leg (ISSUE 4 + ISSUE 5): the dispatch pipeline,
+# request striping, and the fair-share QoS plane default ON, so the full
+# run above exercises the overlapped/fair-share path — re-run the
+# recovery/chaos/parity-sensitive modules (plus the QoS suite, whose
+# FIFO-parity pin is exactly what this leg exists for) with
+# DBM_PIPELINE=0 DBM_STRIPE=0 DBM_QOS=0 so the stock serial loop +
+# reference even split + FIFO dispatch order (the Go-parity shape)
+# stays covered in CI too. Skipped when the main leg already blew the
+# budget. DBM_TIER1_MATRIX=0 opts out.
 if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     timeout -k 10 300 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
+        DBM_QOS=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
-        tests/test_apps.py \
+        tests/test_apps.py tests/test_qos.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}

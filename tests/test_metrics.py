@@ -128,6 +128,12 @@ def test_miner_drop_retires_labeled_gauges():
     join(sched, MINER_A)
     join(sched, MINER_B)
     request(sched, CLIENT_X, "churn", 199)
+    # Backdate the assignments past RATE_WINDOW_S: the windowed sampler
+    # (ISSUE 5) only publishes the rate gauges once a window's worth of
+    # wall clock has been observed, and the scripted result is instant.
+    for m in (sched._find_miner(MINER_A), sched._find_miner(MINER_B)):
+        for ch in m.pending:
+            ch.assigned_at -= 2 * sched.RATE_WINDOW_S
     result(sched, MINER_A)
     result(sched, MINER_B)
     assert "miner_rate_nps{miner=1}" in sched.metrics.snapshot()["gauges"]

@@ -328,9 +328,13 @@ def _e2e_cluster_answers(pipeline: bool, stripe: StripeParams,
                 await worker.join()
                 c.tasks.append(asyncio.create_task(worker.run()))
                 c.miners.append(worker)
-            # Request 1 seeds the rate EWMA; request 2 stripes (when on).
+            # Request 1 warms the pool; the EWMA is then pinned directly
+            # (the windowed rate sampler ignores sub-window warm
+            # requests by design) so request 2 stripes (when on).
             r0 = await asyncio.wait_for(
                 submit(c.hostport, "equiv warm", 999, params), 30)
+            for m in c.scheduler.miners:
+                m.rate_ewma = 1000.0
             r1 = await asyncio.wait_for(
                 submit(c.hostport, "equiv main", 49_999, params), 60)
             ru = await asyncio.wait_for(
@@ -376,6 +380,12 @@ def test_e2e_equivalence_real_jnp_searcher():
             r0 = await asyncio.wait_for(
                 submit(c.hostport, "pipe jnp", 999, params), 120)
             assert r0 == scan_min("pipe jnp", 0, 1000)
+            # The windowed rate sampler needs RATE_WINDOW_S of wall
+            # clock before publishing a rate; a sub-second warm request
+            # can't fill it, so pin the EWMA (file-wide idiom) so the
+            # next request stripes.
+            for m in c.scheduler.miners:
+                m.rate_ewma = 1000.0
             r1 = await asyncio.wait_for(
                 submit(c.hostport, "pipe jnp", 2999, params), 120)
             assert r1 == scan_min("pipe jnp", 0, 3000)
@@ -397,10 +407,14 @@ def test_chaos_wedge_mid_pipeline_reissues_striped_chunk():
             c.scheduler.stripe = FORCED_STRIPE
             wedged = await c.add_miner("wedged")
             await c.add_miner("healthy")
-            # Seed both rate EWMAs so the next request stripes.
+            # Seed both rate EWMAs so the next request stripes (pinned
+            # directly: the windowed rate sampler ignores sub-window
+            # warm requests by design).
             r0 = await asyncio.wait_for(
                 submit(c.hostport, "chaos warm", 799, c.params), 20)
             assert r0 == scan_min("chaos warm", 0, 800)
+            for m in c.scheduler.miners:
+                m.rate_ewma = 1000.0
             wedged.wedge()
             result = await asyncio.wait_for(
                 submit(c.hostport, "chaos striped", 999, c.params), 30)

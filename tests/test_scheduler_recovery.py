@@ -221,7 +221,13 @@ def test_empty_range_burst_drains_iteratively():
     re-entrancy guard iteratively — not one recursion frame set per
     request (a ~250-deep burst used to overflow the stack and kill the
     scheduler actor)."""
-    sched, server = make_scheduler()
+    from distributed_bitcoinminer_tpu.utils.config import QosParams
+    server = FakeServer()
+    # Unbounded intake (max_queued=0): this pins the re-entrancy guard,
+    # not the ISSUE 5 overload shed — which would (correctly) cut a
+    # 2000-deep same-conn burst down to DBM_QOS_MAX_QUEUED first.
+    sched = Scheduler(server, lease=LeaseParams(),
+                      qos=QosParams(max_queued=0))
     join(sched, MINER_A)
     bad = Message(type=MsgType.REQUEST, data="void", lower=5, upper=3)
     for _ in range(2000):
