@@ -35,6 +35,10 @@ import time
 
 GO_MINER_BASELINE_NPS = 1.0e7  # upper structural estimate, BASELINE.md
 _REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+
+from distributed_bitcoinminer_tpu.utils._env import (   # noqa: E402
+    float_env as _float_env, int_env as _int_env, str_env as _str_env)
 
 
 def _emit(value: float, detail: dict) -> None:
@@ -178,7 +182,7 @@ def _pipeline_probe(data: str, lower: int, count: int, batch: int,
             await worker.close()
             await server.close()
 
-    rounds = max(1, int(os.environ.get("DBM_BENCH_PIPELINE_ROUNDS", "6")))
+    rounds = max(1, _int_env("DBM_BENCH_PIPELINE_ROUNDS", 6))
     on_samples, off_samples = [], []
     # Stock legs BRACKET the rounds (one before, one after, median-of-2):
     # a single un-interleaved sample would re-import the exact +-25%
@@ -406,7 +410,7 @@ def _qos_probe(data: str, lower: int, batch: int) -> dict:
                  mouse_count // 2):        # wholesale mouse share
         warm.search(lower, lower + span)
 
-    rounds = max(1, int(os.environ.get("DBM_BENCH_QOS_ROUNDS", "3")))
+    rounds = max(1, _int_env("DBM_BENCH_QOS_ROUNDS", 3))
     on_rounds, off_rounds = [], []
     for rnd in range(rounds):
         order = (True, False) if rnd % 2 == 0 else (False, True)
@@ -461,8 +465,8 @@ def main() -> int:
     # 0 disables the emitter — the overhead-comparison baseline). The
     # final registry snapshot is embedded in the artifact either way.
     ensure_emitter()
-    init_deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
-    if os.environ.get("DBM_BENCH_PROBE", "1") == "0":
+    init_deadline = _float_env("DBM_BENCH_INIT_TIMEOUT", 300.0)
+    if _str_env("DBM_BENCH_PROBE", "1") == "0":
         # Probe opt-out (ISSUE 4 satellite): trust JAX_PLATFORMS as-is —
         # chip-less boxes pin cpu and stop paying the init deadline (and
         # the artifact stops carrying the recurring probe error).
@@ -529,7 +533,7 @@ def main() -> int:
     upper = lower + count - 1
     min_time_s = 1.0 if on_accel else 0.5
     data = "cmu440"
-    tier_req = os.environ.get("DBM_COMPUTE", "auto").lower()
+    tier_req = _str_env("DBM_COMPUTE", "auto").lower()
 
     def build(tier: str, hoist: bool | None = None):
         if tier == "host":
@@ -582,7 +586,7 @@ def main() -> int:
             t0 = time.time()
             searcher.search(lower, t_upper)  # compile + warm the signature
             warm_s = time.time() - t0
-            trace_dir = os.environ.get("DBM_TRACE")
+            trace_dir = _str_env("DBM_TRACE")
             if trace_dir:
                 with device_trace(os.path.join(trace_dir, tier)):
                     searcher.search(lower, t_upper)
@@ -669,7 +673,7 @@ def main() -> int:
     # at a small fixed geometry. Opt-in: the default artifact is
     # unchanged and the driver's timing budget untouched.
     sweep_detail = {}
-    if os.environ.get("DBM_BENCH_REM_SWEEP", "0") == "1":
+    if _str_env("DBM_BENCH_REM_SWEEP", "0") == "1":
         try:
             from distributed_bitcoinminer_tpu.utils.profiling import Timer
             sweep = []
@@ -699,7 +703,7 @@ def main() -> int:
     # auxiliary measurements; DBM_BENCH_PIPELINE=0 skips it.
     pipeline_detail = {}
     if not on_accel and "jnp" in results \
-            and os.environ.get("DBM_BENCH_PIPELINE", "1") != "0":
+            and _str_env("DBM_BENCH_PIPELINE", "1") != "0":
         try:
             pipeline_detail = {"pipeline": _pipeline_probe(
                 data, lower, count, batch)}
@@ -713,7 +717,7 @@ def main() -> int:
     # DBM_BENCH_QOS=0 skips it.
     qos_detail = {}
     if not on_accel and "jnp" in results \
-            and os.environ.get("DBM_BENCH_QOS", "1") != "0":
+            and _str_env("DBM_BENCH_QOS", "1") != "0":
         try:
             qos_detail = {"qos": _qos_probe(data, lower, batch)}
         except Exception as exc:  # noqa: BLE001

@@ -1,0 +1,41 @@
+"""dbmlint — the repo's own AST-based invariant checker (ISSUE 7).
+
+Five PRs of review rounds kept re-finding the same bug classes by hand;
+this package machine-checks them. Pure AST + text over the working tree:
+importing it (and running every analyzer) must never import JAX, so the
+tier-1 lint leg costs seconds, not a backend init.
+
+Analyzers (each a module exporting ``analyze(files) -> [Finding]``):
+
+- ``loopblock`` — blocking calls (JAX forcing, subprocess, sleeps,
+  searcher construction/scans) reachable from ``async def`` bodies in
+  ``apps/`` and ``lsp/`` without a thread-pool hop.
+- ``cardinality`` — dynamic metric label values must have a retirement
+  path (a matching ``.remove(...)`` in the same module) or a justified
+  suppression, so conn/job/tenant churn can't grow series without bound.
+- ``knobs`` — every ``DBM_*`` read routes through ``utils/_env.py`` /
+  ``utils/config.py``; the read knob set, the ``utils/config.py``
+  docstring, and the README knob tables must all agree (no undocumented
+  knobs, no orphaned doc entries).
+- ``jitstatic`` — expressions computed inline at a jit boundary's static
+  parameter (the stripe-size recompile-storm hazard) in ``ops/``,
+  ``models/``, ``parallel/``.
+- ``threadstate`` — attributes of ``Scheduler`` / ``QosPlane`` /
+  ``MinerWorker`` touched from both coroutines and worker threads must
+  appear in the class's ``THREAD_SHARED`` ownership table or be mutated
+  under a lock.
+
+Workflow: ``python scripts/dbmlint.py`` checks the tree against the
+checked-in baseline (``analysis/baseline.json``). NEW findings fail the
+run; fixed findings leave stale baseline entries, flushed with
+``--update-baseline`` — which refuses to GROW the baseline unless
+``--force`` is given, so the baseline shrinks monotonically.
+Line-targeted suppressions use ``# dbmlint: ok[<analyzer>] <why>``.
+"""
+
+from .core import (ANALYZERS, Finding, baseline_path, compare, load_baseline,
+                   load_files, run_repo, run_source, save_baseline)
+
+__all__ = ["ANALYZERS", "Finding", "baseline_path", "compare",
+           "load_baseline", "load_files", "run_repo", "run_source",
+           "save_baseline"]

@@ -1,0 +1,196 @@
+"""Analyzer: runtime-derived scalars at jit static boundaries (jit-static).
+
+The bug class (PR 5 bench hardening): a value passed to a
+``static_argnames`` parameter — or any shape-determining position — is
+baked into the jit signature, so every DISTINCT value is a fresh XLA
+trace+compile. A static argument derived from runtime state with an
+unbounded value set (EWMA-drifted stripe sizes was the live incident:
+``nbatches`` followed the scheduler's per-chunk nonce counts and
+recompiled mid-leg, blowing 120s leases) turns the compile cache into a
+recompile storm. Static arguments must come from QUANTIZED value sets —
+pow2 sub-dispatch sizes, decimal block widths, fixed bench geometry.
+
+Scope: ``ops/``, ``models/``, ``parallel/``. Two passes:
+
+1. collect functions decorated ``functools.partial(jax.jit,
+   static_argnames=(...))`` (or ``jax.jit(... static_argnames=...)``) —
+   name -> static parameter names;
+2. at every call site of a collected function, classify each static
+   keyword's value expression:
+
+   - **stable**: literals; attribute chains (precomputed state such as
+     ``plan.rem`` — quantization happened where the plan was built);
+     names that don't resolve to a local assignment (parameters, loop
+     targets — the value was quantized upstream and the site is
+     auditable); names whose single local assignment is itself stable;
+     tuples/unary ops/boolean comparisons of stable parts; constant
+     arithmetic.
+   - **unstable** (finding): arithmetic on runtime values, function-call
+     results, subscripts — computed AT the boundary, where nothing
+     enforces a bounded value set. Sites that ARE bounded by
+     construction document it with
+     ``# dbmlint: ok[jit-static] <why bounded>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, SourceFile, dotted
+
+NAME = "jit-static"
+
+SCOPE_PREFIXES = (
+    "distributed_bitcoinminer_tpu/ops/",
+    "distributed_bitcoinminer_tpu/models/",
+    "distributed_bitcoinminer_tpu/parallel/",
+)
+
+
+def _static_names_from_decorator(dec: ast.expr) -> Optional[Set[str]]:
+    """static_argnames set when ``dec`` is a jit-with-statics decorator."""
+    if not isinstance(dec, ast.Call):
+        return None
+    target = dotted(dec.func)
+    args = list(dec.keywords)
+    if target.endswith("partial"):
+        if not dec.args or not dotted(dec.args[0]).endswith("jit"):
+            return None
+    elif not target.endswith("jit"):
+        return None
+    for kw in args:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            names = set()
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    names.add(el.value)
+            return names
+    return None
+
+
+def _collect_jitted(files: List[SourceFile]) -> Dict[str, Set[str]]:
+    jitted: Dict[str, Set[str]] = {}
+    for f in files:
+        if f.tree is None or not f.rel.startswith(SCOPE_PREFIXES):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                statics = _static_names_from_decorator(dec)
+                if statics:
+                    jitted[node.name] = statics
+    return jitted
+
+
+def _local_assignments(fn: ast.AST) -> Dict[str, List[ast.expr]]:
+    """name -> assigned value exprs in ``fn``'s own body (nested defs
+    excluded). Tuple-unpack targets map to a sentinel None (a slice of a
+    call result — unresolvable, treated unstable)."""
+    out: Dict[str, List] = {}
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.setdefault(tgt.id, []).append(node.value)
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            out.setdefault(el.id, []).append(None)
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name):
+            out.setdefault(node.target.id, []).append(None)
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+    return out
+
+
+def _stable(expr: Optional[ast.expr], assigns: Dict[str, List],
+            depth: int = 0) -> bool:
+    if expr is None or depth > 4:
+        return False
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Attribute):
+        return True          # precomputed state; quantized at the source
+    if isinstance(expr, ast.Name):
+        values = assigns.get(expr.id)
+        if values is None:
+            return True      # parameter / loop target: quantized upstream
+        if len(values) != 1:
+            return False     # multi-assigned: value set untracked
+        return _stable(values[0], assigns, depth + 1)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return all(_stable(el, assigns, depth + 1) for el in expr.elts)
+    if isinstance(expr, ast.UnaryOp):
+        return _stable(expr.operand, assigns, depth + 1)
+    if isinstance(expr, ast.Compare):
+        return True          # bool result: two-valued signature set
+    if isinstance(expr, ast.IfExp):
+        # Branch on anything; the VALUE set is the two branches' union.
+        return _stable(expr.body, assigns, depth + 1) and \
+            _stable(expr.orelse, assigns, depth + 1)
+    if isinstance(expr, ast.BinOp):
+        # Constant folding only: arithmetic on runtime values is exactly
+        # the hazard.
+        return isinstance(expr.left, ast.Constant) and \
+            isinstance(expr.right, ast.Constant)
+    if isinstance(expr, ast.Call):
+        fname = dotted(expr.func)
+        if fname in ("bool", "str"):   # bounded / non-shape coercions
+            return all(_stable(a, assigns, depth + 1) for a in expr.args)
+        return False
+    return False
+
+
+def analyze(files: List[SourceFile], repo: str) -> List[Finding]:
+    jitted = _collect_jitted(files)
+    out: List[Finding] = []
+    if not jitted:
+        return out
+    for f in files:
+        if f.tree is None or not f.rel.startswith(SCOPE_PREFIXES):
+            continue
+        # Walk function-by-function so call sites resolve local names.
+        funcs = [n for n in ast.walk(f.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            assigns = _local_assignments(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = node.func
+                cname = callee.attr if isinstance(callee, ast.Attribute) \
+                    else (callee.id if isinstance(callee, ast.Name)
+                          else "")
+                statics = jitted.get(cname)
+                if not statics:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg not in statics:
+                        continue
+                    if _stable(kw.value, assigns):
+                        continue
+                    out.append(Finding(
+                        NAME, f.rel, kw.value.lineno,
+                        f"{NAME}:{f.rel}:{fn.name}:{cname}:{kw.arg}",
+                        f"static argument {kw.arg!r} of jitted "
+                        f"{cname}() is computed at the call boundary "
+                        f"in {fn.name}(); every distinct value is a "
+                        f"fresh trace+compile — quantize the value set "
+                        f"(pow2 / fixed geometry) where it is computed, "
+                        f"or document the bound with a suppression"))
+    return out

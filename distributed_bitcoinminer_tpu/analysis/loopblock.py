@@ -1,0 +1,128 @@
+"""Analyzer: blocking calls inside ``async def`` bodies (loop-block).
+
+The bug class (PR 4 review, round-5 live incident): synchronous JAX or
+subprocess work executed directly on the asyncio event loop starves the
+LSP engine's heartbeat/ack timers — a miner wedged in backend init or a
+long ``subprocess.run`` passes its transport's epoch check late or never
+and gets declared dead while healthy. Every compute call must hop to a
+worker thread (``asyncio.to_thread`` / ``run_in_executor``).
+
+Scope: ``apps/`` and ``lsp/`` (the asyncio actors). The walk covers the
+DIRECT body of each ``async def`` — nested ``def``/``lambda`` bodies run
+wherever they are later called (usually a thread pool), so only the
+statements the coroutine itself executes are charged to the loop.
+
+What counts as blocking (curated for this repo, not a general list):
+
+- ``time.sleep`` (the asyncio one is fine);
+- subprocess execution (``subprocess.run/check_*/call``, ``os.system``);
+- JAX result forcing and transfer: ``.block_until_ready()``,
+  ``jax.device_get``, ``.item()``, ``np/jnp.asarray``;
+- backend/searcher construction and resolution: ``probe_backend``,
+  ``jax_devices_robust``, ``_pin_platform_if_backend_wedged``,
+  ``make_searcher``, ``default_searcher_factory``, ``NonceSearcher``,
+  ``ShardedNonceSearcher``, ``_get_searcher`` (first touch runs backend
+  init — minutes on a wedged tunnel);
+- the searcher compute surface: ``.search()``, ``.search_until()``,
+  ``.finalize()``, ``.dispatch()`` (dispatch can hide a full jit
+  trace+compile), and the native scans ``scan_min_native`` /
+  ``scan_until_native``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, SourceFile, dotted
+
+NAME = "loop-block"
+
+SCOPE_PREFIXES = (
+    "distributed_bitcoinminer_tpu/apps/",
+    "distributed_bitcoinminer_tpu/lsp/",
+)
+
+#: Exact dotted-name suffixes that block (matched against the call's
+#: dotted form, so ``time.sleep`` hits both ``time.sleep(...)`` and an
+#: aliased ``t.sleep`` only when spelled with the module name).
+BLOCKING_DOTTED = {
+    "time.sleep", "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.call", "os.system",
+    "jax.device_get", "np.asarray", "numpy.asarray", "jnp.asarray",
+}
+
+#: Bare function / constructor names that block regardless of receiver.
+BLOCKING_NAMES = {
+    "probe_backend", "jax_devices_robust",
+    "_pin_platform_if_backend_wedged", "default_searcher_factory",
+    "NonceSearcher", "ShardedNonceSearcher", "PodSearcher",
+    "scan_min_native", "scan_until_native", "run_follower",
+}
+
+#: Method names that block on ANY receiver (the compute surface).
+BLOCKING_ATTRS = {
+    "block_until_ready", "item", "search", "search_until", "finalize",
+    "dispatch", "make_searcher", "_get_searcher", "_search",
+    "_resolve_and_dispatch",
+}
+
+
+def _direct_body(fn: ast.AsyncFunctionDef):
+    """Nodes the coroutine itself executes: walk, but do not descend into
+    nested function/lambda definitions (their bodies run elsewhere)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def _blocking_reason(call: ast.Call):
+    func = call.func
+    name = dotted(func)
+    if name in BLOCKING_DOTTED:
+        return f"call to {name}"
+    if isinstance(func, ast.Name) and func.id in BLOCKING_NAMES:
+        return f"call to {func.id} (backend/searcher construction)"
+    if isinstance(func, ast.Attribute) and func.attr in BLOCKING_ATTRS:
+        # `self.foo.item` etc. — attribute on any receiver.
+        if isinstance(func.value, ast.Name) and \
+                func.value.id == "asyncio":
+            return None   # asyncio.sleep etc.
+        return f"call to .{func.attr}() (blocking compute surface)"
+    if isinstance(func, ast.Name) and func.id in BLOCKING_ATTRS and \
+            func.id not in ("search", "dispatch", "item", "finalize"):
+        # Bare-name forms of the repo helpers (imported unqualified); the
+        # generic method names stay attribute-only to avoid false hits.
+        return f"call to {func.id} (blocking compute surface)"
+    return None
+
+
+def analyze(files: List[SourceFile], repo: str) -> List[Finding]:
+    out: List[Finding] = []
+    for f in files:
+        if f.tree is None or not f.rel.startswith(SCOPE_PREFIXES):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for sub in _direct_body(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                reason = _blocking_reason(sub)
+                if reason is None:
+                    continue
+                callee = dotted(sub.func)
+                out.append(Finding(
+                    NAME, f.rel, sub.lineno,
+                    f"{NAME}:{f.rel}:{node.name}:{callee}",
+                    f"async def {node.name} runs blocking {reason} on "
+                    f"the event loop; hop to a worker thread "
+                    f"(asyncio.to_thread) so LSP heartbeats keep "
+                    f"flowing"))
+    return out

@@ -9,13 +9,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 from typing import Optional, Tuple
 
 from ..bitcoin.message import Message, MsgType, new_request
 from ..lsp.client import new_async_client
 from ..lsp.errors import LspError
 from ..lsp.params import Params
+from ..utils._env import int_env as _int_env
 from ..utils.config import RetryParams
 from ..utils.metrics import registry as _registry
 
@@ -247,11 +247,7 @@ def main(argv=None) -> int:
     # reconnect+resubmit, and a connect failure prints "Disconnected"
     # instead of "Failed to connect"). A missing, unparsable, 0, or 1
     # value keeps the reference behavior.
-    raw_attempts = os.environ.get("DBM_RETRY_ATTEMPTS", "")
-    try:
-        want_retry = int(raw_attempts) > 1
-    except ValueError:
-        want_retry = False
+    want_retry = _int_env("DBM_RETRY_ATTEMPTS", 0) > 1
     try:
         if want_retry:
             until = asyncio.run(submit_with_retry(

@@ -43,6 +43,7 @@ from ..bitcoin.message import Message, MsgType, new_join, new_result
 from ..lsp.client import AsyncClient, new_async_client
 from ..lsp.errors import LspError
 from ..lsp.params import Params
+from ..utils import sanitize as _sanitize
 from ..utils._env import int_env as _int_env
 from ..utils.metrics import (OCCUPANCY_BUCKETS, ensure_emitter,
                              registry as _registry)
@@ -176,9 +177,9 @@ def default_searcher_factory(data: str, batch: Optional[int] = None,
     accelerators and for process-level tests. ``tier`` pins the device
     kernel (jnp | pallas); None reads the environment default.
     """
-    import os
+    from ..utils._env import str_env
 
-    if os.environ.get("DBM_COMPUTE", "").lower() == "host":
+    if str_env("DBM_COMPUTE", "").lower() == "host":
         return HostSearcher(data)
 
     import jax
@@ -204,6 +205,20 @@ class MinerWorker:
     # messages can't grow device/midstate caches without bound.
     SEARCHER_CACHE_SIZE = 4
 
+    #: Cross-thread ownership table (dbmlint: thread-state). Attributes
+    #: listed here are touched from BOTH the event loop and compute
+    #: worker threads by design, with the serialization argument on
+    #: record; the analyzer fails any cross-thread attribute that is
+    #: neither declared here nor mutated under a lock.
+    THREAD_SHARED = {
+        "_searchers": "compute-executor-serialized: at most one dispatch "
+                      "or blocking-search worker runs at a time (a single "
+                      "dtask is in flight, and the degraded path drains "
+                      "it before running), so the LRU is never touched "
+                      "concurrently even though the touching thread "
+                      "changes per chunk.",
+    }
+
     def __init__(self, hostport: str, params: Optional[Params] = None,
                  searcher_factory: Callable = default_searcher_factory,
                  batch: Optional[int] = None,
@@ -226,6 +241,10 @@ class MinerWorker:
                                   else _int_env("DBM_PIPELINE_DEPTH", 8))
         self._window = _ThroughputWindow()
         ensure_emitter()   # DBM_METRICS_INTERVAL_S-driven; 0 = no-op
+        # Runtime sanitizer (ISSUE 7): DBM_SANITIZE=1 installs the
+        # slow-callback watchdog and arms the off-loop assertions on the
+        # compute entry points below.
+        self._sanitize = _sanitize.ensure_sanitizer()
 
     async def join(self) -> None:
         """Connect and send Join (ref: miner.go:24-34)."""
@@ -380,6 +399,8 @@ class MinerWorker:
         includes head-of-line wait behind the previous chunk's
         finalize+write, which would read as a latency regression in
         BENCH artifact diffs whenever the knob toggles."""
+        if self._sanitize:
+            _sanitize.assert_off_loop("miner searcher resolution/dispatch")
         t0 = time.monotonic()
         searcher = self._get_searcher(msg.data)
         if hasattr(searcher, "dispatch") and hasattr(searcher, "finalize"):
@@ -484,6 +505,8 @@ class MinerWorker:
         the chunk-FIRST qualifying nonce), 0 when this miner behaved like
         a stock full scan; the scheduler uses the echo to grade its merge
         guarantee (ADVICE r4)."""
+        if self._sanitize:
+            _sanitize.assert_off_loop("miner blocking search")
         if lower > upper:
             # The Go miner's loop body never runs for an inverted range and
             # it reports (maxUint, 0) (ref: miner.go:46-59); match that
@@ -532,11 +555,12 @@ def _pin_platform_if_backend_wedged(compute: str = "auto") -> bool:
     """
     import os
 
+    from ..utils._env import float_env, str_env
     from ..utils.config import probe_backend
-    if compute == "host" or os.environ.get("DBM_COORDINATOR") or \
+    if compute == "host" or str_env("DBM_COORDINATOR") or \
             os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         return False
-    timeout_s = float(os.environ.get("DBM_MINER_PROBE_TIMEOUT_S", "120"))
+    timeout_s = float_env("DBM_MINER_PROBE_TIMEOUT_S", 120.0)
     if timeout_s <= 0:
         return False
     probe = probe_backend(timeout_s)
@@ -566,12 +590,25 @@ def _cpu_fallback_config(cfg):
     return dataclasses.replace(cfg, compute="host")
 
 
+def _probe_and_pin(cfg):
+    """Blocking startup half of :func:`_run_miner`: the deadlined
+    accelerator probe (a subprocess join of up to
+    ``DBM_MINER_PROBE_TIMEOUT_S``) and, on a pin, the native-tier
+    fallback (which may g++-build the scan once). Runs on a worker
+    thread via ``asyncio.to_thread`` — executed inline it held the
+    event loop for the probe's whole deadline, so the LSP client
+    created right after started life up to 120s behind on its own
+    epoch timers (dbmlint: loop-block)."""
+    if _pin_platform_if_backend_wedged(cfg.compute):
+        return _cpu_fallback_config(cfg)
+    return cfg
+
+
 async def _run_miner(hostport: str) -> int:
     from ..utils import from_env
     from ..utils.config import apply_jax_platform_env
     cfg = from_env()
-    if _pin_platform_if_backend_wedged(cfg.compute):
-        cfg = _cpu_fallback_config(cfg)
+    cfg = await asyncio.to_thread(_probe_and_pin, cfg)
 
     # Pod mode (north star: a whole multi-host pod joins as ONE miner).
     # DBM_COORDINATOR et al. select it; unset means plain single-host.

@@ -165,6 +165,7 @@ from ..bitcoin.hash import MAX_U64
 from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
+from ..utils import sanitize as _sanitize
 from ..utils.config import CacheParams, LeaseParams, QosParams, \
     StripeParams, qos_from_env, stripe_from_env
 from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS, Registry,
@@ -380,6 +381,14 @@ class Scheduler:
         self.metrics = Registry()
         process_registry().mount("sched", self.metrics)
         ensure_emitter()
+        # Runtime sanitizer (ISSUE 7, DBM_SANITIZE=1): installs the
+        # process slow-callback watchdog and pins the hot dispatch
+        # structures (miners/queue/_inflight and everything reachable
+        # from the event handlers) to the actor's own thread. None when
+        # the knob is off — the guard below is then one attribute test.
+        self._owner = (_sanitize.ThreadOwner(
+            "Scheduler hot state (miners/queue/_inflight)")
+            if _sanitize.ensure_sanitizer() else None)
         self._counters = {n: self.metrics.counter(n) for n in STAT_COUNTERS}
         self._queue_depth = self.metrics.gauge("queue_depth")
         self._pool_size = self.metrics.gauge("pool_size")
@@ -517,6 +526,8 @@ class Scheduler:
     # ---------------------------------------------------------------- events
 
     def _on_request(self, conn_id: int, msg: Message) -> None:
+        if self._owner is not None:
+            self._owner.assert_here()
         key = (msg.data, msg.lower, msg.upper, msg.target)
         if self.results is not None:
             hit = self._cache_lookup(key)
@@ -574,6 +585,8 @@ class Scheduler:
         self.traces.register(f"cache:{self._cache_trace_seq}", trace)
 
     def _on_join(self, conn_id: int) -> None:
+        if self._owner is not None:
+            self._owner.assert_here()
         miner = MinerState(conn_id=conn_id)
         # A joining miner immediately absorbs one parked chunk, if any
         # (ref: server.go:222-244).
@@ -585,6 +598,8 @@ class Scheduler:
         self._maybe_dispatch()
 
     def _on_result(self, conn_id: int, msg: Message) -> None:
+        if self._owner is not None:
+            self._owner.assert_here()
         miner = self._find_miner(conn_id)
         if miner is None or not miner.pending:
             return
@@ -667,6 +682,8 @@ class Scheduler:
             self._maybe_dispatch()
 
     def _on_drop(self, conn_id: int) -> None:
+        if self._owner is not None:
+            self._owner.assert_here()
         miner = self._find_miner(conn_id)
         if miner is not None:
             logger.info("miner %d dropped", conn_id)
@@ -828,6 +845,8 @@ class Scheduler:
         frame set per request and overflow; with it, the inner call
         returns immediately and the OUTER pump loop drains the queue
         iteratively."""
+        if self._owner is not None:
+            self._owner.assert_here()
         if self._dispatching:
             return
         self._dispatching = True
@@ -1465,6 +1484,8 @@ class Scheduler:
         but the QoS plane (ISSUE 5) runs several concurrently — a wedged
         miner holding a mouse's chunk must blow even while an elephant's
         chunks are also live."""
+        if self._owner is not None:
+            self._owner.assert_here()
         if not self._inflight:
             return
         now = time.monotonic()

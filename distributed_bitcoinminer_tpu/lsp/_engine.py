@@ -331,8 +331,11 @@ class Conn:
                 # Labeled by the backoff level that TRIGGERED this resend
                 # (0, 1, 2, 4, ... capped): the distribution is the
                 # XXOXOOX retransmission-law shape, observable per process.
-                _M.counter("lsp.retransmits",
-                           backoff=str(pending.cur_backoff)).inc()
+                _M.counter(   # dbmlint: ok[cardinality] bounded:
+                    # backoff levels are 0, 1, 2, 4, ... capped at the
+                    # max_backoff_interval knob — log2(cap)+2 values.
+                    "lsp.retransmits",
+                    backoff=str(pending.cur_backoff)).inc()
                 pending.epochs_passed = 0
                 if pending.cur_backoff == 0:
                     pending.cur_backoff = min(1, self.params.max_backoff_interval)

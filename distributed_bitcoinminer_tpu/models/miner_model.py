@@ -18,7 +18,6 @@ nonce wins everywhere).
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -27,6 +26,7 @@ from ..bitcoin.hash import MAX_U64
 from ..ops.search import search_span, search_span_until
 from ..ops.sha256_host import sha256_midstate
 from ..ops.sha256_jnp import build_hoist, build_tail_template
+from ..utils._env import str_env as _str_env
 from ..utils.metrics import registry as _registry
 
 _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
@@ -54,7 +54,7 @@ def default_tier() -> str:
     the Mosaic simulator at interpreter speed). ``jnp`` pins the XLA tier
     explicitly. (Round-3 fix lineage: ``DBM_COMPUTE=jax`` used to leak
     through as an unknown tier and crash the miner's first search.)"""
-    value = os.environ.get("DBM_COMPUTE", "auto").lower()
+    value = _str_env("DBM_COMPUTE", "auto").lower()
     if value in ("", "auto", "jax", "host"):
         from ..utils.config import CHIP_PLATFORMS, jax_devices_robust
         on_chip = jax_devices_robust()[0].platform in CHIP_PLATFORMS
@@ -119,7 +119,7 @@ class NonceSearcher:
         #: Lane-invariant hoist (deep midstate + constant schedule terms);
         #: DBM_HOIST=0 is the safety valve back to the original entry path.
         self.use_hoist = (hoist if hoist is not None
-                          else os.environ.get("DBM_HOIST", "1") != "0")
+                          else _str_env("DBM_HOIST", "1") != "0")
         #: Difficulty-mode sub-dispatch lookahead: with DBM_UNTIL_PIPELINE=1
         #: (default) sub k+1 is dispatched BEFORE sub k's result is forced,
         #: hiding dispatch+fetch latency behind compute; 0 restores the
@@ -128,7 +128,7 @@ class NonceSearcher:
         #: speculatively dispatched later sub is simply discarded when an
         #: earlier sub hits (its scan is idempotent).
         self._until_lookahead = (
-            1 if os.environ.get("DBM_UNTIL_PIPELINE", "1") != "0" else 0)
+            1 if _str_env("DBM_UNTIL_PIPELINE", "1") != "0" else 0)
 
     def _plan_block(self, d: int, k: int, block_base: int, lo: int, hi: int) -> _BlockPlan:
         top = str(block_base)[: d - k] if d > k else ""

@@ -241,9 +241,9 @@ def build_hoist(midstate, template: np.ndarray, rem: int, k: int,
     """
     from .sha256_host import compress_rounds, schedule_words, sigma0, sigma1
 
-    import os
+    from ..utils._env import str_env
     if deep_window is None:
-        env = os.environ.get("DBM_HOIST_DEEP", "")
+        env = str_env("DBM_HOIST_DEEP", "")
         if env:
             deep_window = env == "1"
         else:

@@ -82,8 +82,8 @@ def peel_enabled() -> bool:
     tunnel window (round-5 outage). Flip with ``DBM_PEEL=1`` (e.g. via
     ``scripts/pallas_chip_smoke.py`` under the chain) and make it the
     default here once validated."""
-    import os
-    return os.environ.get("DBM_PEEL", "0") == "1"
+    from ..utils._env import str_env
+    return str_env("DBM_PEEL", "0") == "1"
 
 
 def pallas_argmin(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
@@ -96,11 +96,19 @@ def pallas_argmin(midstate, template, i0, lo_i, hi_i, *, rem: int, k: int,
     stays byte-identical when DBM_PEEL is off."""
     rows, nsteps = pallas_geometry(total)
     peel = peel_enabled()
+    # Static-signature boundedness (the dbmlint jit-static suppressions
+    # below): rows/nsteps derive from ``total``, which every caller
+    # quantizes to batch * pow2 sub-dispatch sizes
+    # (models.NonceSearcher._sub_dispatches), and interpret/peel are
+    # two-valued booleans fixed for a process — the signature set is
+    # small and geometry-keyed, not runtime-drifting.
     return pallas_search_span(
         midstate, template, i0, lo_i, hi_i,
-        hoist if peel else None, rem=rem, k=k, rows=rows,
-        nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
-        peel=peel)
+        hoist if peel else None, rem=rem, k=k,
+        rows=rows, nsteps=nsteps,  # dbmlint: ok[jit-static] pow2 geometry
+        interpret=interpret_on(platform),  # dbmlint: ok[jit-static] bool
+        peel=peel,  # dbmlint: ok[jit-static] bool knob
+        vma=vma)
 
 
 def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
@@ -110,11 +118,14 @@ def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
     :func:`pallas_argmin`)."""
     rows, nsteps = pallas_geometry(total)
     peel = peel_enabled()
+    # Same boundedness argument as pallas_argmin above.
     return pallas_search_span_until(
         midstate, template, i0, lo_i, hi_i, t_hi, t_lo,
         hoist if peel else None, rem=rem, k=k,
-        rows=rows, nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
-        peel=peel)
+        rows=rows, nsteps=nsteps,  # dbmlint: ok[jit-static] pow2 geometry
+        interpret=interpret_on(platform),  # dbmlint: ok[jit-static] bool
+        peel=peel,  # dbmlint: ok[jit-static] bool knob
+        vma=vma)
 
 
 def pallas_geometry(total: int) -> tuple[int, int]:

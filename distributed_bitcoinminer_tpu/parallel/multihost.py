@@ -33,6 +33,8 @@ from typing import Optional
 import jax
 import numpy as np
 
+from ..utils._env import (float_env as _float_env, int_env as _int_env,
+                          str_env as _str_env)
 from .mesh_search import make_mesh
 
 logger = logging.getLogger("dbm.multihost")
@@ -53,14 +55,13 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     ``DBM_PROC_ID`` and stays single-host when unset (the common case on
     one chip or one host).
     """
-    coordinator_address = coordinator_address or os.environ.get(
-        "DBM_COORDINATOR")
+    coordinator_address = coordinator_address or _str_env("DBM_COORDINATOR")
     if coordinator_address is None:
         return False
-    num_processes = num_processes if num_processes is not None else int(
-        os.environ.get("DBM_NUM_PROCS", "1"))
-    process_id = process_id if process_id is not None else int(
-        os.environ.get("DBM_PROC_ID", "0"))
+    if num_processes is None:
+        num_processes = _int_env("DBM_NUM_PROCS", 1)
+    if process_id is None:
+        process_id = _int_env("DBM_PROC_ID", 0)
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
     logger.info("multihost: process %d/%d, %d global devices",
                 jax.process_index(), jax.process_count(),
@@ -80,10 +81,7 @@ def _pod_timeout_s() -> float:
     (a v4-8 pod clears 10^11 nonces inside it) while still converting a
     wedged collective into a bounded failure.
     """
-    try:
-        return float(os.environ.get("DBM_POD_TIMEOUT_S", "600"))
-    except ValueError:
-        return 600.0
+    return _float_env("DBM_POD_TIMEOUT_S", 600.0)
 
 
 def bounded_pod_call(fn, timeout_s: Optional[float] = None):
@@ -238,14 +236,9 @@ def run_follower(batch: Optional[int] = None,
         cache_size = MinerWorker.SEARCHER_CACHE_SIZE
     searchers: OrderedDict[str, ShardedNonceSearcher] = OrderedDict()
     mesh = global_mesh()
-    try:
-        idle_bound = float(os.environ.get("DBM_POD_IDLE_TIMEOUT_S", "0"))
-    except ValueError:
-        # Tolerate a malformed knob like the sibling DBM_POD_TIMEOUT_S
-        # does — a typo must not crash the follower and wedge the pod.
-        logger.warning("ignoring malformed DBM_POD_IDLE_TIMEOUT_S=%r",
-                       os.environ.get("DBM_POD_IDLE_TIMEOUT_S"))
-        idle_bound = 0.0
+    # A malformed knob falls back silently (the _env contract): a typo
+    # must not crash the follower and wedge the pod.
+    idle_bound = _float_env("DBM_POD_IDLE_TIMEOUT_S", 0.0)
     jobs = 0
     while True:
         job = (bounded_pod_call(_receive_job, timeout_s=idle_bound)

@@ -30,3 +30,14 @@ def float_env(name: str, default: float) -> float:
         return float(raw)
     except ValueError:
         return default
+
+
+def str_env(name: str, default=None):
+    """Raw string knob (``default`` when unset — callers parse/compare).
+
+    Exists so EVERY ``DBM_*`` read in the tree routes through this module
+    (the dbmlint knob-hygiene analyzer enforces it): one grep target for
+    the full knob surface, one place where read semantics can change.
+    """
+    raw = os.environ.get(name)
+    return default if raw is None else raw

@@ -139,6 +139,41 @@ Environment variables:
 - ``DBM_TIER1_MATRIX`` (0 disables): scripts/tier1.sh's knob-off
   matrix leg, which re-runs the recovery/chaos/parity modules with
   ``DBM_PIPELINE=0 DBM_STRIPE=0`` after a green main leg.
+- ``DBM_TIER1_LINT`` (0 disables): scripts/tier1.sh's dbmlint leg — the
+  pure-AST static-analysis gate (``scripts/dbmlint.py``) that runs
+  before the pytest leg (analysis/ package; no JAX import, seconds).
+- ``DBM_SANITIZE`` (default 0) / ``DBM_SANITIZE_SLOW_S``: the runtime
+  sanitizer plane (utils/sanitize.py). With ``DBM_SANITIZE=1`` every
+  scheduler/miner construction installs an asyncio slow-callback
+  watchdog — any callback holding the event loop longer than
+  ``DBM_SANITIZE_SLOW_S`` seconds (default 0.1) is named in a
+  ``dbm.sanitize`` warning and counted in ``sanitize.slow_callbacks``
+  — plus thread-ownership assertions on the scheduler's hot
+  structures and on the miner's compute entry points (compute on the
+  event loop is the bug class the dbmlint loop-block analyzer catches
+  statically; this catches what slips through at runtime).
+  Observability only: violations log and count, never raise.
+- ``DBM_PEEL`` (default 0): pallas-tier peeled-compression kernel
+  variant (ops/sha256_pallas.peel_enabled; chip-gated rollout — see
+  scripts/chip_chain.py).
+- ``DBM_TRACE``: directory for a JAX profiler trace of one timed
+  search (bench.py; unset = no trace).
+- ``DBM_BENCH_INIT_TIMEOUT``: deadline in seconds for the bench /
+  chip-script backend probe subprocess (default 300).
+- ``DBM_BENCH_REM_SWEEP`` (default 0): bench.py's opt-in rem-sweep
+  micro-bench (hoisted vs plain jnp rates across message lengths).
+- ``DBM_MINER_PROBE_TIMEOUT_S``: the miner's pre-join deadlined
+  accelerator probe (default 120; 0 skips — apps/miner
+  _pin_platform_if_backend_wedged). On probe failure the miner pins
+  itself to CPU instead of hanging in backend init.
+- ``DBM_COORDINATOR`` / ``DBM_NUM_PROCS`` / ``DBM_PROC_ID``: multi-host
+  pod mode (parallel/multihost.initialize_multihost): the
+  jax.distributed coordinator address and process geometry; unset =
+  single-host.
+- ``DBM_POD_TIMEOUT_S`` (default 600) / ``DBM_POD_IDLE_TIMEOUT_S``
+  (default 0 = unbounded): pod failure-domain bounds — one pod job's
+  collective deadline, and the follower's optional between-jobs
+  broadcast wait bound (parallel/multihost.bounded_pod_call).
 """
 
 from __future__ import annotations
@@ -149,7 +184,8 @@ import platform
 from dataclasses import dataclass, field
 
 from ..lsp.params import Params
-from ._env import float_env as _float_env, int_env as _int_env
+from ._env import (float_env as _float_env, int_env as _int_env,
+                    str_env as _str_env)
 
 #: Platform names that mean "a real chip" — the axon plugin's registered
 #: name is cwd-dependent in this image (axon vs tpu), and the miner's tier
@@ -497,7 +533,7 @@ def stripe_from_env() -> StripeParams:
 def qos_from_env() -> QosParams:
     d = QosParams()
     weights = []
-    for part in os.environ.get("DBM_QOS_WEIGHTS", "").split(","):
+    for part in _str_env("DBM_QOS_WEIGHTS", "").split(","):
         part = part.strip()
         if not part or ":" not in part:
             continue
@@ -541,13 +577,15 @@ def from_env() -> FrameworkConfig:
         max_backoff_interval=_int_env("DBM_MAX_BACKOFF",
                                       Params().max_backoff_interval),
     )
-    batch = os.environ.get("DBM_BATCH")
+    # 0/unset/malformed -> platform default (the _env contract: a bad
+    # override must never crash an endpoint).
+    batch = _int_env("DBM_BATCH", 0)
     return FrameworkConfig(
         params=params,
         # Normalized once here so every downstream comparison (make_searcher,
         # default_searcher_factory, models.default_tier) sees one casing.
-        compute=os.environ.get("DBM_COMPUTE", "auto").lower(),
-        batch=int(batch) if batch else None,
+        compute=_str_env("DBM_COMPUTE", "auto").lower(),
+        batch=batch if batch > 0 else None,
         lease=lease_from_env(),
         retry=retry_from_env(),
         cache=cache_from_env(),

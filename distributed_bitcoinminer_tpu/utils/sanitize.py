@@ -1,0 +1,208 @@
+"""Runtime sanitizer plane: event-loop stall watchdog + thread ownership.
+
+The dbmlint static pack (``distributed_bitcoinminer_tpu/analysis``)
+catches the two recurring concurrency bug classes of this codebase at
+the AST level — synchronous JAX/subprocess work reachable from ``async
+def`` bodies (PR 4 review: a wedged backend init on the event loop
+starves LSP heartbeats and gets the miner declared dead), and scheduler
+state mutated off its owning thread. This module is the RUNTIME
+complement for what an AST cannot see (dynamic dispatch, third-party
+callbacks, new code paths): opt-in via ``DBM_SANITIZE=1``, it
+
+- installs an **asyncio slow-callback watchdog**: every loop callback is
+  timed (one wrapped ``Handle._run``, two ``monotonic()`` reads — cheap
+  enough for the chaos/QoS suites to run sanitized wholesale), and one
+  that holds the loop longer than ``DBM_SANITIZE_SLOW_S`` seconds
+  (default 0.1) is NAMED in a ``dbm.sanitize`` warning and counted in
+  the ``sanitize.slow_callbacks`` metric, with the worst stall kept in
+  ``sanitize.slow_callback_worst_s``;
+- provides **thread-ownership assertions**: :class:`ThreadOwner` pins a
+  set of structures to the first thread that touches them (the
+  scheduler's miners/queue/in-flight tables are asyncio-actor state —
+  any cross-thread touch is a data race today or a heisenbug tomorrow),
+  and :func:`assert_off_loop` asserts a compute entry point is NOT
+  running on an event-loop thread (the miner's searcher resolution and
+  blocking search must stay on worker threads).
+
+Everything is observability-only: violations warn and count, never
+raise — a sanitizer that can kill a healthy-but-slow production process
+is worse than the bug it hunts. ``DBM_SANITIZE`` unset (the default)
+costs one boolean check per guarded call site and installs nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import threading
+import time
+from typing import Optional
+
+from ._env import float_env as _float_env, int_env as _int_env
+from .metrics import registry as _registry
+
+_log = logging.getLogger("dbm.sanitize")
+
+
+def enabled() -> bool:
+    """True when the sanitizer plane is switched on (``DBM_SANITIZE=1``).
+
+    Read per call (not cached at import) so tests and embedded drivers
+    can toggle the knob around individual constructions.
+    """
+    return _int_env("DBM_SANITIZE", 0) != 0
+
+
+def slow_threshold_s() -> float:
+    """Watchdog bound: callbacks holding the loop longer than this warn."""
+    return _float_env("DBM_SANITIZE_SLOW_S", 0.1)
+
+
+# --------------------------------------------------------------- watchdog
+
+_install_lock = threading.Lock()
+_orig_handle_run = None          # asyncio.events.Handle._run before patch
+_threshold_s: float = 0.1
+
+
+def _describe_callback(handle) -> str:
+    """Best-effort name of a Handle's callback for the stall warning.
+
+    Coroutine steps matter most: a Task's step handle is a
+    ``TaskStepMethWrapper`` whose repr names nothing — but its
+    ``__self__`` is the Task, and the Task's coroutine qualname is
+    exactly "which async def held the loop" (the PR-4 wedged-probe
+    incident shape this plane exists to attribute)."""
+    cb = getattr(handle, "_callback", None)
+    while isinstance(cb, functools.partial):
+        cb = cb.func
+    owner = getattr(cb, "__self__", None)
+    if isinstance(owner, asyncio.Task):
+        coro = owner.get_coro()
+        name = getattr(coro, "__qualname__", None)
+        if name:
+            return f"coroutine {name}"
+    for attr in ("__qualname__", "__name__"):
+        name = getattr(cb, attr, None)
+        if name:
+            mod = getattr(cb, "__module__", None)
+            return f"{mod}.{name}" if mod else name
+    return repr(cb)
+
+
+def install_watchdog(threshold_s: Optional[float] = None) -> None:
+    """Wrap ``asyncio.events.Handle._run`` with a stall timer (idempotent).
+
+    Covers every loop callback — ``call_soon``/``call_later`` handles AND
+    coroutine steps (Task.__step is itself scheduled through a Handle) —
+    so a synchronous ``subprocess.run`` inside an ``async def`` shows up
+    named, not as mystery heartbeat loss. Installed once per process;
+    a later call only tightens/loosens the threshold.
+    """
+    global _orig_handle_run, _threshold_s
+    with _install_lock:
+        if threshold_s is not None:
+            _threshold_s = threshold_s
+        else:
+            _threshold_s = slow_threshold_s()
+        if _orig_handle_run is not None:
+            return
+        _orig_handle_run = asyncio.events.Handle._run
+        slow = _registry().counter("sanitize.slow_callbacks")
+        worst = _registry().gauge("sanitize.slow_callback_worst_s")
+        orig = _orig_handle_run
+
+        def _timed_run(self):
+            t0 = time.monotonic()
+            try:
+                return orig(self)
+            finally:
+                dt = time.monotonic() - t0
+                if dt >= _threshold_s:
+                    slow.inc()
+                    if dt > worst.value:
+                        worst.set(dt)
+                    _log.warning(
+                        "event-loop stall: %s held the loop %.3fs "
+                        "(bound %.3fs) — move the blocking work to a "
+                        "worker thread (asyncio.to_thread)",
+                        _describe_callback(self), dt, _threshold_s)
+
+        asyncio.events.Handle._run = _timed_run
+
+
+def uninstall_watchdog() -> None:
+    """Restore the stock ``Handle._run`` (test isolation)."""
+    global _orig_handle_run
+    with _install_lock:
+        if _orig_handle_run is not None:
+            asyncio.events.Handle._run = _orig_handle_run
+            _orig_handle_run = None
+
+
+def ensure_sanitizer() -> bool:
+    """Install the watchdog iff ``DBM_SANITIZE=1``; returns enabled().
+
+    The scheduler and miner call this at construction (the same shape as
+    ``metrics.ensure_emitter``), so exporting one knob sanitizes every
+    endpoint in the process with no call-site changes.
+    """
+    if not enabled():
+        return False
+    install_watchdog()
+    return True
+
+
+# --------------------------------------------------------- thread ownership
+
+class ThreadOwner:
+    """Asserts a structure set is only touched from its owning thread.
+
+    The owner is the FIRST thread that calls :meth:`assert_here` — for
+    the scheduler that is the thread running its asyncio loop, without
+    needing the loop to exist at construction time. Violations warn with
+    both thread names and count in ``sanitize.ownership_violations``;
+    they never raise (observability-only, like the whole plane).
+    """
+
+    __slots__ = ("what", "_ident", "_name")
+
+    def __init__(self, what: str):
+        self.what = what
+        self._ident: Optional[int] = None
+        self._name = ""
+
+    def assert_here(self) -> bool:
+        me = threading.get_ident()
+        if self._ident is None:
+            self._ident = me
+            self._name = threading.current_thread().name
+            return True
+        if me == self._ident:
+            return True
+        _registry().counter("sanitize.ownership_violations").inc()
+        _log.warning(
+            "thread-ownership violation: %s touched from thread %r "
+            "(owner: %r)", self.what, threading.current_thread().name,
+            self._name)
+        return False
+
+
+def assert_off_loop(what: str) -> bool:
+    """Assert the caller is NOT on an event-loop thread.
+
+    Guards compute entry points (searcher resolution, blocking search):
+    a running loop in the current thread means a blocking call is about
+    to starve it. Warns + counts ``sanitize.loop_blocking``; never
+    raises.
+    """
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return True
+    _registry().counter("sanitize.loop_blocking").inc()
+    _log.warning(
+        "%s ran ON the event loop; expected a worker thread "
+        "(asyncio.to_thread)", what)
+    return False

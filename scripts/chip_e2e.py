@@ -35,9 +35,10 @@ def main() -> int:
     # 5-minute client timeout — and a CPU-resolved fallback would "pass"
     # without validating the chip path this script exists for.
     sys.path.insert(0, _REPO)
+    from distributed_bitcoinminer_tpu.utils._env import float_env
     from distributed_bitcoinminer_tpu.utils.config import (CHIP_PLATFORMS,
                                                            probe_backend)
-    deadline = float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300"))
+    deadline = float_env("DBM_BENCH_INIT_TIMEOUT", 300.0)
     probe = probe_backend(deadline, _REPO)
     if "error" in probe:
         print(f"chip unreachable: {probe['error']}")

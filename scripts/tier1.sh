@@ -14,6 +14,10 @@
 # A second deliberate addition (ISSUE 4): after a green main leg, a
 # knob-off matrix leg re-runs the recovery/chaos/parity modules with
 # DBM_PIPELINE=0 DBM_STRIPE=0 (see below; DBM_TIER1_MATRIX=0 skips).
+# A third (ISSUE 7): a dbmlint leg runs BEFORE pytest — pure AST, no
+# JAX import, seconds — and its failure fails the gate without eating
+# the pytest budget (tests still run so DOTS_PASSED stays comparable).
+# DBM_TIER1_LINT=0 skips it.
 #
 # Usage: scripts/tier1.sh            (from anywhere; cd's to the repo root)
 # Exit code is pytest's (or timeout's 124/143 on budget exhaustion).
@@ -21,6 +25,16 @@
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
 export DBM_METRICS_INTERVAL_S="${DBM_METRICS_INTERVAL_S:-2}"
+
+# dbmlint leg (ISSUE 7): the repo's AST invariant gate
+# (scripts/dbmlint.py vs analysis/baseline.json). New findings fail;
+# the run costs seconds because nothing imports JAX.
+lint_rc=0
+if [ "${DBM_TIER1_LINT:-1}" != "0" ]; then
+    timeout -k 5 120 python scripts/dbmlint.py
+    lint_rc=$?
+    echo "DBMLINT_RC=$lint_rc"
+fi
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -37,11 +51,16 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 # FIFO-parity pin is exactly what this leg exists for) with
 # DBM_PIPELINE=0 DBM_STRIPE=0 DBM_QOS=0 so the stock serial loop +
 # reference even split + FIFO dispatch order (the Go-parity shape)
-# stays covered in CI too. Skipped when the main leg already blew the
+# stays covered in CI too. The leg also runs with DBM_SANITIZE=1
+# (ISSUE 7): the chaos and QoS suites under it exercise real wedges,
+# kills, and concurrent dispatch, so the sanitizer's loop-stall
+# watchdog and thread-ownership assertions sweep the paths most likely
+# to regress — violations warn and count, never fail a test, so this
+# costs nothing when clean. Skipped when the main leg already blew the
 # budget. DBM_TIER1_MATRIX=0 opts out.
 if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     timeout -k 10 300 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
-        DBM_QOS=0 \
+        DBM_QOS=0 DBM_SANITIZE=1 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
@@ -52,4 +71,5 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     echo "MATRIX_KNOBS_OFF_RC=$mrc"
     [ "$mrc" -ne 0 ] && rc=$mrc
 fi
+[ "$lint_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$lint_rc
 exit $rc

@@ -206,11 +206,11 @@ def trace(span_log2: int = 29, dev_cpu: bool = False) -> dict:
     import tempfile
     import time
 
+    from distributed_bitcoinminer_tpu.utils._env import float_env
     from distributed_bitcoinminer_tpu.utils.config import (CHIP_PLATFORMS,
                                                            probe_backend)
     if not dev_cpu:
-        probe = probe_backend(
-            float(os.environ.get("DBM_BENCH_INIT_TIMEOUT", "300")))
+        probe = probe_backend(float_env("DBM_BENCH_INIT_TIMEOUT", 300.0))
         if "error" in probe or probe.get("platform") not in CHIP_PLATFORMS:
             report = {"error": "chip unreachable", "probe": probe}
             print(json.dumps(report))

@@ -32,6 +32,20 @@ from distributed_bitcoinminer_tpu.utils.config import (LeaseParams,
                                                        RetryParams)
 
 
+@pytest.fixture(autouse=True)
+def _sanitize_armed(monkeypatch):
+    """ISSUE 7: this suite runs with the runtime sanitizer armed — its
+    wedges, kills, and concurrent dispatch are exactly the paths the
+    loop-stall watchdog and thread-ownership assertions sweep.
+    Violations warn and count, never fail a test; the watchdog is
+    uninstalled afterwards so timing-sensitive suites see stock
+    callbacks."""
+    from distributed_bitcoinminer_tpu.utils import sanitize
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    yield
+    sanitize.uninstall_watchdog()
+
+
 def chaos_params(epoch_ms=40, limit=4, window=5):
     return Params(epoch_limit=limit, epoch_millis=epoch_ms,
                   window_size=window, max_backoff_interval=2)

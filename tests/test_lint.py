@@ -1,0 +1,429 @@
+"""dbmlint analyzer tests (ISSUE 7).
+
+Each analyzer gets a known-bad/known-good fixture pair proving it
+catches its bug class and stays quiet on the sanctioned shape; the
+repo-wide test pins the tree clean against the checked-in baseline
+(which is how every analyzer finding fixed in this PR is locked in);
+the mechanics tests cover suppression comments and the monotonic
+baseline workflow.
+
+Everything here is pure AST — no JAX, no network — so the module runs
+in milliseconds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from distributed_bitcoinminer_tpu.analysis import (compare, load_baseline,
+                                                   run_repo, run_source)
+from distributed_bitcoinminer_tpu.analysis.core import (Finding,
+                                                        baseline_path,
+                                                        save_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def snip(src: str) -> str:
+    return textwrap.dedent(src)
+
+
+# ------------------------------------------------------------- loop-block
+
+LOOP_BAD = snip("""
+    import time
+    import subprocess
+
+    class Worker:
+        async def serve(self):
+            time.sleep(1.0)                  # blocks the loop
+
+        async def probe(self):
+            subprocess.run(["true"])         # blocks the loop
+
+        async def resolve(self, msg):
+            searcher = self._get_searcher(msg.data)   # backend init!
+            return searcher
+""")
+
+LOOP_GOOD = snip("""
+    import asyncio
+    import time
+
+    class Worker:
+        async def serve(self):
+            await asyncio.sleep(0.1)          # asyncio sleep: fine
+            await asyncio.to_thread(self._scan)
+
+        def _scan(self):
+            time.sleep(1.0)                   # sync method: runs off-loop
+
+        async def pipelined(self, msg):
+            # Passing the method REFERENCE to a worker thread is the
+            # sanctioned hop — no call happens on the loop.
+            return await asyncio.to_thread(self._resolve_and_dispatch, msg)
+
+        def _resolve_and_dispatch(self, msg):
+            return self._get_searcher(msg.data)
+""")
+
+
+def test_loopblock_catches_known_bad():
+    found = run_source("loop-block", LOOP_BAD)
+    kinds = {f.key.rsplit(":", 1)[-1] for f in found}
+    assert len(found) == 3
+    assert "time.sleep" in kinds and "subprocess.run" in kinds
+    assert any("_get_searcher" in k for k in kinds)
+
+
+def test_loopblock_clean_on_known_good():
+    assert run_source("loop-block", LOOP_GOOD) == []
+
+
+def test_loopblock_scoped_to_apps_and_lsp():
+    # The same bad code outside apps/ and lsp/ is out of scope.
+    rel = "distributed_bitcoinminer_tpu/ops/_fixture.py"
+    assert run_source("loop-block", LOOP_BAD, rel=rel) == []
+
+
+# ------------------------------------------------------------ cardinality
+
+CARD_BAD = snip("""
+    class Sched:
+        def observe(self, metrics, conn_id, rate):
+            metrics.gauge("miner_rate_nps", miner=str(conn_id)).set(rate)
+""")
+
+CARD_GOOD_RETIRED = snip("""
+    class Sched:
+        def observe(self, metrics, conn_id, rate):
+            metrics.gauge("miner_rate_nps", miner=str(conn_id)).set(rate)
+
+        def on_drop(self, metrics, conn_id):
+            metrics.remove("miner_rate_nps", miner=str(conn_id))
+""")
+
+CARD_GOOD_LITERAL = snip("""
+    def setup(metrics):
+        metrics.counter("drops", reason="checksum").inc()
+        outcomes = {k: metrics.counter("outcomes", outcome=k)
+                    for k in ("ok", "exhausted")}
+        return outcomes
+""")
+
+
+def test_cardinality_catches_unretired_dynamic_label():
+    found = run_source("cardinality", CARD_BAD)
+    assert len(found) == 1
+    assert "miner_rate_nps" in found[0].message
+    assert "retirement" in found[0].message
+
+
+def test_cardinality_accepts_retirement_path():
+    assert run_source("cardinality", CARD_GOOD_RETIRED) == []
+
+
+def test_cardinality_accepts_literals_and_bounded_comprehensions():
+    assert run_source("cardinality", CARD_GOOD_LITERAL) == []
+
+
+# ----------------------------------------------------------- knob-hygiene
+
+KNOB_BAD = snip("""
+    import os
+
+    def load():
+        a = os.environ.get("DBM_FIXTURE_KNOB", "1")
+        b = os.environ["DBM_FIXTURE_KNOB2"]
+        c = "DBM_FIXTURE_KNOB3" in os.environ
+        return a, b, c
+""")
+
+KNOB_GOOD = snip("""
+    import os
+    from ..utils._env import int_env, str_env
+
+    def load():
+        a = int_env("DBM_FIXTURE_KNOB", 1)
+        b = str_env("DBM_FIXTURE_KNOB2", "")
+        os.environ["DBM_FIXTURE_KNOB3"] = "1"   # a WRITE: not a read
+        return a, b
+""")
+
+KNOB_COMPUTED = snip("""
+    from ..utils._env import int_env
+
+    def load(name):
+        return int_env(name, 1)     # computed knob name: ungreppable
+""")
+
+
+def test_knobs_catch_direct_reads():
+    found = run_source("knob-hygiene", KNOB_BAD)
+    assert len(found) == 3
+    assert all("route it through utils/_env.py" in f.message
+               for f in found)
+
+
+def test_knobs_accept_env_helpers_and_writes():
+    assert run_source("knob-hygiene", KNOB_GOOD) == []
+
+
+def test_knobs_flag_computed_knob_name():
+    found = run_source("knob-hygiene", KNOB_COMPUTED)
+    assert len(found) == 1 and "computed knob name" in found[0].message
+
+
+def test_knobs_allow_the_env_module_itself():
+    rel = "distributed_bitcoinminer_tpu/utils/_env.py"
+    assert run_source("knob-hygiene", KNOB_BAD, rel=rel) == []
+
+
+# ------------------------------------------------------------- jit-static
+
+JIT_BAD = snip("""
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("rem", "nbatches"))
+    def search_fixture(x, *, rem, nbatches):
+        return x
+
+    def caller(x, span, batch):
+        # computed INLINE at the boundary: unbounded signature set
+        return search_fixture(x, rem=7, nbatches=span // batch + 1)
+
+    def caller_via_local(x, span, batch):
+        n = span // batch            # same hazard, one assignment away
+        return search_fixture(x, rem=7, nbatches=n)
+""")
+
+JIT_GOOD = snip("""
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("rem", "nbatches"))
+    def search_fixture(x, *, rem, nbatches):
+        return x
+
+    NBATCHES = 8
+
+    def caller(x, plan, nbatches):
+        # literals, module constants, precomputed plan state, and
+        # forwarded parameters are the quantized-upstream shapes.
+        search_fixture(x, rem=7, nbatches=NBATCHES)
+        search_fixture(x, rem=plan.rem, nbatches=plan.nbatches)
+        for _, nb in plan.subs:
+            search_fixture(x, rem=plan.rem, nbatches=nb)
+        return search_fixture(x, rem=7, nbatches=nbatches)
+""")
+
+JIT_REL = "distributed_bitcoinminer_tpu/ops/_fixture.py"
+
+
+def test_jitstatic_catches_boundary_computed_static_args():
+    found = run_source("jit-static", JIT_BAD, rel=JIT_REL)
+    assert len(found) == 2
+    assert {f.key.split(":")[2] for f in found} == \
+        {"caller", "caller_via_local"}
+    assert all("nbatches" in f.message for f in found)
+
+
+def test_jitstatic_clean_on_quantized_shapes():
+    assert run_source("jit-static", JIT_GOOD, rel=JIT_REL) == []
+
+
+def test_jitstatic_scoped_to_compute_dirs():
+    rel = "distributed_bitcoinminer_tpu/apps/_fixture.py"
+    assert run_source("jit-static", JIT_BAD, rel=rel) == []
+
+
+# ------------------------------------------------------------ thread-state
+
+THREAD_BAD = snip("""
+    import asyncio
+
+    class Scheduler:
+        def __init__(self):
+            self.queue = []
+
+        async def on_result(self):
+            self.queue.append(1)             # event-loop side
+            await asyncio.to_thread(self._work)
+
+        def _work(self):
+            self.queue.pop()                 # worker-thread side
+""")
+
+THREAD_GOOD_TABLE = THREAD_BAD.replace(
+    "    def __init__(self):",
+    "    THREAD_SHARED = {\n"
+    "        \"queue\": \"serialized: one worker at a time\",\n"
+    "    }\n\n"
+    "    def __init__(self):")
+
+THREAD_GOOD_LOCK = snip("""
+    import asyncio
+    import threading
+
+    class Scheduler:
+        def __init__(self):
+            self.queue = []
+            self._lock = threading.Lock()
+
+        async def on_result(self):
+            with self._lock:
+                self.queue.append(1)
+            await asyncio.to_thread(self._work)
+
+        def _work(self):
+            with self._lock:
+                self.queue.pop()
+""")
+
+
+def test_threadstate_catches_undeclared_cross_thread_attr():
+    found = run_source("thread-state", THREAD_BAD)
+    assert len(found) == 1
+    assert "Scheduler.queue" in found[0].message
+    assert "THREAD_SHARED" in found[0].message
+
+
+THREAD_BAD_LOOP_WRITE = snip("""
+    import asyncio
+
+    class Scheduler:
+        def __init__(self):
+            self.pool_rate = None
+
+        async def on_result(self):
+            self.pool_rate = 2.0             # event-loop WRITE
+            await asyncio.to_thread(self._work)
+
+        def _work(self):
+            return self.pool_rate            # worker-thread READ
+""")
+
+
+def test_threadstate_catches_loop_written_thread_read():
+    found = run_source("thread-state", THREAD_BAD_LOOP_WRITE)
+    assert len(found) == 1
+    assert "Scheduler.pool_rate" in found[0].message
+
+
+def test_threadstate_accepts_ownership_table():
+    assert run_source("thread-state", THREAD_GOOD_TABLE) == []
+
+
+def test_threadstate_accepts_lock_guard():
+    assert run_source("thread-state", THREAD_GOOD_LOCK) == []
+
+
+# ---------------------------------------------------- suppression comments
+
+def test_ok_comment_suppresses_matching_analyzer():
+    src = LOOP_BAD.replace(
+        "time.sleep(1.0)                  # blocks the loop",
+        "time.sleep(1.0)  # dbmlint: ok[loop-block] test rig")
+    found = run_source("loop-block", src)
+    assert len(found) == 2      # the other two still fire
+
+
+def test_ok_comment_for_other_analyzer_does_not_suppress():
+    src = LOOP_BAD.replace(
+        "time.sleep(1.0)                  # blocks the loop",
+        "time.sleep(1.0)  # dbmlint: ok[cardinality] nope")
+    assert len(run_source("loop-block", src)) == 3
+
+
+# ------------------------------------------------------- baseline mechanics
+
+def _finding(key):
+    return Finding("loop-block", "f.py", 1, key, "msg " + key)
+
+
+def test_compare_splits_new_known_stale():
+    findings = [_finding("a"), _finding("b")]
+    new, known, stale = compare(findings, {"b": "msg b", "c": "msg c"})
+    assert [f.key for f in new] == ["a"]
+    assert [f.key for f in known] == ["b"]
+    assert stale == ["c"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, [_finding("k1"), _finding("k0")])
+    loaded = load_baseline(path)
+    assert list(loaded) == ["k0", "k1"]     # sorted, stable for diffs
+    with open(path) as f:
+        assert "shrink" in json.load(f)["comment"]
+
+
+def test_update_refuses_to_grow_without_force(tmp_path):
+    # CLI-level: a repo-shaped temp tree with one bad file and an empty
+    # baseline; --update-baseline must refuse, --force must accept.
+    pkg = tmp_path / "distributed_bitcoinminer_tpu"
+    (pkg / "apps").mkdir(parents=True)
+    (pkg / "analysis").mkdir()
+    (pkg / "apps" / "bad.py").write_text(
+        "import time\nclass W:\n    async def f(self):\n"
+        "        time.sleep(1)\n")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    base = [sys.executable, os.path.join(REPO, "scripts", "dbmlint.py"),
+            "--repo", str(tmp_path)]
+    r = subprocess.run(base + ["--update-baseline"], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 1 and "refusing to GROW" in r.stderr
+    r = subprocess.run(base + ["--update-baseline", "--force"], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0
+    r = subprocess.run(base, env=env, capture_output=True, text=True)
+    assert r.returncode == 0    # baselined: clean now
+    # A partial (--analyzer) run must neither rewrite the baseline (it
+    # would flush other analyzers' entries) nor report their entries as
+    # stale (code-review findings on the first cut).
+    r = subprocess.run(base + ["--analyzer", "cardinality",
+                               "--update-baseline"], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 2 and "requires a full run" in r.stderr
+    r = subprocess.run(base + ["--analyzer", "cardinality"], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "stale" not in r.stdout
+
+
+# ------------------------------------------------------------- repo-wide
+
+def test_repo_is_clean_against_checked_in_baseline():
+    """THE gate (acceptance): the tree has no new findings."""
+    findings = run_repo(REPO)
+    baseline = load_baseline(baseline_path(REPO))
+    new, _known, _stale = compare(findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_cli_exits_zero_on_repo_without_importing_jax():
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from distributed_bitcoinminer_tpu.analysis import run_repo, "
+        "load_baseline, compare; "
+        "from distributed_bitcoinminer_tpu.analysis.core import "
+        "baseline_path; "
+        "fs = run_repo(%r); "
+        "new, _, _ = compare(fs, load_baseline(baseline_path(%r))); "
+        "assert not new, new; "
+        "assert 'jax' not in sys.modules, 'lint must not import JAX'; "
+        "print('ok')" % (REPO, REPO, REPO))
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "ok"
+
+
+def test_cli_gate_subprocess():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "dbmlint.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout

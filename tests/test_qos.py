@@ -44,6 +44,20 @@ MINER_A, MINER_B, MINER_C = 1, 2, 3
 TEN_X, TEN_Y, TEN_Z = 10, 11, 12
 
 
+@pytest.fixture(autouse=True)
+def _sanitize_armed(monkeypatch):
+    """ISSUE 7: this suite runs with the runtime sanitizer armed — its
+    concurrent chunked dispatch and shed/cancel paths are exactly what
+    the loop-stall watchdog and thread-ownership assertions sweep.
+    Violations warn and count, never fail a test; the watchdog is
+    uninstalled afterwards so timing-sensitive suites see stock
+    callbacks."""
+    from distributed_bitcoinminer_tpu.utils import sanitize
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    yield
+    sanitize.uninstall_watchdog()
+
+
 # --------------------------------------------------------------- plane units
 
 

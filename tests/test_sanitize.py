@@ -1,0 +1,260 @@
+"""Runtime sanitizer tests (ISSUE 7, ``DBM_SANITIZE``).
+
+The acceptance case: the slow-callback watchdog must flag an injected
+100ms synchronous stall on the scheduler's event loop, NAMING the
+offending callback. Plus: threshold respected, thread-ownership
+violations on the scheduler's hot state, off-loop assertions on the
+miner compute entry points, disabled-by-default no-op, and the
+regression pin for the `_run_miner` loop-block fix (the deadlined
+accelerator probe now runs on a worker thread, so the loop stays
+responsive through it).
+"""
+
+import asyncio
+import logging
+import threading
+import time
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.message import Message, new_join
+from distributed_bitcoinminer_tpu.utils import sanitize
+from distributed_bitcoinminer_tpu.utils.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _watchdog_isolation():
+    yield
+    sanitize.uninstall_watchdog()
+
+
+def _counter(name):
+    return registry().counter(name).value
+
+
+class FakeServer:
+    """Recording write-only server (the scripted-scheduler harness)."""
+
+    def __init__(self):
+        self.writes = []
+
+    def write(self, conn_id, payload):
+        self.writes.append((conn_id, Message.from_json(payload)))
+
+
+class AsyncFakeServer(FakeServer):
+    """Adds an awaitable read() so Scheduler.run() serves on a real loop."""
+
+    def __init__(self):
+        super().__init__()
+        self.q = asyncio.Queue()
+
+    async def read(self):
+        return await self.q.get()
+
+
+def _injected_stall_100ms():
+    time.sleep(0.1)
+
+
+def test_watchdog_flags_injected_stall_on_scheduler_loop(monkeypatch,
+                                                         caplog):
+    """Acceptance: a 100ms synchronous stall on the serving scheduler's
+    event loop is flagged by name in dbm.sanitize and counted."""
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    monkeypatch.setenv("DBM_SANITIZE_SLOW_S", "0.05")
+    before = _counter("sanitize.slow_callbacks")
+
+    async def drive():
+        server = AsyncFakeServer()
+        sched = Scheduler(server)           # installs the watchdog
+        assert sched._owner is not None
+        task = asyncio.get_running_loop().create_task(sched.run())
+        await server.q.put((1, new_join().to_json()))   # serve something
+        await asyncio.sleep(0.01)
+        asyncio.get_running_loop().call_soon(_injected_stall_100ms)
+        await asyncio.sleep(0.05)
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        return sched
+
+    with caplog.at_level(logging.WARNING, logger="dbm.sanitize"):
+        sched = asyncio.run(drive())
+    assert sched.miners and sched.miners[0].conn_id == 1   # it served
+    assert _counter("sanitize.slow_callbacks") >= before + 1
+    joined = " ".join(r.getMessage() for r in caplog.records)
+    assert "_injected_stall_100ms" in joined, joined
+    assert "event-loop stall" in joined
+
+
+def test_watchdog_names_coroutine_stalls(monkeypatch, caplog):
+    """A stall INSIDE an async def (the PR-4 wedged-probe shape) must be
+    attributed to the coroutine's qualname, not an anonymous Task step
+    wrapper (code-review finding on the first cut)."""
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    monkeypatch.setenv("DBM_SANITIZE_SLOW_S", "0.05")
+
+    async def wedged_probe_coro():
+        time.sleep(0.1)        # sync stall inside the coroutine step
+
+    async def drive():
+        Scheduler(AsyncFakeServer())        # installs the watchdog
+        # Its own task: the stall lands in wedged_probe_coro's OWN step
+        # (awaiting the bare coroutine would charge the stall to this
+        # test harness's wrapper coroutine instead).
+        await asyncio.get_running_loop().create_task(wedged_probe_coro())
+
+    with caplog.at_level(logging.WARNING, logger="dbm.sanitize"):
+        asyncio.run(drive())
+    joined = " ".join(r.getMessage() for r in caplog.records)
+    assert "coroutine" in joined, joined
+    assert "wedged_probe_coro" in joined or "drive" in joined, joined
+    assert "TaskStepMethWrapper" not in joined
+
+
+def test_watchdog_threshold_respected(monkeypatch, caplog):
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    monkeypatch.setenv("DBM_SANITIZE_SLOW_S", "0.5")
+    before = _counter("sanitize.slow_callbacks")
+
+    async def drive():
+        Scheduler(AsyncFakeServer())
+        asyncio.get_running_loop().call_soon(_injected_stall_100ms)
+        await asyncio.sleep(0.02)
+
+    with caplog.at_level(logging.WARNING, logger="dbm.sanitize"):
+        asyncio.run(drive())
+    # 100ms < the 500ms bound: nothing flagged.
+    assert _counter("sanitize.slow_callbacks") == before
+
+
+def test_sanitizer_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DBM_SANITIZE", raising=False)
+    sched = Scheduler(FakeServer())
+    assert sched._owner is None
+    assert sanitize.ensure_sanitizer() is False
+    assert sanitize._orig_handle_run is None     # nothing installed
+
+
+def test_ownership_violation_counted_and_logged(monkeypatch, caplog):
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    sched = Scheduler(FakeServer())
+    sched._on_join(1)                        # main thread becomes owner
+    before = _counter("sanitize.ownership_violations")
+    with caplog.at_level(logging.WARNING, logger="dbm.sanitize"):
+        t = threading.Thread(target=sched._on_join, args=(2,),
+                             name="rogue-worker")
+        t.start()
+        t.join()
+    # _on_join cascades into _maybe_dispatch (both guarded), so one
+    # rogue call may count more than one violation — at least one.
+    assert _counter("sanitize.ownership_violations") > before
+    joined = " ".join(r.getMessage() for r in caplog.records)
+    assert "rogue-worker" in joined and "Scheduler hot state" in joined
+
+
+def test_ownership_same_thread_is_quiet(monkeypatch):
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    sched = Scheduler(FakeServer())
+    before = _counter("sanitize.ownership_violations")
+    sched._on_join(1)
+    sched._on_join(2)
+    assert _counter("sanitize.ownership_violations") == before
+
+
+def test_assert_off_loop_detects_loop_thread():
+    before = _counter("sanitize.loop_blocking")
+
+    async def on_loop():
+        return sanitize.assert_off_loop("test compute")
+
+    assert asyncio.run(on_loop()) is False
+    assert _counter("sanitize.loop_blocking") == before + 1
+    # Off the loop (plain thread): fine.
+    assert sanitize.assert_off_loop("test compute") is True
+    assert _counter("sanitize.loop_blocking") == before + 1
+
+
+def test_miner_compute_entry_points_assert_off_loop(monkeypatch):
+    """The miner's blocking search warns when (hypothetically) invoked on
+    the event loop — the runtime complement of the loop-block analyzer."""
+    from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
+    monkeypatch.setenv("DBM_SANITIZE", "1")
+    worker = MinerWorker.__new__(MinerWorker)
+    worker._sanitize = sanitize.enabled()
+    worker._searchers = {}
+    before = _counter("sanitize.loop_blocking")
+
+    async def on_loop():
+        # Inverted range returns before any searcher work, but the
+        # off-loop assertion has already fired by then.
+        return worker._search("m", 5, 4)
+
+    assert asyncio.run(on_loop()) == (2 ** 64 - 1, 0, 0)
+    assert _counter("sanitize.loop_blocking") == before + 1
+
+
+def test_miner_probe_runs_off_loop_keeping_heartbeats_alive(monkeypatch):
+    """Regression for the _run_miner loop-block fix: the deadlined
+    accelerator probe (a blocking subprocess join of up to 120s) must not
+    hold the event loop. Drives the extracted _probe_and_pin through the
+    same asyncio.to_thread hop _run_miner now uses, with a stand-in probe
+    that blocks 0.25s, and counts loop heartbeats meanwhile."""
+    from distributed_bitcoinminer_tpu.apps import miner
+    from distributed_bitcoinminer_tpu.utils import config
+    from distributed_bitcoinminer_tpu.utils.config import FrameworkConfig
+
+    monkeypatch.setenv("JAX_PLATFORMS", "")       # don't short-circuit
+    monkeypatch.delenv("DBM_COORDINATOR", raising=False)
+
+    def slow_probe(timeout_s, repo_dir=None, refresh=False):
+        time.sleep(0.25)
+        return {"error": "stand-in: tunnel wedged"}
+
+    monkeypatch.setattr(config, "probe_backend", slow_probe)
+    cfg = FrameworkConfig(compute="jnp")          # non-auto: no native build
+
+    async def drive():
+        ticks = 0
+        done = asyncio.Event()
+
+        async def heartbeat():
+            nonlocal ticks
+            while not done.is_set():
+                ticks += 1
+                await asyncio.sleep(0.01)
+
+        hb = asyncio.get_running_loop().create_task(heartbeat())
+        out = await asyncio.to_thread(miner._probe_and_pin, cfg)
+        done.set()
+        await hb
+        return out, ticks
+
+    out, ticks = asyncio.run(drive())
+    assert out.compute == "jnp"                   # explicit tier respected
+    # The probe blocked a worker thread for 0.25s; a responsive loop
+    # ticks ~25x. Inline (the old bug) it would tick ~once. Generous
+    # bound for a loaded CI box:
+    assert ticks >= 5, f"event loop starved during probe ({ticks} ticks)"
+
+
+def test_run_miner_uses_thread_hop_for_probe():
+    """Static pin of the same fix: _run_miner must not call the probe
+    path synchronously (the dbmlint loop-block gate enforces this
+    repo-wide; this is the targeted regression guard)."""
+    import ast
+    import inspect
+
+    from distributed_bitcoinminer_tpu.apps import miner
+    tree = ast.parse(inspect.getsource(miner._run_miner))
+    calls = [n for n in ast.walk(tree) if isinstance(n, ast.Call)]
+    direct = [n for n in calls
+              if getattr(n.func, "id", "") == "_probe_and_pin"]
+    assert not direct, "_probe_and_pin called inline on the event loop"
+    hops = [n for n in calls
+            if getattr(n.func, "attr", "") == "to_thread"
+            and any(getattr(a, "id", "") == "_probe_and_pin"
+                    for a in n.args)]
+    assert hops, "_run_miner no longer hops the probe to a worker thread"
