@@ -137,13 +137,15 @@ def _analyzers():
     # Finding/SourceFile from THIS module, so the catalog can only be
     # built once core's classes exist (the call at module bottom runs
     # after every definition above it).
-    from . import cardinality, jitstatic, knobs, loopblock, threadstate
+    from . import (cardinality, jitstatic, knobs, lockdiscipline,
+                   loopblock, threadstate)
     return {
         "loop-block": loopblock.analyze,
         "cardinality": cardinality.analyze,
         "knob-hygiene": knobs.analyze,
         "jit-static": jitstatic.analyze,
         "thread-state": threadstate.analyze,
+        "lock-discipline": lockdiscipline.analyze,
     }
 
 

@@ -1,0 +1,412 @@
+"""dbmcheck scenario catalog (ISSUE 8).
+
+Four REAL control-plane scenarios — the tier-1 leg explores these — and
+two KNOWN-BAD fixtures (deliberately racy mini-schedulers the explorer
+must be able to catch; they pin the checker's own sensitivity and are
+never part of the gate's clean-run requirement).
+
+Every scenario draws its constants (ranges, delays, which miner wedges)
+from the seed's ``Random`` stream, so each seed is both a schedule AND a
+slightly different population — a random walk covers timing races the
+pure step-ordering branching cannot reach (e.g. a lease expiring one
+tick before vs after a Result lands).
+
+Run-time randomness (per-chunk delays, fake compute costs) is drawn
+from PER-ACTOR child streams forked off the scenario stream at build
+time (:func:`_fork`), never from the shared stream: a shared stream's
+draw ORDER would follow the explored schedule, so a shrink/DFS
+perturbation of one choice would silently re-roll every later actor's
+timing — conflating ordering changes with population changes. With
+per-actor streams, an actor's k-th draw depends only on its own k,
+which is what makes shrinking converge on the ordering change alone.
+(Exact-spec replay is bit-exact either way.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from ...apps.scheduler import Scheduler
+from ...bitcoin.hash import hash_op
+from ...bitcoin.message import Message, MsgType, new_join
+from ...utils.config import CacheParams, LeaseParams, QosParams, StripeParams
+from .scenario import Ctx, Req, Scenario, oracle_min
+
+__all__ = ["SCENARIOS", "FIXTURES", "ALL"]
+
+_DATA = ("alpha", "bravo", "charlie", "delta")
+
+
+def _fork(rng: random.Random) -> random.Random:
+    """A child stream forked from ``rng`` at build time (see module
+    docstring: run-time draws must come from per-actor streams)."""
+    return random.Random(rng.getrandbits(64))
+
+
+def _make_sched(ctx: Ctx, lease: LeaseParams, qos: QosParams,
+                stripe: StripeParams = None) -> Scheduler:
+    # clock=ctx.loop.time: the admission buckets must tick on the
+    # VIRTUAL clock (they capture their clock at construction, before
+    # the time.monotonic patch could reach them).
+    sched = Scheduler(
+        ctx.server, lease=lease, cache=CacheParams(),
+        stripe=stripe if stripe is not None
+        else StripeParams(enabled=False), qos=qos, clock=ctx.loop.time)
+    ctx.sched = sched
+    ctx.spawn(sched.run())
+    return sched
+
+
+async def _warm_rates(ctx: Ctx, n_miners: int, rate: float) -> None:
+    """Wait for every miner to join, then pin the throughput EWMAs —
+    the striping/QoS-chunking planes need a warm pool, and warming via
+    real traffic would couple the scenario's shape to its schedule."""
+    while ctx.sched is None or len(ctx.sched.miners) < n_miners:
+        await asyncio.sleep(0.01)
+    for m in ctx.sched.miners:
+        m.rate_ewma = rate
+    ctx.sched._pool_rate = rate
+
+
+# ------------------------------------------------------------ lease_reissue
+
+class LeaseReissue(Scenario):
+    """A wedged miner's lease blows mid-request; the chunk is
+    speculatively re-issued and first-Result-wins dedup must keep the
+    merge exactly-once — raced against parked-chunk recovery, client
+    drops, and quarantine. Stock FIFO path (QoS off), so the reference
+    one-in-flight reply order is asserted globally."""
+
+    name = "lease_reissue"
+
+    def build(self, ctx: Ctx) -> None:
+        rng = ctx.rng
+        _make_sched(ctx, lease=LeaseParams(
+            grace_s=0.4, factor=4.0, floor_s=0.3, tick_s=0.05,
+            quarantine_after=rng.choice((1, 2)), ewma_alpha=0.3,
+            queue_alarm_s=30.0), qos=QosParams(enabled=False))
+        # One miner may misbehave: WEDGE (reads forever, never answers
+        # — pure lease blow) or go SLOW (answers after its lease blew —
+        # the first-Result-wins dedup race, dup_results > 0).
+        bad = rng.choice((None, 0, 1, 2))
+        slow = rng.random() < 0.5
+        for i in range(3):
+            kw = {}
+            mrng = _fork(rng)
+            if bad == i and not slow:
+                kw["wedge_after"] = rng.choice((0, 1))
+            if bad == i and slow:
+                kw["delay_fn"] = \
+                    lambda size, r=mrng: r.uniform(0.8, 2.0)
+            else:
+                kw.setdefault(
+                    "delay_fn",
+                    lambda size, r=mrng: r.uniform(0.02, 0.25))
+            ctx.add_miner(f"m{i}", **kw)
+        reqs = []
+        for j in range(rng.choice((2, 3))):
+            # Unique cache keys (the "#j" suffix): a duplicate would
+            # legitimately replay from the ResultCache at arrival and
+            # overtake the FIFO, which the global-FIFO check below
+            # deliberately does not model.
+            reqs.append(Req(f"{rng.choice(_DATA)}#{j}", 0,
+                            rng.choice((59, 119, 199)),
+                            pre_delay=rng.uniform(0.0, 0.3)))
+        ctx.add_client("c0", reqs)
+        if rng.random() < 0.5:
+            # A second client that drops right after sending: the
+            # cancel path must free the pool without corrupting c0.
+            ctx.add_client("c1", [Req(f"{rng.choice(_DATA)}#x", 0, 99,
+                                      pre_delay=rng.uniform(0.0, 0.4),
+                                      close_after=True)])
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_global_fifo(ctx)
+        out += self.check_accounting(ctx)
+        return out
+
+
+# ---------------------------------------------------------------- qos_shed
+
+class QosShed(Scenario):
+    """The fair-share plane under contention: a chunked elephant
+    interleaving with mice from two other tenants, token-bucket
+    admission (virtual-clock bucket) and oldest-first overload shedding
+    both able to fire. Every surviving request must merge exactly-once
+    oracle-exact in per-tenant order; shed tenants must see their conn
+    die and nothing else corrupt; the grant accounting must return to
+    zero."""
+
+    name = "qos_shed"
+
+    def build(self, ctx: Ctx) -> None:
+        rng = ctx.rng
+        sched = _make_sched(ctx, lease=LeaseParams(
+            grace_s=5.0, factor=4.0, floor_s=2.0, tick_s=0.1,
+            queue_alarm_s=30.0), qos=QosParams(
+            enabled=True, chunk_s=0.2, max_chunks=32, depth=2,
+            wholesale_s=0.5, max_queued=rng.choice((3, 4)),
+            rate=rng.choice((0.0, 0.5)), burst=2.0))
+        for i in range(2):
+            ctx.add_miner(
+                f"m{i}",
+                delay_fn=lambda size, r=_fork(rng):
+                    size / 1000.0 * r.uniform(0.8, 1.2))
+        ctx.spawn(_warm_rates(ctx, 2, 1000.0))
+        # Tenant 1: the elephant (estimated 1s > wholesale_s 0.5 at the
+        # warmed 2x1000 nps pool -> chunked activation, ~10 chunks).
+        ctx.add_client("elephant", [
+            Req(rng.choice(_DATA), 0, 1999, pre_delay=0.5)])
+        # Tenants 2 + 3: mice trains; pre-delays land them against the
+        # elephant's grant stream (and sometimes over the admission
+        # burst of 2, or the max_queued bound).
+        for t, n in (("mice_a", 3), ("mice_b", 2)):
+            reqs = [Req(rng.choice(_DATA), 0, rng.choice((99, 149)),
+                        pre_delay=0.5 + rng.uniform(0.0, 1.5))
+                    for _ in range(n)]
+            ctx.add_client(t, reqs)
+        self.sched = sched
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        # Shed bookkeeping: a script that saw its conn die must be
+        # matched by at least one counted shed (and vice versa a shed
+        # count with no dead conn would mean we closed nobody).
+        shed_conns = sum(1 for c in ctx.clients if c.shed)
+        shed_count = ctx.sched.stats["qos_shed"]
+        if shed_conns and not shed_count:
+            out.append(f"{shed_conns} client conn(s) died without any "
+                       f"counted QoS shed")
+        if shed_count and not shed_conns and \
+                not any(c.dropped for c in ctx.clients):
+            out.append(f"qos_shed counted {shed_count} but no client "
+                       f"conn died")
+        return out
+
+
+# ------------------------------------------------------- pipelined_dispatch
+
+class _FakeSearcher:
+    """Two-phase (dispatch/finalize) oracle searcher for the REAL
+    MinerWorker pipeline: compute cost is charged to the virtual clock
+    inside the executor step (the loop thread is blocked, so the jump
+    is atomic), sized so the scheduler's stripe planner produces
+    multi-chunk shares."""
+
+    def __init__(self, data: str, ctx: Ctx, rng: random.Random,
+                 rate: float = 4000.0):
+        self.data = data
+        self.ctx = ctx
+        self.rng = rng          # per-searcher stream (module docstring)
+        self.rate = rate
+
+    def _charge(self, size: int, frac: float = 1.0) -> None:
+        self.ctx.loop.advance(
+            size / self.rate * frac * self.rng.uniform(0.7, 1.3))
+
+    def search(self, lower: int, upper: int):
+        self._charge(upper - lower + 1)
+        return oracle_min(self.data, lower, upper)
+
+    def search_until(self, lower: int, upper: int, target: int):
+        from .scenario import oracle_until
+        self._charge(upper - lower + 1)
+        return oracle_until(self.data, lower, upper, target)
+
+    def dispatch(self, lower: int, upper: int):
+        self._charge(upper - lower + 1, frac=0.2)   # async enqueue cost
+        return (lower, upper)
+
+    def finalize(self, handle, lower: int):
+        lo, up = handle
+        self._charge(up - lo + 1, frac=0.8)         # force cost
+        return oracle_min(self.data, lo, up)
+
+
+class PipelinedDispatch(Scenario):
+    """The REAL miner-side dispatch pipeline (apps/miner.MinerWorker,
+    reader task + overlapped two-phase executor + to_thread hops) under
+    the REAL striping scheduler: Results must still land strictly in
+    request order per miner, and every merge stays exactly-once."""
+
+    name = "pipelined_dispatch"
+
+    def build(self, ctx: Ctx) -> None:
+        from ...apps.miner import MinerWorker
+        rng = ctx.rng
+        _make_sched(ctx, lease=LeaseParams(
+            grace_s=5.0, factor=4.0, floor_s=2.0, tick_s=0.1,
+            queue_alarm_s=30.0), qos=QosParams(enabled=False),
+            stripe=StripeParams(enabled=True, chunk_s=0.1, depth=4))
+        self.workers = []
+        for i in range(2):
+            chan = ctx.server.connect()
+            wrng = _fork(rng)
+            worker = MinerWorker(
+                f"det:{i}",
+                searcher_factory=lambda data, batch=None, r=wrng:
+                    _FakeSearcher(data, ctx, _fork(r)),
+                pipeline=True, pipeline_depth=rng.choice((2, 4)))
+            worker.client = chan
+            chan.write(new_join().to_json())
+            ctx.spawn(worker.run())
+            self.workers.append((worker, chan))
+        ctx.spawn(_warm_rates(ctx, 2, 4000.0))
+        reqs = []
+        for j in range(rng.choice((2, 3))):
+            reqs.append(Req(f"{rng.choice(_DATA)}#{j}", 0,
+                            rng.choice((799, 1199, 1599)),
+                            pre_delay=0.5 + 0.2 * j))
+        ctx.add_client("c0", reqs)
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_global_fifo(ctx)
+        out += self.check_accounting(ctx)
+        # In-order pipeline contract: each miner's k-th Result answers
+        # its k-th Request (oracle-checked — a pipelined executor that
+        # let chunk k+1 overtake chunk k would mismatch here).
+        for worker, chan in self.workers:
+            asked = [Message.from_json(p)
+                     for p in ctx.server.sent_to(chan.conn_id)]
+            asked = [m for m in asked if m.type == MsgType.REQUEST]
+            answered = [Message.from_json(p) for p in chan.sent]
+            answered = [m for m in answered if m.type == MsgType.RESULT]
+            for k, rep in enumerate(answered):
+                if k >= len(asked):
+                    out.append(f"miner conn {chan.conn_id}: more "
+                               f"Results than Requests")
+                    break
+                req = asked[k]
+                h, n = oracle_min(req.data, req.lower, req.upper)
+                if (rep.hash, rep.nonce) != (h, n):
+                    out.append(
+                        f"miner conn {chan.conn_id}: Result #{k} "
+                        f"({rep.hash}, {rep.nonce}) does not answer "
+                        f"Request #{k} [{req.lower}, {req.upper}] "
+                        f"(oracle ({h}, {n})) — pipeline reordered "
+                        f"Results")
+        return out
+
+
+# ------------------------------------------------------- difficulty_prefix
+
+class DifficultyPrefix(Scenario):
+    """Difficulty (first-hit) merges under re-issue and stock-miner
+    degradation: the prefix-release rule must hand back the globally
+    FIRST qualifying nonce when every miner speaks the extension, and
+    at-least-a-qualifying nonce when a stock miner weakened the merge —
+    never a non-qualifying or fabricated one."""
+
+    name = "difficulty_prefix"
+
+    def build(self, ctx: Ctx) -> None:
+        rng = ctx.rng
+        _make_sched(ctx, lease=LeaseParams(
+            grace_s=0.5, factor=4.0, floor_s=0.3, tick_s=0.05,
+            quarantine_after=2, queue_alarm_s=30.0),
+            qos=QosParams(enabled=False))
+        self.has_stock = rng.random() < 0.5
+        wedged = rng.choice((None, 0, 1, 2))
+        for i in range(3):
+            kw = {}
+            if wedged == i:
+                kw["wedge_after"] = rng.choice((0, 1))
+            if self.has_stock and i == 2:
+                kw["stock"] = True
+            ctx.add_miner(
+                f"m{i}",
+                delay_fn=lambda size, r=_fork(rng): r.uniform(0.02, 0.2),
+                **kw)
+        reqs = []
+        for _j in range(rng.choice((1, 2))):
+            data = f"{rng.choice(_DATA)}#{_j}"
+            upper = rng.choice((149, 209))
+            if rng.random() < 0.25:
+                target = 1          # unreachable: no-hit arg-min path
+            else:
+                q = rng.randrange(0, upper + 2)
+                target = hash_op(data, q) + 1   # q qualifies by def.
+            reqs.append(Req(data, 0, upper, target=target,
+                            pre_delay=rng.uniform(0.0, 0.3)))
+        ctx.add_client("c0", reqs)
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx, weak_ok=self.has_stock)
+        out += self.check_global_fifo(ctx)
+        out += self.check_accounting(ctx)
+        return out
+
+
+# ------------------------------------------------------- known-bad fixtures
+
+class FixtureLostUpdate(Scenario):
+    """KNOWN-BAD: classic read-yield-write lost update. Two tasks
+    increment a counter with an await between load and store; any
+    schedule that interleaves the loads loses one increment. dbmcheck
+    MUST find a failing schedule here (tests pin that it does)."""
+
+    name = "fixture_lost_update"
+
+    def build(self, ctx: Ctx) -> None:
+        self.box = {"counter": 0}
+
+        async def bump():
+            v = self.box["counter"]
+            await asyncio.sleep(0)       # the racy yield point
+            self.box["counter"] = v + 1
+
+        ctx.spawn(bump(), client=True)
+        ctx.spawn(bump(), client=True)
+
+    def check(self, ctx: Ctx):
+        if self.box["counter"] != 2:
+            return [f"lost update: counter is {self.box['counter']}, "
+                    f"expected 2"]
+        return []
+
+
+class FixtureDoubleReply(Scenario):
+    """KNOWN-BAD: a mini-scheduler that replies on a merged chunk
+    WITHOUT the answered[] guard the real scheduler carries — two
+    racing Results (a speculative re-issue and its original) can both
+    pass the not-yet-answered check and double-reply."""
+
+    name = "fixture_double_reply"
+
+    def build(self, ctx: Ctx) -> None:
+        self.replies: list = []
+        self.answered = False
+
+        async def on_result(tag):
+            if not self.answered:
+                await asyncio.sleep(0)   # check-then-act without a latch
+                self.replies.append(tag)
+                self.answered = True
+
+        ctx.spawn(on_result("original"), client=True)
+        ctx.spawn(on_result("reissue"), client=True)
+
+    def check(self, ctx: Ctx):
+        if len(self.replies) != 1:
+            return [f"exactly-once broken: {len(self.replies)} replies "
+                    f"({self.replies})"]
+        return []
+
+
+SCENARIOS = {
+    "lease_reissue": LeaseReissue,
+    "qos_shed": QosShed,
+    "pipelined_dispatch": PipelinedDispatch,
+    "difficulty_prefix": DifficultyPrefix,
+}
+
+FIXTURES = {
+    "fixture_lost_update": FixtureLostUpdate,
+    "fixture_double_reply": FixtureDoubleReply,
+}
+
+ALL = {**SCENARIOS, **FIXTURES}

@@ -343,7 +343,8 @@ class Scheduler:
                  lease: Optional[LeaseParams] = None,
                  cache: Optional[CacheParams] = None,
                  stripe: Optional[StripeParams] = None,
-                 qos: Optional[QosParams] = None):
+                 qos: Optional[QosParams] = None,
+                 clock=None):
         self.server = server
         self.lease = lease if lease is not None else LeaseParams()
         self.cache = cache if cache is not None else CacheParams()
@@ -407,7 +408,16 @@ class Scheduler:
         self._cache_trace_seq = 0
         # Fair-share QoS plane (ISSUE 5): always constructed (tenant
         # accounting is a few dicts), consulted only when qos.enabled.
-        self.qos_plane = QosPlane(self.metrics)
+        # ``clock`` (ISSUE 8) feeds the admission token buckets: the
+        # deterministic-schedule explorer (analysis/schedcheck) injects
+        # its virtual clock here so bucket refills are a function of the
+        # explored schedule, not of wall time. Note the scheduler's own
+        # lease/trace stamps read ``time.monotonic`` directly — the
+        # explorer patches that; this parameter exists because the
+        # bucket CAPTURES its clock at construction.
+        self.qos_plane = QosPlane(
+            self.metrics, clock=clock if clock is not None
+            else time.monotonic)
         self._tenant_weights: dict = {}    # programmatic overrides
 
     # ---------------------------------------------------------- public view

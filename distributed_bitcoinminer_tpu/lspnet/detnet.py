@@ -1,0 +1,136 @@
+"""Deterministic in-process transport shim (dbmcheck, ISSUE 8).
+
+The real stack — UDP endpoints, the LSP sliding-window engine, its
+epoch timers — is what the conformance and chaos suites exercise. The
+deterministic-schedule explorer (``analysis/schedcheck``) needs the
+OPPOSITE trade: no sockets, no retransmission state, no timers of its
+own, just the scheduler-visible surface of :class:`..lsp.server.
+AsyncServer` and :class:`..lsp.client.AsyncClient` over plain asyncio
+queues — so every message delivery is an event-loop step the explorer's
+picker orders, and the only state machines under test are the CONTROL
+PLANE's (scheduler, QoS, miner pipeline), not the transport's.
+
+Semantics preserved from the real stack (the scheduler depends on each):
+
+- ``read()`` yields ``(conn_id, payload)`` in delivery order, and
+  ``(conn_id, exc)`` exactly once when a peer's endpoint closes — the
+  drop event ``Scheduler._on_drop`` consumes.
+- ``write(conn_id, ...)`` raises :class:`~..lsp.errors.ConnectionClosed`
+  on a closed/unknown conn (``Scheduler._write`` catches ``LspError``).
+- ``close_conn(conn_id)`` (the QoS shed path) kills the peer endpoint:
+  its pending/later ``read()`` raises, like a dying LSP conn — and the
+  server read stream gets NO drop event for a close it initiated
+  (matching ``AsyncServer.close_conn``'s reaper, which removes the conn
+  without posting one; the peer's own ``close()`` is what posts drops).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple, Union
+
+from ..lsp.errors import ConnectionClosed
+
+__all__ = ["DetServer", "DetChannel"]
+
+ReadItem = Tuple[int, Union[bytes, Exception]]
+
+
+class DetChannel:
+    """One peer endpoint (a miner's or client's side of a conn).
+
+    Duck-types the slice of ``AsyncClient`` the apps consume: async
+    ``read()``, sync ``write(payload)``, async ``close()``.
+    """
+
+    def __init__(self, server: "DetServer", conn_id: int):
+        self._server = server
+        self.conn_id = conn_id
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self.closed = False
+        #: Every payload this endpoint wrote, in order (scenario checks).
+        self.sent: list = []
+
+    async def read(self) -> bytes:
+        if self.closed and self._inbox.empty():
+            raise ConnectionClosed(f"conn {self.conn_id} closed")
+        item = await self._inbox.get()
+        if isinstance(item, Exception):
+            # Leave the poison pill for any later read.
+            self._inbox.put_nowait(item)
+            raise item
+        return item
+
+    def write(self, payload: bytes) -> None:
+        if self.closed:
+            raise ConnectionClosed(f"conn {self.conn_id} closed")
+        self.sent.append(payload)
+        self._server._deliver(self.conn_id, payload)
+
+    async def close(self) -> None:
+        """Peer-initiated close: the server side observes a drop."""
+        if not self.closed:
+            self._kill()
+            self._server._on_peer_closed(self.conn_id)
+
+    def _kill(self) -> None:
+        self.closed = True
+        self._inbox.put_nowait(
+            ConnectionClosed(f"conn {self.conn_id} closed"))
+
+
+class DetServer:
+    """Deterministic AsyncServer stand-in: same read/write/close_conn
+    surface, backed by per-conn :class:`DetChannel` endpoints."""
+
+    def __init__(self) -> None:
+        self._read_queue: asyncio.Queue = asyncio.Queue()
+        self._chans: Dict[int, DetChannel] = {}
+        self._next_conn_id = 1
+        #: (conn_id, payload) of every server-side write, in order.
+        self.writes: list = []
+        #: (conn_id, payload) of every peer write, in DELIVERY order —
+        #: the arrival sequence scenario FIFO checks compare against.
+        self._read_log: list = []
+
+    # ------------------------------------------------------------ wiring
+
+    def connect(self) -> DetChannel:
+        """A new peer conn (miner or client); returns its endpoint."""
+        chan = DetChannel(self, self._next_conn_id)
+        self._chans[chan.conn_id] = chan
+        self._next_conn_id += 1
+        return chan
+
+    def _deliver(self, conn_id: int, payload: bytes) -> None:
+        self._read_log.append((conn_id, payload))
+        self._read_queue.put_nowait((conn_id, payload))
+
+    def _on_peer_closed(self, conn_id: int) -> None:
+        if conn_id in self._chans:
+            self._read_queue.put_nowait(
+                (conn_id, ConnectionClosed(f"conn {conn_id} dropped")))
+
+    # ------------------------------------------- AsyncServer surface
+
+    async def read(self) -> ReadItem:
+        return await self._read_queue.get()
+
+    def write(self, conn_id: int, payload: bytes) -> None:
+        chan = self._chans.get(conn_id)
+        if chan is None or chan.closed:
+            raise ConnectionClosed(
+                f"conn {conn_id} does not exist or is closed")
+        self.writes.append((conn_id, payload))
+        chan._inbox.put_nowait(payload)
+
+    def close_conn(self, conn_id: int) -> None:
+        chan = self._chans.get(conn_id)
+        if chan is None:
+            raise ConnectionClosed(f"conn {conn_id} does not exist")
+        if not chan.closed:
+            chan._kill()
+
+    def sent_to(self, conn_id: int) -> list:
+        """Payloads written to one conn, in order (scenario checks)."""
+        return [p for c, p in self.writes if c == conn_id]

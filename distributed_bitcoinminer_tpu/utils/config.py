@@ -174,6 +174,24 @@ Environment variables:
   (default 0 = unbounded): pod failure-domain bounds — one pod job's
   collective deadline, and the follower's optional between-jobs
   broadcast wait bound (parallel/multihost.bounded_pod_call).
+- ``DBM_CHECK`` (0 disables): scripts/tier1.sh's dbmcheck leg — the
+  deterministic interleaving explorer (``scripts/dbmcheck.py``,
+  ``analysis/schedcheck``): the control plane's scenario catalog run
+  over seed-driven random walks plus a bounded DFS on a controlled
+  event loop + virtual clock, with the merge/FIFO/accounting/liveness
+  invariants checked after every explored schedule and every failure
+  printed as a replayable (shrunk) seed spec.
+- ``DBM_CHECK_SEEDS``: random-walk seeds per scenario (default 200).
+- ``DBM_CHECK_BUDGET_S``: wall budget in seconds for the whole
+  exploration (default 75; scenarios share it).
+- ``DBM_CHECK_DFS``: bounded-exhaustive-DFS schedules per scenario
+  (default 64; 0 disables the DFS pass).
+- ``DBM_CHECK_SCENARIOS``: comma-separated scenario subset (default:
+  the full real-scenario catalog; ``scripts/dbmcheck.py --list``).
+- ``DBM_CHECK_MIN_DISTINCT``: tier1.sh-side floor on the leg's
+  DBMCHECK_DISTINCT total (default 500; 0 disables) — a starved box
+  whose budget expired after a handful of schedules must fail the
+  gate, not pass green having checked nothing.
 """
 
 from __future__ import annotations

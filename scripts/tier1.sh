@@ -36,6 +36,42 @@ if [ "${DBM_TIER1_LINT:-1}" != "0" ]; then
     echo "DBMLINT_RC=$lint_rc"
 fi
 
+# dbmcheck leg (ISSUE 8): deterministic interleaving exploration of the
+# control plane (scripts/dbmcheck.py) — a fixed seed budget of random
+# walks plus a bounded DFS pass over the scenario catalog, every
+# schedule invariant-checked, every failure printed with a replayable
+# (and shrunk) seed spec. Runs BEFORE pytest like the lint leg: no JAX
+# import, the whole budget is wall-bounded (DBM_CHECK_BUDGET_S, default
+# 75s), and its rc folds into the gate without eating the pytest
+# budget. DBM_CHECK=0 skips; DBM_CHECK_SEEDS / DBM_CHECK_DFS /
+# DBM_CHECK_SCENARIOS tune the sweep.
+# The leg also enforces an exploration FLOOR: a starved box whose wall
+# budget expired after a handful of schedules would otherwise pass
+# green having checked nothing (the "checker went blind" failure mode).
+# DBM_CHECK_MIN_DISTINCT (default 500, 0 disables — lower it alongside
+# DBM_CHECK_SEEDS on deliberately small runs) bounds it.
+check_rc=0
+if [ "${DBM_CHECK:-1}" != "0" ]; then
+    rm -f /tmp/_t1_check.log
+    # Kill deadline derives from the documented budget knob (it must
+    # not silently cap it) + headroom for the post-exploration shrink
+    # passes a violation triggers (up to 400 re-executions each).
+    check_kill=$(awk -v b="${DBM_CHECK_BUDGET_S:-75}" \
+        'BEGIN{printf "%d", (b+0)+90}')
+    timeout -k 5 "$check_kill" python scripts/dbmcheck.py 2>&1 \
+        | tee /tmp/_t1_check.log
+    check_rc=${PIPESTATUS[0]}
+    distinct=$(grep -a '^DBMCHECK_DISTINCT=' /tmp/_t1_check.log | tail -1 | cut -d= -f2)
+    min_distinct="${DBM_CHECK_MIN_DISTINCT:-500}"
+    if [ "$check_rc" -eq 0 ] && [ "$min_distinct" != "0" ] && \
+       [ "${distinct:-0}" -lt "$min_distinct" ]; then
+        echo "DBMCHECK_FLOOR: only ${distinct:-0} distinct schedules" \
+             "explored (< $min_distinct) — treating as failure"
+        check_rc=3
+    fi
+    echo "DBMCHECK_LEG_RC=$check_rc"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
@@ -72,4 +108,5 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     [ "$mrc" -ne 0 ] && rc=$mrc
 fi
 [ "$lint_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$lint_rc
+[ "$check_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$check_rc
 exit $rc

@@ -1,0 +1,42 @@
+"""Environmental-failure detection for tier-1 skips (ISSUE 8 satellite).
+
+Three tests have failed identically since PR 3 on every box shaped like
+the CI container, for a reason outside the repo's control: the image
+bakes the **libtpu PJRT plugin** (plus the axon TPU runtime) into
+site-packages, but no TPU is actually attached. Any FRESH subprocess
+that runs jax backend discovery without this test suite's
+``JAX_PLATFORMS=cpu`` config pin — the two ``test_multihost``
+``jax.distributed`` children, and ``test_utils``' deliberate
+bad-platform fallback probe — then attempts libtpu/axon initialization,
+which blocks on GCP-metadata / device-tunnel lookups until the caller's
+deadline kills it (observed trace: ``gcp_metadata_utils.cc`` /
+``env_var_utils.cc`` in the child's stderr after SIGKILL).
+
+The detection below encodes exactly that condition, so the skip applies
+on chip-less containers carrying the plugin and nowhere else: on a real
+TPU VM a device node (``/dev/accel*`` or ``/dev/vfio/*``) exists and
+the tests run; on a box without libtpu the hang cannot happen and the
+tests run. The point (ISSUE 8): tier-1 signal becomes violations-only —
+a red tier-1 means a real regression, not container weather.
+"""
+
+import glob
+import importlib.util
+
+
+def tpu_plugin_without_device() -> bool:
+    """True iff the libtpu PJRT plugin is importable but no TPU device
+    node is attached — the fresh-subprocess-backend-discovery-hangs
+    environment described in the module docstring."""
+    if importlib.util.find_spec("libtpu") is None:
+        return False
+    return not (glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*"))
+
+
+SKIP_REASON = (
+    "environmental (pre-existing since PR 3): libtpu PJRT plugin baked "
+    "into the image but no TPU device node (/dev/accel*, /dev/vfio/*) "
+    "attached — a fresh subprocess's jax backend discovery (which runs "
+    "without this suite's JAX_PLATFORMS=cpu config pin) wedges in "
+    "libtpu/axon + GCP-metadata init until the deadline kills it; "
+    "detection: tests/_env_detect.tpu_plugin_without_device()")

@@ -1,0 +1,249 @@
+"""dbmcheck — deterministic interleaving explorer tests (ISSUE 8).
+
+Four layers, mirroring the checker's own trust chain:
+
+1. **DetLoop determinism**: the controlled event loop + virtual clock
+   reproduce a schedule bit-for-bit from its seed (the golden-replay
+   contract every printed repro spec depends on) and explore distinct
+   schedules across seeds.
+2. **Sensitivity**: the KNOWN-BAD fixture scenarios (a deliberately
+   racy mini-scheduler pair) are caught within a fixed seed budget by
+   both random walks and bounded DFS, the failing schedule shrinks to
+   a minimal trace that still fails, and the shrunk spec replays
+   deterministically.
+3. **Cleanliness**: the real control-plane scenarios hold every
+   invariant over a seeded sweep — the regression pin for the clean
+   bill recorded in ``analysis/schedcheck/REPORT.md`` (22k schedules).
+4. **Liveness detection**: a scenario that cannot complete is reported
+   as a violation, not an infinite loop.
+
+No sockets, no JAX, no wall-clock sleeps: everything runs on the
+virtual clock, so the module is fast and schedule-exact.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from distributed_bitcoinminer_tpu.analysis import schedcheck
+from distributed_bitcoinminer_tpu.analysis.schedcheck import (
+    ALL, FIXTURES, SCENARIOS, execute, format_spec, parse_spec, replay,
+    run_dfs, run_walks, shrink)
+from distributed_bitcoinminer_tpu.analysis.schedcheck.detloop import (
+    DetLoop, RandomPicker, virtual_time)
+from distributed_bitcoinminer_tpu.analysis.schedcheck.scenario import (
+    Ctx, Scenario)
+
+
+# ------------------------------------------------------------ detloop core
+
+def test_detloop_virtual_clock_drives_timers_and_monotonic():
+    loop = DetLoop()
+    seen = []
+
+    async def main():
+        import time
+        await asyncio.sleep(0.5)
+        seen.append(("slept", loop.time(), time.monotonic()))
+
+    with loop.running(), virtual_time(loop):
+        t = loop.create_task(main())
+        status = loop.run_until(t.done, 100, 10.0)
+        loop.drain()
+    loop.close()
+    assert status == "done"
+    # Virtual time advanced exactly to the timer, and the patched
+    # time.monotonic read the same clock.
+    assert seen == [("slept", 0.5, 0.5)]
+    assert not loop.exceptions
+
+
+def test_detloop_to_thread_runs_off_loop():
+    loop = DetLoop()
+    out = {}
+
+    def job():
+        # No running loop on the worker thread: the sanitize
+        # assert_off_loop contract holds under the harness.
+        try:
+            asyncio.get_running_loop()
+            out["on_loop"] = True
+        except RuntimeError:
+            out["on_loop"] = False
+        return 42
+
+    async def main():
+        out["result"] = await asyncio.to_thread(job)
+
+    with loop.running(), virtual_time(loop):
+        t = loop.create_task(main())
+        assert loop.run_until(t.done, 100, 10.0) == "done"
+        loop.drain()
+    loop.close()
+    assert out == {"on_loop": False, "result": 42}
+
+
+# ---------------------------------------------------------- golden replay
+
+def test_golden_replay_seed_reproduces_step_sequence_bit_for_bit():
+    """The replay contract: same seed -> the IDENTICAL executed step
+    sequence, across independent executions and through the printed
+    seed-spec path."""
+    for name in ("lease_reissue", "qos_shed", "pipelined_dispatch"):
+        first = execute(ALL[name](), 11)
+        again = execute(ALL[name](), 11)
+        via_spec = replay(f"{name}:rw:11")
+        assert first.steps == again.steps == via_spec.steps, name
+        assert first.trace == again.trace == via_spec.trace, name
+        assert len(first.steps) > 20, f"{name}: suspiciously short"
+
+
+def test_distinct_seeds_explore_distinct_schedules():
+    keys = {execute(ALL["lease_reissue"](), seed).schedule_key()
+            for seed in range(12)}
+    assert len(keys) >= 10      # near-total schedule diversity
+
+
+def test_trace_replay_reproduces_its_own_schedule():
+    base = execute(ALL["difficulty_prefix"](), 3)
+    again = execute(ALL["difficulty_prefix"](), 3,
+                    choices=base.choices)
+    assert again.steps == base.steps
+
+
+def test_spec_roundtrip():
+    assert parse_spec("qos_shed:rw:42") == ("qos_shed", 42, None)
+    assert parse_spec("qos_shed:tr:7:0.2.1") == ("qos_shed", 7, [0, 2, 1])
+    assert parse_spec("qos_shed:tr:7:") == ("qos_shed", 7, [])
+
+
+# ------------------------------------------------- known-bad sensitivity
+
+@pytest.mark.parametrize("fixture", sorted(FIXTURES))
+def test_random_walk_catches_known_bad_fixture(fixture):
+    """The checker must BITE: each deliberately racy mini-scheduler
+    yields a violation within a fixed seed budget (empirical hit rate
+    is ~45%/seed; 30 seeds bound the miss chance below 1e-7)."""
+    failures = [seed for seed in range(30)
+                if execute(ALL[fixture](), seed).failed]
+    assert failures, f"{fixture}: no violation in 30 seeds"
+
+
+@pytest.mark.parametrize("fixture", sorted(FIXTURES))
+def test_dfs_catches_known_bad_fixture(fixture):
+    st = run_dfs(fixture, seed=0, depth=4, limit=40)
+    assert st.failures, f"{fixture}: DFS found no violation in 40 runs"
+
+
+def test_shrunk_repro_still_fails_and_replays_deterministically():
+    failing = next(r for r in (execute(ALL["fixture_double_reply"](), s)
+                               for s in range(30)) if r.failed)
+    small = shrink(failing)
+    assert small.failed
+    assert len(small.choices) <= len(failing.choices)
+    spec = format_spec(small, shrunk=True)
+    rr = replay(spec)
+    assert rr.failed and rr.steps == small.steps
+
+
+def test_shrink_survives_choice_point_collapse():
+    """Regression (review round 2): zeroing one choice may CUT whole
+    task chains — the kept candidate then has fewer choice points than
+    the trace the pass started from, and the shrink walk must re-read
+    its bound instead of indexing off the end."""
+    class Collapsing(Scenario):
+        name = "collapse_fixture"
+
+        def build(self, ctx: Ctx) -> None:
+            tasks = []
+
+            async def worker(i):
+                await asyncio.sleep(0)
+                await asyncio.sleep(0)
+
+            async def canceller():
+                # Scheduled early, this erases the workers' remaining
+                # steps (and their choice points) from the schedule.
+                for t in tasks:
+                    t.cancel()
+
+            for i in range(3):
+                tasks.append(ctx.spawn(worker(i), client=True))
+            ctx.spawn(canceller(), client=True)
+
+        def check(self, ctx: Ctx):
+            return ["always fails (shrink-mechanics fixture)"]
+
+    ALL["collapse_fixture"] = Collapsing   # shrink re-instantiates by name
+    try:
+        for seed in range(6):
+            failing = execute(Collapsing(), seed)
+            assert failing.failed
+            small = shrink(failing)      # must not raise IndexError
+            assert small.failed
+    finally:
+        del ALL["collapse_fixture"]
+
+
+def test_explicit_trace_results_format_as_trace_specs():
+    st = run_dfs("fixture_lost_update", seed=0, depth=4, limit=40)
+    failing = st.failures[0]
+    spec = format_spec(failing)
+    assert ":tr:" in spec            # never a misleading rw: spec
+    assert replay(spec).failed
+
+
+# -------------------------------------------------- real-scenario health
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_real_scenario_holds_all_invariants(name):
+    """The clean-bill regression pin: a seeded sweep of each real
+    scenario (the tier-1 dbmcheck leg runs far more) must hold the
+    exactly-once / FIFO / accounting / liveness / sanitizer pack."""
+    for seed in range(25):
+        result = execute(ALL[name](), seed)
+        assert not result.failed, (
+            f"{name} seed {seed}: {result.violations} "
+            f"(repro: {format_spec(result)})")
+
+
+def test_walks_report_explored_and_distinct_counts():
+    st = run_walks("lease_reissue", 15, seed0=100)
+    assert st.explored == 15
+    assert len(st.distinct) >= 13
+    assert not st.failures
+
+
+# ------------------------------------------------------ liveness detection
+
+def test_deadlocked_scenario_reported_as_liveness_violation():
+    class Deadlock(Scenario):
+        name = "deadlock_fixture"
+
+        def build(self, ctx: Ctx) -> None:
+            async def waits_forever():
+                await asyncio.Future()   # no one will ever resolve it
+
+            ctx.spawn(waits_forever(), client=True)
+
+    result = execute(Deadlock(), 0)
+    assert result.failed
+    assert any("liveness" in v for v in result.violations)
+    assert result.status == "deadlock"
+
+
+def test_vtime_budget_reported_as_liveness_violation():
+    class Spin(Scenario):
+        name = "spin_fixture"
+
+        def build(self, ctx: Ctx) -> None:
+            async def ticks_forever():
+                while True:
+                    await asyncio.sleep(60.0)
+
+            ctx.spawn(ticks_forever(), client=True)
+
+    result = execute(Spin(), 0)
+    assert result.failed
+    assert result.status == "vtime"

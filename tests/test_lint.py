@@ -321,6 +321,90 @@ def test_threadstate_accepts_lock_guard():
     assert run_source("thread-state", THREAD_GOOD_LOCK) == []
 
 
+# --------------------------------------------------------- lock-discipline
+
+LOCK_BAD_AWAIT = snip("""
+    import asyncio
+    import threading
+
+    class Actor:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        async def serve(self):
+            with self._lock:
+                await asyncio.sleep(0.1)     # parked holding the lock
+""")
+
+LOCK_BAD_BLOCKING = snip("""
+    import threading
+    import time
+
+    class Actor:
+        def __init__(self):
+            self._mu = threading.Lock()      # name has no 'lock' hint
+
+        def probe(self):
+            with self._mu:
+                probe_backend(3.0)           # minutes under a lock
+
+        async def drain(self):
+            async with self.state_lock:
+                time.sleep(0.5)              # blocking under asyncio lock
+""")
+
+LOCK_GOOD = snip("""
+    import asyncio
+
+    class Actor:
+        async def serve(self):
+            async with self._lock:
+                self.count += 1              # pure state flip: fine
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+
+        async def read(self):
+            with open("f") as fh:            # not a lock
+                await asyncio.sleep(0)
+""")
+
+
+def test_lockdiscipline_catches_sync_lock_across_await():
+    found = run_source("lock-discipline", LOCK_BAD_AWAIT)
+    assert len(found) == 1
+    assert "held across an await" in found[0].message
+    assert "self._lock" in found[0].key
+
+
+def test_lockdiscipline_catches_blocking_call_under_lock():
+    found = run_source("lock-discipline", LOCK_BAD_BLOCKING)
+    keys = {f.key for f in found}
+    # The ctor-assignment tracking catches `_mu` (no name hint), and the
+    # name hint catches `state_lock` with no assignment in sight.
+    assert any("self._mu:probe_backend" in k for k in keys)
+    assert any("self.state_lock:time.sleep" in k for k in keys)
+    assert len(found) == 2
+
+
+def test_lockdiscipline_clean_on_known_good():
+    assert run_source("lock-discipline", LOCK_GOOD) == []
+
+
+def test_lockdiscipline_scoped_to_apps_and_lsp():
+    rel = "distributed_bitcoinminer_tpu/utils/_fixture.py"
+    assert run_source("lock-discipline", LOCK_BAD_AWAIT, rel=rel) == []
+
+
+def test_lockdiscipline_suppression_needs_matching_analyzer():
+    src = LOCK_BAD_AWAIT.replace(
+        "await asyncio.sleep(0.1)     # parked holding the lock",
+        "await asyncio.sleep(0.1)  "
+        "# dbmlint: ok[lock-discipline] bounded: test rig")
+    assert run_source("lock-discipline", src) == []
+
+
 # ---------------------------------------------------- suppression comments
 
 def test_ok_comment_suppresses_matching_analyzer():

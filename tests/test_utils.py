@@ -3,6 +3,8 @@
 import logging
 import os
 
+import pytest
+
 from distributed_bitcoinminer_tpu.lsp.params import Params
 from distributed_bitcoinminer_tpu.utils import (
     FrameworkConfig, Timer, configure_logging, from_env)
@@ -73,6 +75,13 @@ def test_apply_jax_platform_env_falls_back_on_bad_platform():
     selection instead of crashing every later jax.devices()."""
     import subprocess
     import sys
+
+    from _env_detect import SKIP_REASON, tpu_plugin_without_device
+    if tpu_plugin_without_device():
+        # The fallback path this test exercises runs backend discovery
+        # in a fresh child process, which is exactly the shape the
+        # baked-in libtpu plugin wedges on a chip-less box.
+        pytest.skip(SKIP_REASON)
 
     code = (
         "from distributed_bitcoinminer_tpu.utils.config import "
