@@ -30,6 +30,15 @@ Scope: ``ops/``, ``models/``, ``parallel/``. Two passes:
      enforces a bounded value set. Sites that ARE bounded by
      construction document it with
      ``# dbmlint: ok[jit-static] <why bounded>``.
+
+   Calls to a registered QUANTIZER (``BOUNDED_CALLS``) are stable by
+   definition: the function's whole contract is to collapse a runtime
+   value onto a bounded set — ``pow2_bucket`` (ops/search.py, ISSUE 9)
+   maps coalesced-batch row counts onto powers of two, bounding the
+   padded batch-geometry signature set at log2(max rows). Teaching the
+   analyzer the quantizer (instead of suppressing per site) keeps every
+   future batched call site machine-checked: an unquantized row count
+   at a static boundary still fails.
 """
 
 from __future__ import annotations
@@ -40,6 +49,12 @@ from typing import Dict, List, Optional, Set
 from .core import Finding, SourceFile, dotted
 
 NAME = "jit-static"
+
+#: Registered quantizers: calls whose RESULT is bounded by the callee's
+#: contract (see module docstring). Matched on the dotted name's last
+#: segment so both ``pow2_bucket(n)`` and ``search.pow2_bucket(n)``
+#: resolve.
+BOUNDED_CALLS = {"pow2_bucket"}
 
 SCOPE_PREFIXES = (
     "distributed_bitcoinminer_tpu/ops/",
@@ -152,6 +167,8 @@ def _stable(expr: Optional[ast.expr], assigns: Dict[str, List],
         fname = dotted(expr.func)
         if fname in ("bool", "str"):   # bounded / non-shape coercions
             return all(_stable(a, assigns, depth + 1) for a in expr.args)
+        if fname.rsplit(".", 1)[-1] in BOUNDED_CALLS:
+            return True   # registered quantizer: bounded by contract
         return False
     return False
 

@@ -30,7 +30,8 @@ import random
 from ...apps.scheduler import Scheduler
 from ...bitcoin.hash import hash_op
 from ...bitcoin.message import Message, MsgType, new_join
-from ...utils.config import CacheParams, LeaseParams, QosParams, StripeParams
+from ...utils.config import (CacheParams, CoalesceParams, LeaseParams,
+                             QosParams, StripeParams)
 from .scenario import Ctx, Req, Scenario, oracle_min
 
 __all__ = ["SCENARIOS", "FIXTURES", "ALL"]
@@ -45,14 +46,17 @@ def _fork(rng: random.Random) -> random.Random:
 
 
 def _make_sched(ctx: Ctx, lease: LeaseParams, qos: QosParams,
-                stripe: StripeParams = None) -> Scheduler:
+                stripe: StripeParams = None,
+                coalesce: CoalesceParams = None) -> Scheduler:
     # clock=ctx.loop.time: the admission buckets must tick on the
     # VIRTUAL clock (they capture their clock at construction, before
     # the time.monotonic patch could reach them).
     sched = Scheduler(
         ctx.server, lease=lease, cache=CacheParams(),
         stripe=stripe if stripe is not None
-        else StripeParams(enabled=False), qos=qos, clock=ctx.loop.time)
+        else StripeParams(enabled=False), qos=qos,
+        coalesce=coalesce if coalesce is not None
+        else CoalesceParams(enabled=False), clock=ctx.loop.time)
     ctx.sched = sched
     ctx.spawn(sched.run())
     return sched
@@ -225,6 +229,22 @@ class _FakeSearcher:
         self._charge(up - lo + 1, frac=0.8)         # force cost
         return oracle_min(self.data, lo, up)
 
+    def dispatch_batch(self, entries):
+        """Batched-dispatch contract (ISSUE 9): one 'launch' for many
+        jobs, charged as a single compute interval — the coalesced
+        shape the batched_dispatch scenario drives through the REAL
+        miner executor."""
+        for _s, lo, up in entries:
+            if lo > up:
+                raise ValueError("empty range")
+        self._charge(sum(up - lo + 1 for _s, lo, up in entries),
+                     frac=0.2)
+        return [(s.data, lo, up) for s, lo, up in entries]
+
+    def finalize_batch(self, handle):
+        self._charge(sum(up - lo + 1 for _d, lo, up in handle), frac=0.8)
+        return [oracle_min(d, lo, up) for d, lo, up in handle]
+
 
 class PipelinedDispatch(Scenario):
     """The REAL miner-side dispatch pipeline (apps/miner.MinerWorker,
@@ -289,6 +309,95 @@ class PipelinedDispatch(Scenario):
                         f"Request #{k} [{req.lower}, {req.upper}] "
                         f"(oracle ({h}, {n})) — pipeline reordered "
                         f"Results")
+        return out
+
+
+# ------------------------------------------------------- batched_dispatch
+
+class BatchedDispatch(Scenario):
+    """Cross-request batched dispatch (ISSUE 9) under the REAL
+    scheduler/QoS and REAL coalescing MinerWorkers: a chunked elephant
+    plus mice trains from two other tenants, the scheduler's coalescing
+    window stacking small grants on one miner, and the miner executor
+    draining them into shared batched launches. Every reply must stay
+    exactly-once oracle-exact in per-tenant order, the grant accounting
+    must balance, and each miner's k-th Result must answer its k-th
+    Request — a coalescer that scattered batch results out of drain
+    order, or attributed them to the wrong request, fails here."""
+
+    name = "batched_dispatch"
+
+    def build(self, ctx: Ctx) -> None:
+        from ...apps.miner import MinerWorker
+        rng = ctx.rng
+        lanes = rng.choice((3, 4, 8))
+        _make_sched(ctx, lease=LeaseParams(
+            grace_s=5.0, factor=4.0, floor_s=2.0, tick_s=0.1,
+            queue_alarm_s=30.0), qos=QosParams(
+            enabled=True, chunk_s=0.2, max_chunks=32, depth=2,
+            wholesale_s=0.5),
+            coalesce=CoalesceParams(
+                enabled=True, lanes=lanes,
+                small_s=rng.choice((0.1, 0.25))))
+        self.workers = []
+        for i in range(2):
+            chan = ctx.server.connect()
+            wrng = _fork(rng)
+            worker = MinerWorker(
+                f"det:{i}",
+                searcher_factory=lambda data, batch=None, r=wrng:
+                    _FakeSearcher(data, ctx, _fork(r)),
+                pipeline=True, pipeline_depth=8,
+                coalesce=True, coalesce_lanes=lanes,
+                coalesce_max=1 << 20)
+            worker.client = chan
+            chan.write(new_join().to_json())
+            ctx.spawn(worker.run())
+            self.workers.append((worker, chan))
+        ctx.spawn(_warm_rates(ctx, 2, 4000.0))
+        # Tenant 1: a chunked elephant (est 2s > wholesale 0.5s at the
+        # warmed 2 x 4000 nps pool) whose grant stream the mice must
+        # interleave — and sometimes share windows — with.
+        ctx.add_client("elephant", [
+            Req(rng.choice(_DATA), 0, rng.choice((7999, 11999)),
+                pre_delay=0.5)])
+        # Tenants 2 + 3: mice trains of small argmin requests (each one
+        # QoS chunk, each coalescible at the warmed rate) landing while
+        # the elephant is mid-grant.
+        for t, n in (("mice_a", rng.choice((2, 3))), ("mice_b", 2)):
+            reqs = [Req(f"{rng.choice(_DATA)}#{t}{j}", 0,
+                        rng.choice((99, 199, 399)),
+                        pre_delay=0.6 + rng.uniform(0.0, 1.0))
+                    for j in range(n)]
+            ctx.add_client(t, reqs)
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        # In-order coalesced scatter: each miner's k-th Result answers
+        # its k-th Request, oracle-exact (same contract as the
+        # pipelined_dispatch scenario — a batch written out of drain
+        # order, or mis-scattered across requests, mismatches here).
+        for worker, chan in self.workers:
+            asked = [Message.from_json(p)
+                     for p in ctx.server.sent_to(chan.conn_id)]
+            asked = [m for m in asked if m.type == MsgType.REQUEST]
+            answered = [Message.from_json(p) for p in chan.sent]
+            answered = [m for m in answered if m.type == MsgType.RESULT]
+            for k, rep in enumerate(answered):
+                if k >= len(asked):
+                    out.append(f"miner conn {chan.conn_id}: more "
+                               f"Results than Requests")
+                    break
+                req = asked[k]
+                h, n = oracle_min(req.data, req.lower, req.upper)
+                if (rep.hash, rep.nonce) != (h, n):
+                    out.append(
+                        f"miner conn {chan.conn_id}: Result #{k} "
+                        f"({rep.hash}, {rep.nonce}) does not answer "
+                        f"Request #{k} [{req.lower}, {req.upper}] "
+                        f"(oracle ({h}, {n})) — coalescer broke the "
+                        f"in-order scatter")
         return out
 
 
@@ -401,6 +510,7 @@ SCENARIOS = {
     "lease_reissue": LeaseReissue,
     "qos_shed": QosShed,
     "pipelined_dispatch": PipelinedDispatch,
+    "batched_dispatch": BatchedDispatch,
     "difficulty_prefix": DifficultyPrefix,
 }
 

@@ -21,6 +21,26 @@ Two serving shapes (``DBM_PIPELINE``, default on):
 - **Serial** (``DBM_PIPELINE=0``): the stock read -> blocking search ->
   write loop, preserved verbatim for Go-parity conformance and replay.
 
+Cross-request batched dispatch (ISSUE 9, ``DBM_COALESCE``, default on,
+pipelined shape only): a 2^14 "mouse" chunk pays a full device dispatch
++ force + serialize round-trip for ~1ms of compute, so at
+millions-of-users mice traffic the miner drowns in launch overhead, not
+hashing. The pipelined executor therefore COALESCES: after pulling a
+chunk from its local queue, it opportunistically drains further
+compatible small chunks (argmin mode, size <= ``DBM_COALESCE_MAX``, up
+to ``DBM_COALESCE_LANES``) — possibly from different requests/tenants;
+the scheduler's QoS grant hint deliberately stacks such chunks on one
+miner — and dispatches them as ONE batched device launch
+(models.NonceSearcher.dispatch_batch: per-row plans, a per-request
+segment-min on device), then scatters the per-request Results out of a
+single force, still written strictly in request order, so the
+scheduler's FIFO pop contract and every merge rule are untouched.
+``DBM_COALESCE=0`` never drains: each chunk takes the stock
+one-chunk-one-dispatch path bit-for-bit (the tier-1 matrix leg pins
+it). Batches the searcher cannot serve (no batch API, gated pallas
+tier, mixed incompatible searchers) degrade to the per-chunk path, in
+order.
+
 Either way the compute runs in worker threads so the asyncio loop keeps
 serving LSP heartbeats/acks while the device is busy; JAX dispatch is
 thread-safe.
@@ -66,6 +86,11 @@ _MET_QDEPTH = _M.histogram("miner.dispatch_queue_depth", OCCUPANCY_BUCKETS)
 _MET_OCCUPANCY = _M.gauge("miner.pipeline_occupancy")
 _MET_OVERLAP = _M.gauge("miner.pipeline_overlap_ratio")
 _MET_TWO_PHASE = _M.counter("miner.chunks_two_phase")
+# Batched-dispatch plane (ISSUE 9): coalesced dispatches, the chunks
+# that rode them, and the width distribution (chunks per shared launch).
+_MET_COAL_DISPATCHES = _M.counter("miner.coalesced_dispatches")
+_MET_COAL_CHUNKS = _M.counter("miner.chunks_coalesced")
+_MET_COAL_WIDTH = _M.histogram("miner.coalesce_width", OCCUPANCY_BUCKETS)
 
 
 class _ThroughputWindow:
@@ -167,6 +192,21 @@ class HostSearcher:
         """Join a dispatched scan -> exact (min_hash, argmin_nonce)."""
         return handle.result()
 
+    def dispatch_batch(self, entries: list):
+        """Batched-dispatch contract (same as
+        ``NonceSearcher.dispatch_batch``): start every job's scan on its
+        searcher's own worker pool. The host tier has no per-launch
+        device overhead to amortize, but serving the API keeps the
+        miner's coalescer uniform — a coalesced batch pipelines through
+        one finalize instead of degrading to N blocking chunks."""
+        if not all(isinstance(s, HostSearcher) for s, _lo, _up in entries):
+            return None
+        return [s.dispatch(lower, upper) for s, lower, upper in entries]
+
+    def finalize_batch(self, handle) -> list:
+        """Join a batched dispatch -> one (hash, nonce) pair per entry."""
+        return [f.result() for f in handle]
+
 
 def default_searcher_factory(data: str, batch: Optional[int] = None,
                              tier: Optional[str] = None):
@@ -223,7 +263,10 @@ class MinerWorker:
                  searcher_factory: Callable = default_searcher_factory,
                  batch: Optional[int] = None,
                  pipeline: Optional[bool] = None,
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 coalesce: Optional[bool] = None,
+                 coalesce_lanes: Optional[int] = None,
+                 coalesce_max: Optional[int] = None):
         self.hostport = hostport
         self.params = params
         self.searcher_factory = searcher_factory
@@ -239,6 +282,18 @@ class MinerWorker:
         self.pipeline_depth = max(1, pipeline_depth if pipeline_depth
                                   is not None
                                   else _int_env("DBM_PIPELINE_DEPTH", 8))
+        # Cross-request batched dispatch (ISSUE 9): env-defaulted like
+        # the pipeline so the DBM_COALESCE=0 matrix leg pins the stock
+        # one-chunk-one-dispatch path through every existing harness.
+        self.coalesce = (coalesce if coalesce is not None
+                         else _int_env("DBM_COALESCE", 1) != 0)
+        self.coalesce_lanes = max(2, coalesce_lanes
+                                  if coalesce_lanes is not None
+                                  else _int_env("DBM_COALESCE_LANES", 8))
+        self.coalesce_max = (coalesce_max if coalesce_max is not None
+                             else _int_env("DBM_COALESCE_MAX", 1 << 20))
+        if self.coalesce_max <= 0:
+            self.coalesce = False    # repo 0-disables convention
         self._window = _ThroughputWindow()
         ensure_emitter()   # DBM_METRICS_INTERVAL_S-driven; 0 = no-op
         # Runtime sanitizer (ISSUE 7): DBM_SANITIZE=1 installs the
@@ -332,10 +387,13 @@ class MinerWorker:
 
         reader_task = asyncio.create_task(reader())
         _IDLE = object()
-        inflight = None     # (msg, searcher, handle, t0) awaiting finalize
+        inflight = None     # (msg[s], searcher, handle, t0, dispatch_s)
+        carry = None        # drained-but-incompatible msg (or _STOP)
         try:
             while True:
-                if inflight is None:
+                if carry is not None:
+                    msg, carry = carry, None
+                elif inflight is None:
                     msg = await queue.get()
                 else:
                     try:
@@ -346,19 +404,50 @@ class MinerWorker:
                     return   # transport died; nothing can be written
                 if msg is not _IDLE:
                     _MET_QDEPTH.observe(queue.qsize())
+                # Cross-request coalescing (ISSUE 9): opportunistically
+                # drain further compatible small chunks already sitting
+                # in the local queue — consecutive FIFO entries, so
+                # batching them into one launch and writing their
+                # Results in drain order preserves strict request
+                # order. Never waits: an empty queue means the batch is
+                # whatever arrived, keeping single-chunk latency
+                # untouched. DBM_COALESCE=0 skips the drain entirely —
+                # the stock one-chunk path below is then bit-for-bit.
+                msgs = None
+                if self.coalesce and msg is not _IDLE \
+                        and self._coalescible(msg):
+                    msgs = [msg]
+                    while len(msgs) < self.coalesce_lanes:
+                        try:
+                            nxt = queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        if nxt is _STOP or not self._coalescible(nxt):
+                            carry = nxt
+                            break
+                        msgs.append(nxt)
+                    if len(msgs) == 1:
+                        msgs = None      # solo: stock path, bit-for-bit
                 # Start the new chunk's dispatch on its own worker thread
                 # BEFORE draining the previous chunk — this concurrency
                 # is the overlap window, and it also means a dispatch
                 # stuck in jit trace+compile (fresh signature) cannot
                 # delay the in-flight chunk's Result write.
                 dtask = t0 = None
-                if msg is not _IDLE and msg.target == 0 \
+                if msgs is not None:
+                    t0 = time.monotonic()
+                    dtask = asyncio.create_task(asyncio.to_thread(
+                        self._resolve_and_dispatch_batch, msgs))
+                elif msg is not _IDLE and msg.target == 0 \
                         and msg.lower <= msg.upper:
                     t0 = time.monotonic()
                     dtask = asyncio.create_task(asyncio.to_thread(
                         self._resolve_and_dispatch, msg))
                 if inflight is not None:
-                    if not await self._finalize_and_reply(*inflight):
+                    fin = (self._finalize_and_reply_batch
+                           if isinstance(inflight[0], list)
+                           else self._finalize_and_reply)
+                    if not await fin(*inflight):
                         if dtask is not None:
                             # Transport died with a dispatch possibly
                             # mid-compile on its thread: reap it quietly
@@ -374,17 +463,37 @@ class MinerWorker:
                     try:
                         searcher, handle, dispatch_s = await dtask
                     except Exception:
-                        await self._exit_broken(msg)
+                        await self._exit_broken(
+                            msgs[0] if msgs is not None else msg)
                         return
-                    if handle is not None:
+                    if handle is not None and msgs is not None:
+                        inflight = (msgs, searcher, handle, t0, dispatch_s)
+                        _MET_TWO_PHASE.inc(len(msgs))
+                    elif handle is not None:
                         inflight = (msg, searcher, handle, t0, dispatch_s)
                         _MET_TWO_PHASE.inc()
+                    elif msgs is not None:
+                        # No batch API (or gated tier): degrade to the
+                        # stock per-chunk two-phase path, in drain order
+                        # (sequential — the rare path loses overlap,
+                        # never order or answers).
+                        for m in msgs:
+                            if not await self._serve_two_phase(m):
+                                return
                     elif not await self._serve_blocking(msg):
                         return   # no two-phase API: degraded, in order
                 elif not await self._serve_blocking(msg):
                     return
         finally:
             reader_task.cancel()
+
+    def _coalescible(self, msg) -> bool:
+        """May this Request share a coalesced launch? Argmin mode only
+        (difficulty chunks keep their early-exit pipelining), non-empty
+        range, and small enough that batching it cannot meaningfully
+        delay its own first result (``DBM_COALESCE_MAX`` nonces)."""
+        return (msg.target == 0 and msg.lower <= msg.upper
+                and msg.upper - msg.lower + 1 <= self.coalesce_max)
 
     def _resolve_and_dispatch(self, msg):
         """Worker-thread half of a two-phase chunk: resolve the searcher
@@ -422,6 +531,96 @@ class MinerWorker:
         busy_s = dispatch_s + (time.monotonic() - t2)
         return self._reply(msg, best_hash, best_nonce, 0, t0,
                            busy_s=busy_s)
+
+    def _resolve_and_dispatch_batch(self, msgs: list):
+        """Worker-thread half of a COALESCED chunk set (ISSUE 9):
+        resolve every chunk's searcher (cache-miss construction runs
+        JAX backend init — same off-loop rule as the single-chunk path)
+        and start ONE batched dispatch through the first searcher's
+        ``dispatch_batch``. Returns ``(searcher, handle, dispatch_s)``;
+        ``handle`` is None when the searchers cannot serve a batch
+        (no batch API, incompatible mix, gated pallas tier) — the
+        caller then degrades to per-chunk serving, still in order."""
+        if self._sanitize:
+            _sanitize.assert_off_loop("miner batched resolution/dispatch")
+        t0 = time.monotonic()
+        searchers = [self._get_searcher(m.data) for m in msgs]
+        s0 = searchers[0]
+        if hasattr(s0, "dispatch_batch") and hasattr(s0, "finalize_batch"):
+            handle = s0.dispatch_batch(
+                [(s, m.lower, m.upper)
+                 for s, m in zip(searchers, msgs)])
+            if handle is not None:
+                return s0, handle, time.monotonic() - t0
+        return s0, None, 0.0
+
+    async def _finalize_and_reply_batch(self, msgs: list, searcher,
+                                        handle, t0: float,
+                                        dispatch_s: float) -> bool:
+        """Force a coalesced dispatch with ONE fetch and scatter the
+        per-request Results in request order; False ends the serve
+        loop."""
+        t2 = time.monotonic()
+        try:
+            results = await asyncio.to_thread(searcher.finalize_batch,
+                                              handle)
+        except Exception:
+            await self._exit_broken(msgs[0])
+            return False
+        busy_s = dispatch_s + (time.monotonic() - t2)
+        return self._reply_batch(msgs, results, t0, busy_s)
+
+    def _reply_batch(self, msgs: list, results: list, t0: float,
+                     busy_s: float) -> bool:
+        """Batch-aware accounting + in-order Result scatter (ISSUE 9
+        satellite): busy time is attributed ONCE per shared launch —
+        observing the same interval per chunk would hand the
+        chunk-latency histogram N copies of the full batch latency, and
+        nonces are split per request so the throughput window (and the
+        scheduler's windowed rate EWMA downstream of the Result pacing)
+        measures real work over real wall clock, not N chunks each
+        claiming the whole launch."""
+        t1 = time.monotonic()
+        _MET_CHUNK_S.observe(max(busy_s, 1e-9))
+        _MET_COAL_DISPATCHES.inc()
+        _MET_COAL_CHUNKS.inc(len(msgs))
+        _MET_COAL_WIDTH.observe(len(msgs))
+        total = sum(m.upper - m.lower + 1 for m in msgs
+                    if m.upper >= m.lower)
+        if total:
+            self._window.observe(t0, t1, total)
+        for msg, (best_hash, best_nonce) in zip(msgs, results):
+            _MET_CHUNKS.inc()
+            if msg.upper >= msg.lower:
+                _MET_NONCES.inc(msg.upper - msg.lower + 1)
+            try:
+                self.client.write(
+                    new_result(best_hash, best_nonce, 0).to_json())
+            except LspError:
+                return False
+            self.jobs_done += 1
+        return True
+
+    async def _serve_two_phase(self, msg) -> bool:
+        """One chunk through the stock single-chunk two-phase machinery
+        (resolve+dispatch off-loop, then finalize+reply), degrading to
+        the blocking path when the searcher lacks the split. Used by
+        the coalescer's no-batch-API degrade path — the chunks were
+        already drained from the queue, so they cannot re-enter the
+        overlapped main loop; serving them here keeps order and
+        per-chunk accounting identical to the stock path."""
+        t0 = time.monotonic()
+        try:
+            searcher, handle, dispatch_s = await asyncio.to_thread(
+                self._resolve_and_dispatch, msg)
+        except Exception:
+            await self._exit_broken(msg)
+            return False
+        if handle is None:
+            return await self._serve_blocking(msg)
+        _MET_TWO_PHASE.inc()
+        return await self._finalize_and_reply(msg, searcher, handle, t0,
+                                              dispatch_s)
 
     async def _serve_blocking(self, msg) -> bool:
         """One chunk through the stock blocking search; False ends the

@@ -122,6 +122,21 @@ nothing bounds intake. With QoS on, every request is keyed to a TENANT
   of hanging into their wire deadline. ResultCache replays are answered
   BEFORE admission and are never shed — a retry storm of already-
   answered requests burns no quota.
+- Coalescing grant hint (ISSUE 9, ``DBM_COALESCE``): within one QoS
+  pump pass, once a SMALL chunk (argmin mode, <=
+  ``CoalesceParams.max_nonces``) is granted to a miner, further small
+  grants — typically other tenants' mice, per DRR — may target the
+  same miner's COALESCING WINDOW, up to ``lanes`` chunks sharing one
+  ``coalesce_id``. Windowed chunks count as ONE live chunk against the
+  per-miner ``QosParams.depth`` cap (they will share one device
+  launch on the miner: apps/miner.py's coalescer drains them from its
+  local queue into a single batched dispatch), while per-tenant DRR
+  deficits, admission debits, in-flight accounting, leases, and every
+  merge rule stay per chunk, unchanged. The hint is what actually
+  lands N small chunks in one miner's queue at once — without it the
+  depth cap trickles mice out one-per-free-slot and the miner-side
+  coalescer has nothing to batch. ``DBM_COALESCE=0`` never opens a
+  window: grants and live accounting are bit-identical to stock.
 
 Observability plane (ISSUE 3): every counter that used to live in the
 ad-hoc ``stats`` dict is now a series in a per-scheduler metrics
@@ -166,8 +181,9 @@ from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
 from ..utils import sanitize as _sanitize
-from ..utils.config import CacheParams, LeaseParams, QosParams, \
-    StripeParams, qos_from_env, stripe_from_env
+from ..utils.config import CacheParams, CoalesceParams, LeaseParams, \
+    QosParams, StripeParams, coalesce_from_env, qos_from_env, \
+    stripe_from_env
 from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS, Registry,
                              RequestTrace, TraceBuffer, ensure_emitter,
                              registry as process_registry)
@@ -182,7 +198,7 @@ STAT_COUNTERS = (
     "quarantines", "cache_hits", "cache_misses", "cache_stores",
     "queue_alarms", "inflight_alarms", "no_eligible_miner",
     "desperation_dispatch", "leases_blown_spurious", "chunks_striped",
-    "qos_grants", "qos_shed",
+    "qos_grants", "qos_shed", "qos_window_grants",
 )
 
 
@@ -245,6 +261,13 @@ class Chunk:
     lease_started: bool = False
     lease_blown: bool = False  # expiry observed (counted once per entry)
     reissued: bool = False     # a speculative copy is already in flight
+    # Coalescing grant hint (ISSUE 9): chunks sharing a coalesce_id were
+    # granted into one miner's coalescing window — they may share a
+    # device launch, and they count as ONE live chunk against the QoS
+    # depth cap (_miner_live). None = stock accounting. A speculative
+    # re-issue copy never inherits the id (fresh Chunk): the takeover
+    # miner runs it solo.
+    coalesce_id: Optional[int] = None
 
     @property
     def size(self) -> int:
@@ -344,6 +367,7 @@ class Scheduler:
                  cache: Optional[CacheParams] = None,
                  stripe: Optional[StripeParams] = None,
                  qos: Optional[QosParams] = None,
+                 coalesce: Optional[CoalesceParams] = None,
                  clock=None):
         self.server = server
         self.lease = lease if lease is not None else LeaseParams()
@@ -355,6 +379,11 @@ class Scheduler:
         # Env-defaulted like stripe: DBM_QOS=0 pins the stock FIFO path
         # through every existing harness (the tier-1 matrix leg).
         self.qos = qos if qos is not None else qos_from_env()
+        # Env-defaulted like stripe/qos: DBM_COALESCE=0 pins stock grant
+        # accounting (no windows, no shared live slots) bit-for-bit.
+        self.coalesce = (coalesce if coalesce is not None
+                         else coalesce_from_env())
+        self._next_coalesce_id = 0
         self.results: Optional[ResultCache] = (
             ResultCache(self.cache.size) if self.cache.enabled else None)
         self.miners: list[MinerState] = []      # join order, like minersArray
@@ -948,8 +977,20 @@ class Scheduler:
         self.qos_plane.set_weight(tenant, weight)
 
     def _miner_live(self, miner: MinerState) -> int:
-        """Live (non-cancelled) chunks in a miner's pending FIFO."""
-        return sum(1 for c in miner.pending if not c.cancelled)
+        """Live (non-cancelled) chunks in a miner's pending FIFO, with
+        a coalescing window's chunks counting as ONE (they share one
+        device launch on the miner — ISSUE 9): the QoS depth cap bounds
+        launches in flight, not rows per launch."""
+        n = 0
+        groups = set()
+        for c in miner.pending:
+            if c.cancelled:
+                continue
+            if c.coalesce_id is None:
+                n += 1
+            else:
+                groups.add(c.coalesce_id)
+        return n + len(groups)
 
     def _qos_capacity_pool(self) -> list[MinerState]:
         """Miners that may take an incremental QoS chunk: not
@@ -1060,11 +1101,62 @@ class Scheduler:
             heads[t] = ("start", req, cost)
         return heads
 
+    def _coalescible_cost(self, req: Request, cost: int) -> bool:
+        """May a grant of ``cost`` nonces for ``req`` enter a coalescing
+        window? Argmin mode only, and SMALL twice over: an absolute
+        nonce bound (``max_nonces``) and an estimated-seconds bound at
+        the pool rate (``small_s``) — only a chunk whose scan is
+        launch-overhead-scale belongs in a shared launch; an absolute
+        bound alone would misclassify a slow pool's rate-scaled
+        elephant chunks as mice and serialize the elephant onto one
+        miner's window."""
+        if not self.coalesce.enabled or req.target \
+                or cost > self.coalesce.max_nonces:
+            return False
+        rate = self._pool_rate
+        if rate is not None and rate > 0:
+            return cost <= rate * self.coalesce.small_s
+        return True
+
+    def _window_slot(self, window: dict, job_id: int):
+        """The first open coalescing-window slot that can take a chunk
+        of ``job_id``: a free lane, NOT already holding this job
+        (windows batch across requests; stacking one request's own
+        chunks would just re-merge what the chunk planner split), on a
+        live non-quarantined miner. Returns ``(miner, slot)`` or
+        ``(None, None)``. ONE definition shared by pump candidacy
+        (:meth:`_window_room`) and the grant itself (:meth:`_qos_grant`)
+        — if the two drifted, the pump could admit a candidate the
+        grant cannot place and spin (code review)."""
+        for conn_id, slot in window.items():
+            if slot[1] >= self.coalesce.lanes or job_id in slot[2]:
+                continue
+            m = self._find_miner(conn_id)
+            if m is not None and not m.quarantined:
+                return m, slot
+        return None, None
+
+    def _window_room(self, window: dict, job_id: int = 0) -> bool:
+        """Any joinable window for ``job_id``? (See
+        :meth:`_window_slot`.)"""
+        if not window:
+            return False
+        return self._window_slot(window, job_id)[0] is not None
+
     def _qos_pump(self) -> None:
         """The QoS grant loop: while grantable work and pool capacity
         exist, pick the next tenant by deficit-round-robin and execute
         ONE grant — an incremental chunk, a chunked activation, or a
-        stock wholesale dispatch for small/cold requests."""
+        stock wholesale dispatch for small/cold requests.
+
+        The pass carries a COALESCING WINDOW map (ISSUE 9): miner conn
+        id -> ``[coalesce_id, lanes_used, {job_ids}]``. A small grant
+        may land in an open window even when the capacity pool is empty
+        (the window counts as one live slot however many lanes it
+        holds), which is what batches N mice onto one miner within a
+        single pump pass. Windows live for ONE pass only — the next
+        pump starts fresh, so a window can never span a lease sweep or
+        quarantine event."""
         plane = self.qos_plane
         # Classic DRR: a tenant whose backlog empties forfeits its
         # accumulated deficit — idle time must not bank credit. Backlog =
@@ -1076,6 +1168,7 @@ class Scheduler:
         for t, st in plane.tenants.items():
             if t not in backlogged:
                 st.deficit = 0.0
+        window: dict = {}
         while True:
             heads = self._qos_heads()
             if not heads:
@@ -1084,8 +1177,10 @@ class Scheduler:
             cap_pool = self._qos_capacity_pool()
             candidates = {}
             for t, (kind, req, cost) in heads.items():
+                joinable = (self._coalescible_cost(req, cost)
+                            and self._window_room(window, req.job_id))
                 if kind == "chunk":
-                    if cap_pool:
+                    if cap_pool or joinable:
                         candidates[t] = cost
                 elif not self._inflight and self._qos_small(req):
                     # Wholesale start: needs the stock eligibility (or
@@ -1093,14 +1188,14 @@ class Scheduler:
                     # pump.
                     if eligible or self._desperation_pool():
                         candidates[t] = cost
-                elif cap_pool:
+                elif cap_pool or joinable:
                     candidates[t] = cost
             if not candidates:
                 break
             t = plane.pick(candidates)
             kind, req, cost = heads[t]
             if kind == "chunk":
-                self._qos_grant(req, cap_pool)
+                self._qos_grant(req, cap_pool, window)
                 continue
             self.queue.remove(req)
             self._queue_depth.set(len(self.queue))
@@ -1112,10 +1207,11 @@ class Scheduler:
                     pool, desperate = self._desperation_pool(), True
                 self._load_balance(req, pool, desperate=desperate)
             else:
-                self._qos_activate(req, cap_pool)
+                self._qos_activate(req, cap_pool, window)
             self._starved = False
 
-    def _qos_activate(self, req: Request, pool: list[MinerState]) -> None:
+    def _qos_activate(self, req: Request, pool: list[MinerState],
+                      window: Optional[dict] = None) -> None:
         """Activate a request in CHUNKED mode: plan contiguous ascending
         chunks sized at ``chunk_s`` seconds of pool-EWMA work (capped at
         ``max_chunks``; an even split over the capacity pool when cold)
@@ -1136,7 +1232,12 @@ class Scheduler:
             # Empty/inverted range, same answer as the wholesale path.
             self._finish(req, MAX_U64, 0)
             return
-        n, _ = self._qos_chunk_plan(total, len(pool))
+        # Cold-pool fallback sized over the WHOLE pool, exactly like the
+        # DRR head pricing in _qos_heads — the activation may now run
+        # with an EMPTY capacity pool (the window-joinable path), and
+        # len(pool)=0 on a cold rate would plan ONE whole-request chunk
+        # that diverges from the priced head cost (code review).
+        n, _ = self._qos_chunk_plan(total, len(self.miners) or 1)
         bounds = []
         base = req.lower
         size, rem = divmod(total, n)
@@ -1148,21 +1249,49 @@ class Scheduler:
         req.num_chunks = n
         req.answered = [False] * n
         req.next_chunk = 0
-        self._qos_grant(req, pool)
+        self._qos_grant(req, pool, window)
 
-    def _qos_grant(self, req: Request, pool: list[MinerState]) -> None:
+    def _qos_grant(self, req: Request, pool: list[MinerState],
+                   window: Optional[dict] = None) -> None:
         """Hand the request's next planned chunk to the least-loaded
-        capacity miner and account the grant with the DRR plane."""
-        miner = pool[0]
+        capacity miner and account the grant with the DRR plane.
+
+        Coalescing (ISSUE 9): a SMALL chunk first tries to join an open
+        window in ``window`` (sharing that window's ``coalesce_id`` —
+        one live slot, one future shared launch); failing that it goes
+        to the least-loaded capacity miner and, still being small,
+        OPENS a window there for later grants of this pump pass. Large
+        or difficulty chunks never touch windows. Accounting (DRR
+        debit, tenant in-flight, lease) is identical either way."""
         idx = req.next_chunk
         lo, up = req.chunk_bounds[idx]
+        miner = None
+        cid = None
+        small = self._coalescible_cost(req, up - lo)
+        if small and window:
+            miner, slot = self._window_slot(window, req.job_id)
+            if miner is not None:
+                cid = slot[0]
+                slot[1] += 1
+                slot[2].add(req.job_id)
+                self._count("qos_window_grants")
+        if miner is None:
+            if not pool:
+                return    # window gone and no capacity: next pump turn
+            miner = pool[0]
+            if small and window is not None \
+                    and miner.conn_id not in window:
+                self._next_coalesce_id += 1
+                cid = self._next_coalesce_id
+                window[miner.conn_id] = [cid, 1, {req.job_id}]
         req.next_chunk += 1
         req.granted_chunks += 1
         self._count("qos_grants")
         self.qos_plane.on_grant(req.conn_id, up - lo)
         self._assign_chunk(
             miner, Chunk(req.job_id, req.data, lo, up,
-                         target=req.target, idx=idx), kind="qos")
+                         target=req.target, idx=idx, coalesce_id=cid),
+            kind="qos")
 
     def _shed(self, req: Request, reason: str) -> None:
         """Shed one request under admission/overload pressure: cancel it

@@ -18,18 +18,24 @@ nonce wins everywhere).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..bitcoin.hash import MAX_U64
-from ..ops.search import search_span, search_span_until
+from ..ops.search import (pow2_bucket, search_span, search_span_segmin,
+                          search_span_until)
 from ..ops.sha256_host import sha256_midstate
 from ..ops.sha256_jnp import build_hoist, build_tail_template
 from ..utils._env import str_env as _str_env
 from ..utils.metrics import registry as _registry
 
 _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
+#: Row cap per coalesced launch: a batch wider than this splits into
+#: several launches (keeps the pow2 signature set small and one launch's
+#: compile bounded). 64 rows is ~8x the default coalescer lane cap.
+_BATCH_ROWS_MAX = 64
 
 # Model-layer metrics (utils/metrics.py): midstate/hoist cache behavior
 # (a miss pays the scalar hoist build; production traffic should be nearly
@@ -42,6 +48,15 @@ _MET_HOIST_ON = _M.counter("model.hoist_plans", enabled="true")
 _MET_HOIST_OFF = _M.counter("model.hoist_plans", enabled="false")
 _MET_BLOCKS = _M.counter("model.blocks_dispatched")
 _MET_DEGRADED = _M.counter("model.until_degraded")
+# Batched-dispatch plane (ISSUE 9): every DEVICE LAUNCH (one jitted
+# dispatch — the unit the coalescer amortizes; bench.py's
+# dispatches-per-mouse reads this), coalesced launches specifically,
+# their row widths, and batch-stack cache behavior.
+_MET_LAUNCHES = _M.counter("model.device_launches")
+_MET_BATCH_LAUNCHES = _M.counter("model.coalesced_launches")
+_MET_BATCH_ROWS = _M.counter("model.coalesced_rows")
+_MET_STACK_HIT = _M.counter("model.batch_stack_cache", result="hit")
+_MET_STACK_MISS = _M.counter("model.batch_stack_cache", result="miss")
 
 
 def default_tier() -> str:
@@ -93,6 +108,54 @@ class _BlockPlan:
     def hoist_ops(self):
         """jit-operand dict of the hoist (None when disabled)."""
         return self.hoist.ops if self.hoist is not None else None
+
+
+class _StackCache:
+    """Bounded LRU of stacked batch operands (ISSUE 9).
+
+    A coalesced launch gathers R rows' per-midstate plans — midstate
+    (R, 8), template (R, nblocks, 16), hoist operands — into one
+    stacked jit operand set. Steady-state mice traffic repeats the same
+    (data, block) populations launch after launch, so the np.stack
+    gather runs once per distinct population instead of once per
+    launch. Keys are value-identifying ``(data, top, k)`` tuples (the
+    same identity the per-searcher midstate cache uses), never object
+    ids, so a rebuilt searcher with identical data still hits.
+    Lock-guarded: the cache is PROCESS-wide while each MinerWorker
+    serializes only its OWN worker threads — two in-process miners
+    (the bench probes, the e2e tests) dispatch concurrently, and an
+    unguarded get()'s ``move_to_end`` racing another thread's
+    put()-eviction of the same LRU-oldest key is a KeyError that
+    would kill the miner mid-request (code review).
+    """
+
+    def __init__(self, size: int = 32):
+        import threading
+        self.size = size
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is not None:
+                self._d.move_to_end(key)
+        (_MET_STACK_HIT if hit is not None else _MET_STACK_MISS).inc()
+        return hit
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.size:
+                self._d.popitem(last=False)
+
+
+#: Process-wide stack cache: populations repeat ACROSS searchers (the
+#: whole point of mixed-message batching), so the memo cannot live on
+#: one searcher — and therefore cannot rely on any single worker's
+#: executor serialization (hence the internal lock).
+_STACKS = _StackCache()
 
 
 class NonceSearcher:
@@ -209,6 +272,8 @@ class NonceSearcher:
     def search_block(self, plan: _BlockPlan) -> list:
         """Dispatch one block as pow2 sub-dispatches; returns a list of
         (hi, lo, idx) device-scalar triples, ascending by span."""
+        subs = self._sub_dispatches(plan)
+        _MET_LAUNCHES.inc(len(subs))
         if self.tier == "pallas":
             from ..ops.sha256_pallas import pallas_argmin
 
@@ -222,13 +287,13 @@ class NonceSearcher:
                 np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
                 rem=plan.rem, k=plan.k, total=self.batch * nbatches,
                 platform=self._platform(), hoist=plan.hoist_ops)
-                for i0, nbatches in self._sub_dispatches(plan)]
+                for i0, nbatches in subs]
         return [search_span(
             np.asarray(plan.midstate, dtype=np.uint32), plan.template,
             np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
             plan.hoist_ops,
             rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
-            for i0, nbatches in self._sub_dispatches(plan)]
+            for i0, nbatches in subs]
 
     def dispatch(self, lower: int, upper: int) -> list:
         """Dispatch every block of the range WITHOUT forcing results.
@@ -277,6 +342,165 @@ class NonceSearcher:
         """Exact (min_hash, argmin_nonce) over the inclusive range."""
         return self.finalize(self.dispatch(lower, upper), lower)
 
+    # ------------------------------------------------ batched dispatch
+
+    def coalesce_key(self) -> tuple:
+        """Searchers with equal keys may share a coalesced launch: same
+        kernel tier, same lane batch (a static geometry component), and
+        the same hoist setting (group membership additionally requires
+        equal (rem, k, nblocks, nbatches) per row — the planner splits
+        on those)."""
+        return (type(self), self.tier, self.batch, self.use_hoist)
+
+    def dispatch_batch(self, entries: list):
+        """Dispatch MANY independent argmin jobs — possibly for
+        DIFFERENT messages — as few coalesced device launches (ISSUE 9:
+        cross-request batched dispatch), without forcing results.
+
+        ``entries`` is ``[(searcher, lower, upper), ...]``; ``self`` is
+        entries[0]'s searcher (the miner calls through it). Every job's
+        blocks decompose into pow2 sub-dispatch rows exactly like
+        :meth:`dispatch`; rows are grouped by their static geometry
+        ``(rem, k, nblocks, nbatches)`` — a group is one launch of
+        :func:`ops.search.search_span_segmin` (or the gated pallas
+        batch entry) with the row count pow2-bucketed and per-(job,
+        block) segment ids, so the device answers a SEGMENT-min per
+        (job, block) instead of one global argmin. Mixed messages cost
+        one midstate-cache lookup per block (the plans are already
+        cached) plus a stack-cache lookup per launch.
+
+        Returns an opaque handle for :meth:`finalize_batch`, or None
+        when this batch cannot coalesce (incompatible searchers, or the
+        pallas tier with ``DBM_COALESCE_PALLAS`` off) — the caller then
+        degrades to per-job dispatch. Results are BIT-IDENTICAL to
+        per-job :meth:`search` either way (pinned by tests/test_batch).
+        """
+        key0 = self.coalesce_key()
+        for s, lower, upper in entries:
+            if not isinstance(s, NonceSearcher) or \
+                    s.coalesce_key() != key0:
+                return None
+            if lower > upper:
+                raise ValueError("empty range")
+        if self.tier == "pallas":
+            from ..ops.sha256_pallas import batch_enabled
+            if not batch_enabled():
+                return None
+        # Rows grouped by static launch geometry. Group keys include the
+        # hoist operand key set so a structural mismatch (e.g. plans
+        # built under different DBM_HOIST_DEEP settings) can never share
+        # a stacked operand.
+        groups: dict = {}
+        for ei, (s, lower, upper) in enumerate(entries):
+            for plan in s.plan(lower, upper):
+                hoist_keys = (frozenset(plan.hoist_ops)
+                              if plan.hoist is not None else None)
+                for i0, nbatches in s._sub_dispatches(plan):
+                    gkey = (plan.rem, plan.k, plan.template.shape[0],
+                            nbatches, hoist_keys)
+                    groups.setdefault(gkey, []).append((ei, s, plan, i0))
+        launches = []
+        for (rem, k, _nb, nbatches, hoist_keys), rows in groups.items():
+            for at in range(0, len(rows), _BATCH_ROWS_MAX):
+                launches.append(self._launch_rows(
+                    rows[at:at + _BATCH_ROWS_MAX],
+                    rem=rem, k=k, nbatches=nbatches,
+                    hoist_keys=hoist_keys))
+        return (len(entries), [lower for _, lower, _ in entries], launches)
+
+    def _launch_rows(self, rows: list, *, rem: int, k: int, nbatches: int,
+                     hoist_keys=None):
+        """One coalesced launch: stack the rows' plans (via the
+        process-wide stack cache), assign per-(job, block) segment ids
+        (ascending with row order — the segment reduce relies on it),
+        pad the row count to a pow2 bucket with empty-window rows, and
+        dispatch. Returns ``(seg_meta, device_triple)``."""
+        n = len(rows)
+        nrows = pow2_bucket(n)
+        seg_meta: list = []          # seg id -> (entry_index, block base)
+        seg_ids: dict = {}
+        segs = []
+        for ei, _s, plan, _i0 in rows:
+            skey = (ei, plan.base)
+            sid = seg_ids.get(skey)
+            if sid is None:
+                sid = seg_ids[skey] = len(seg_meta)
+                seg_meta.append((ei, plan.base))
+            segs.append(sid)
+        # hoist_keys (the group's operand-key structure) is part of the
+        # cache identity: the group key separates LAUNCHES on it, so a
+        # cached stack from a different hoist structure (e.g. plans
+        # built before a DBM_HOIST_DEEP flip) must never be served to
+        # this one (code review).
+        stack_key = (rem, k, nbatches, nrows, hoist_keys, tuple(
+            (s.data, plan.base // 10 ** k)
+            for _ei, s, plan, _i0 in rows))
+        stacked = _STACKS.get(stack_key)
+        if stacked is None:
+            plans = [r[2] for r in rows] + [rows[-1][2]] * (nrows - n)
+            midstates = np.stack([np.asarray(p.midstate, dtype=np.uint32)
+                                  for p in plans])
+            templates = np.stack([p.template for p in plans])
+            hoists = None
+            if plans[0].hoist is not None:
+                hoists = {name: np.stack(
+                    [np.asarray(p.hoist_ops[name], dtype=np.uint32)
+                     for p in plans]) for name in plans[0].hoist_ops}
+            stacked = (midstates, templates, hoists)
+            _STACKS.put(stack_key, stacked)
+        midstates, templates, hoists = stacked
+        pad = nrows - n
+        i0s = np.asarray([r[3] for r in rows] + [0] * pad, dtype=np.uint32)
+        # Padded rows carry an inverted valid window: every lane masks
+        # to the sentinel, which never wins a segment min; their seg id
+        # is the last bucket slot (>= every real id, keeping the seg
+        # vector sorted).
+        lo_is = np.asarray([r[2].lo_i for r in rows] + [1] * pad,
+                           dtype=np.uint32)
+        hi_is = np.asarray([r[2].hi_i for r in rows] + [0] * pad,
+                           dtype=np.uint32)
+        seg = np.asarray(segs + [nrows - 1] * pad, dtype=np.int32)
+        _MET_LAUNCHES.inc()
+        _MET_BATCH_LAUNCHES.inc()
+        _MET_BATCH_ROWS.inc(n)
+        if self.tier == "pallas":
+            from ..ops.sha256_pallas import pallas_segmin
+            triple = pallas_segmin(
+                midstates, templates, i0s, lo_is, hi_is, seg,
+                rem=rem, k=k, total=self.batch * nbatches, nrows=nrows,
+                platform=self._platform(), hoists=hoists)
+        else:
+            triple = search_span_segmin(
+                midstates, templates, i0s, lo_is, hi_is, seg, hoists,
+                rem=rem, k=k, batch=self.batch, nbatches=nbatches)
+        return seg_meta, triple
+
+    def finalize_batch(self, handle) -> list:
+        """Force a batched dispatch with ONE device fetch and merge per
+        job on the host: each job's per-(block, launch) segment results
+        merge under the lexicographic ``(hash, nonce)`` min — the same
+        rule :meth:`finalize` applies via its ascending strict-less walk
+        (earliest nonce wins hash ties). Returns one ``(min_hash,
+        argmin_nonce)`` pair per entry, in entry order; a job whose
+        every segment came back sentinel (cannot happen for non-empty
+        ranges, but mirrors :meth:`finalize`) answers ``(MAX_U64,
+        lower)``."""
+        import jax
+
+        n_entries, lowers, launches = handle
+        fetched = jax.device_get([triple for _, triple in launches])
+        cands: list[list] = [[] for _ in range(n_entries)]
+        for (seg_meta, _), (seg_hi, seg_lo, seg_idx) in zip(launches,
+                                                            fetched):
+            for sid, (ei, base) in enumerate(seg_meta):
+                hi, lo, idx = (int(seg_hi[sid]), int(seg_lo[sid]),
+                               int(seg_idx[sid]))
+                if (hi, lo) == _SENTINEL and idx == 0xFFFFFFFF:
+                    continue
+                cands[ei].append(((hi << 32) | lo, base + idx))
+        return [min(c) if c else (MAX_U64, lowers[ei])
+                for ei, c in enumerate(cands)]
+
     def _degrade_until(self, what: str = "pallas until tier") -> None:
         """Sticky pallas->jnp until-tier degradation: a Mosaic lowering or
         runtime regression in the until kernel (its SMEM-flag skip is a
@@ -307,6 +531,7 @@ class NonceSearcher:
         dispatch: the jnp tier per while_loop batch, the pallas tier per
         grid step via the SMEM found-flag skip (r4), so even the largest
         pow2 sub costs only ~one step of compute past the first hit."""
+        _MET_LAUNCHES.inc()
         if self.tier == "pallas" and not self._until_degraded:
             from ..ops.sha256_pallas import pallas_until
 

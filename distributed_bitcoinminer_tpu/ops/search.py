@@ -22,6 +22,20 @@ from .sha256_jnp import (_compress, compress_tail_hoisted, digit_contrib,
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 
 
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= ``n`` (>= 1).
+
+    THE quantizer for batched-dispatch row counts (ISSUE 9): the number
+    of rows in a coalesced launch follows live traffic, so using it raw
+    as an operand SHAPE would mint a fresh jit signature per distinct
+    batch width — the same recompile-storm class as EWMA-drifted
+    ``nbatches`` (PR 4). Bucketing to pow2 bounds the signature set at
+    log2(max rows). The dbmlint jit-static analyzer recognizes calls to
+    this helper as bounded, so call sites stay machine-checked.
+    """
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
 def _hash_lanes(midstate, template, i, rem: int, k: int, vary_axes=(),
                 base=None, span: int = 0, hoist=None):
     """Hash a lane vector of low-digit offsets; returns (hi, lo) uint32.
@@ -179,3 +193,73 @@ def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
                            target_hi, target_lo,
                            rem=rem, k=k, batch=batch, nbatches=nbatches,
                            hoist=hoist)
+
+
+def segmin_rows(hi_h, lo_h, idx, seg, num_segments: int):
+    """Per-segment lexicographic (hi, lo, idx) min over row vectors.
+
+    ``seg`` maps each row to its segment (sorted ascending by
+    construction — the batch planner assigns segment ids in row order;
+    padded rows point at the last slot). The lex rule matches
+    :func:`sha256_jnp.lex_argmin` per segment: min hi, then min lo among
+    hi-ties, then min idx among (hi, lo)-ties — lowest nonce wins ties,
+    and all-sentinel segments (padding, empty windows) come out as the
+    (MAX, MAX, MAX) sentinel, exactly like an all-invalid span.
+    """
+    seg_hi = jax.ops.segment_min(hi_h, seg, num_segments=num_segments,
+                                 indices_are_sorted=True)
+    on_hi = hi_h == seg_hi[seg]
+    seg_lo = jax.ops.segment_min(jnp.where(on_hi, lo_h, _MAX_U32), seg,
+                                 num_segments=num_segments,
+                                 indices_are_sorted=True)
+    on_both = on_hi & (lo_h == seg_lo[seg])
+    seg_idx = jax.ops.segment_min(jnp.where(on_both, idx, _MAX_U32), seg,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
+    return seg_hi, seg_lo, seg_idx
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rem", "k", "batch", "nbatches"))
+def search_span_segmin(midstates, templates, i0s, lo_is, hi_is, seg,
+                       hoists=None, *, rem: int, k: int, batch: int,
+                       nbatches: int):
+    """Batched multi-row span scan with a per-request SEGMENT-min
+    (ISSUE 9: cross-request batched dispatch).
+
+    One device launch scans R independent rows — each row a full
+    :func:`span_scan_body` over its own ``(midstate, template, i0,
+    lo_i, hi_i)``, so rows may carry DIFFERENT messages (mixed-message
+    batches are a midstate/hoist-plan table lookup, the AsicBoost
+    observation) — then reduces rows to per-segment lexicographic mins
+    instead of one global argmin. ``seg`` maps each row to its
+    (request, block) segment; the caller merges segments of the same
+    request across blocks/launches on the host (strict-less, ascending
+    base — the existing ``finalize`` rule).
+
+    Static geometry: all rows share ``(rem, k, batch, nbatches)`` — the
+    batch planner groups rows by exactly that key — and the row count R
+    is pow2-bucketed by the caller (:func:`pow2_bucket`), so the jit
+    signature set stays bounded. Padded rows carry an empty valid
+    window (``lo_i > hi_i``): every lane masks to the sentinel, which
+    can never win a segment min, so padding is bit-neutral.
+
+    Returns ``(seg_hi, seg_lo, seg_idx)``, each of shape (R,); slots
+    beyond the caller's live segment count hold sentinels.
+    """
+    midstates = jnp.asarray(midstates, dtype=jnp.uint32)
+    templates = jnp.asarray(templates, dtype=jnp.uint32)
+
+    def row(midstate, template, i0, lo_i, hi_i, hoist):
+        return span_scan_body(midstate, template, i0, lo_i, hi_i,
+                              rem=rem, k=k, batch=batch,
+                              nbatches=nbatches, hoist=hoist)
+
+    if hoists is None:
+        hi_h, lo_h, idx = jax.vmap(
+            lambda m, t, i, lo, hi: row(m, t, i, lo, hi, None))(
+            midstates, templates, i0s, lo_is, hi_is)
+    else:
+        hi_h, lo_h, idx = jax.vmap(row)(
+            midstates, templates, i0s, lo_is, hi_is, hoists)
+    return segmin_rows(hi_h, lo_h, idx, seg, midstates.shape[0])

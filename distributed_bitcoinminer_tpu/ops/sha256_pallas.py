@@ -128,6 +128,85 @@ def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
         vma=vma)
 
 
+def batch_enabled() -> bool:
+    """Whether the pallas tier serves coalesced batches (ISSUE 9).
+
+    Default OFF, the ``DBM_PEEL`` rollout discipline: the batched entry
+    is interpret-validated (Mosaic simulator) but has not had an
+    on-chip smoke, and the chip-validated single-plan kernel must stay
+    byte-identical until one lands. With the knob off, coalescing
+    miners simply fall back to one-chunk-one-dispatch on the pallas
+    tier; the jnp tier batches unconditionally. Flip with
+    ``DBM_COALESCE_PALLAS=1`` once chip-validated."""
+    from ..utils._env import str_env
+    return str_env("DBM_COALESCE_PALLAS", "0") == "1"
+
+
+def pallas_segmin(midstates, templates, i0s, lo_is, hi_is, seg, *,
+                  rem: int, k: int, total: int, nrows: int, platform: str,
+                  hoists=None):
+    """Dispatch wrapper for the batched (segment-min) pallas entry: one
+    host dispatch + one force covering ``nrows`` independent rows (see
+    :func:`ops.search.search_span_segmin` for the contract). Geometry
+    derives per row from ``total`` exactly like :func:`pallas_argmin`;
+    ``nrows`` must already be pow2-bucketed (``ops.search.pow2_bucket``)
+    by the batch planner."""
+    rows, nsteps = pallas_geometry(total)
+    peel = peel_enabled()
+    # Same boundedness argument as pallas_argmin: rows/nsteps are pow2
+    # geometry from the quantized ``total``; nrows is pow2-bucketed by
+    # the caller (the planner routes it through pow2_bucket, which the
+    # jit-static analyzer recognizes as bounded).
+    return pallas_search_span_batch(
+        midstates, templates, i0s, lo_is, hi_is, seg,
+        hoists if peel else None, rem=rem, k=k,
+        rows=rows, nsteps=nsteps,  # dbmlint: ok[jit-static] pow2 geometry
+        nrows=nrows,  # dbmlint: ok[jit-static] pow2_bucket-quantized
+        interpret=interpret_on(platform),  # dbmlint: ok[jit-static] bool
+        peel=peel,  # dbmlint: ok[jit-static] bool knob
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rem", "k", "rows", "nsteps", "nrows", "interpret",
+                     "peel"))
+def pallas_search_span_batch(midstates, templates, i0s, lo_is, hi_is, seg,
+                             hoists=None, *, rem: int, k: int, rows: int,
+                             nsteps: int, nrows: int, interpret: bool = False,
+                             peel: bool = False):
+    """Batched segment-min entry for the Mosaic tier: ONE jitted
+    program (one host dispatch, one force) containing ``nrows``
+    invocations of the chip-validated span kernel plus the segment-min
+    combine — the continuous-batching shape at the XLA-program level.
+
+    The per-row kernels stay byte-identical to :func:`pallas_search_span`
+    (same ``_run_kernel`` builder, same scalar-prefetch layout), so the
+    batched entry inherits the rolled kernel's chip validation per row;
+    what is new — and what the interpret validation covers — is only
+    the jnp-level segment combine stitched around them. Collapsing the
+    rows into a single multi-row Mosaic grid is the on-chip follow-up
+    (ROADMAP); the host-side dispatch/force/serialize overhead this PR
+    targets is already amortized at this level.
+    """
+    his, los, idxs = [], [], []
+    for r in range(nrows):
+        hoist_r = None
+        if hoists is not None:
+            hoist_r = {name: hoists[name][r] for name in hoists}
+        h, l, i = _run_kernel(
+            midstates[r], templates[r], i0s[r], lo_is[r], hi_is[r],
+            rem=rem, k=k, rows=rows, nsteps=nsteps, interpret=interpret,
+            vma=(), peel=peel, hoist=hoist_r)
+        bh, bl, bi = lex_argmin(h.ravel(), l.ravel(), i.ravel())
+        his.append(bh)
+        los.append(bl)
+        idxs.append(bi)
+    from .search import segmin_rows
+    return segmin_rows(jnp.stack(his), jnp.stack(los), jnp.stack(idxs),
+                       seg, nrows)
+
+
 def pallas_geometry(total: int) -> tuple[int, int]:
     """(rows, nsteps) for a dispatch covering ``total`` lanes.
 

@@ -121,6 +121,40 @@ Environment variables:
   commas (tenant = conn id as decimal string); everything else gets the
   default (1.0). Programmatic drivers use
   ``Scheduler.set_tenant_weight`` instead.
+- ``DBM_COALESCE`` (default 1; 0 disables): cross-request batched
+  dispatch (apps/miner.MinerWorker + apps/scheduler). The pipelined
+  miner drains compatible small argmin chunks — possibly from different
+  requests/tenants — from its local queue into ONE batched device
+  launch with a per-request segment-min
+  (models.NonceSearcher.dispatch_batch / ops.search.search_span_segmin)
+  and scatters the per-request Results out of a single force, still in
+  strict request order; the scheduler's QoS pump emits the matching
+  grant hint (multiple DRR picks may target one miner's coalescing
+  window, the windowed chunks counting as ONE live-FIFO slot).
+  ``DBM_COALESCE=0`` reproduces the stock one-chunk-one-dispatch path
+  bit-for-bit (tier-1 matrix leg).
+- ``DBM_COALESCE_LANES``: max chunks per coalesced launch / per
+  scheduler grant window (default 8).
+- ``DBM_COALESCE_MAX``: largest chunk (in nonces) eligible for
+  coalescing (default 2^20; <=0 disables like ``DBM_COALESCE=0``) —
+  batching an elephant-sized chunk would delay its own result more
+  than a dispatch round-trip costs.
+- ``DBM_COALESCE_SMALL_S``: scheduler-side smallness bound in ESTIMATED
+  seconds at the pool throughput EWMA (default 0.25; <=0 disables the
+  plane): only a chunk whose scan is launch-overhead-scale may join a
+  coalescing window — an absolute nonce bound alone would misclassify a
+  slow pool's rate-scaled elephant chunks as mice.
+- ``DBM_COALESCE_PALLAS`` (default 0): serve coalesced batches on the
+  pallas tier (ops/sha256_pallas.pallas_segmin — one jitted program of
+  per-row Mosaic kernels + the segment combine). Interpret-validated;
+  default off until an on-chip smoke, the ``DBM_PEEL`` rollout
+  discipline — with it off, pallas-tier miners fall back to per-chunk
+  dispatch and only the jnp tier batches.
+- ``DBM_BENCH_BATCH`` (0 disables) / ``DBM_BENCH_BATCH_ROUNDS``: the
+  bench's continuous-batching probe (``bench.py detail.batch``;
+  CPU-only): mice requests/s and device dispatches-per-mouse at fixed
+  elephant goodput, coalescing off vs on, legs interleaved order-swapped
+  per round and median-aggregated like ``detail.qos``.
 - ``DBM_BENCH_QOS`` (0 disables) / ``DBM_BENCH_QOS_ROUNDS``: the bench's
   mixed-load QoS probe (``bench.py detail.qos``; CPU-only): one elephant
   plus a train of mice through a real localhost LSP stack, QoS off vs
@@ -412,6 +446,33 @@ class StripeParams:
 
 
 @dataclass(frozen=True)
+class CoalesceParams:
+    """Cross-request batched-dispatch knobs (ISSUE 9; apps/miner.py
+    coalescer + apps/scheduler.py grant window).
+
+    Miner side: the pipelined executor drains up to ``lanes`` compatible
+    small chunks (argmin mode, <= ``max_nonces`` each) from its local
+    queue into one batched device launch. Scheduler side: within one
+    QoS pump pass, after a small chunk is granted to a miner, further
+    small grants may target the same miner's COALESCING WINDOW (up to
+    ``lanes`` chunks) with the windowed chunks counting as ONE live
+    chunk against the ``DBM_QOS_DEPTH`` cap — the "these N chunks may
+    share a dispatch" hint that actually puts multiple small chunks in
+    one miner's queue at once. Per-tenant DRR/admission accounting is
+    per chunk, unchanged. ``enabled=False`` (or ``max_nonces <= 0``)
+    reproduces stock grant and dispatch behavior bit-for-bit.
+    """
+    enabled: bool = True
+    lanes: int = 8                 # max chunks per shared launch/window
+    max_nonces: int = 1 << 20      # largest coalescible chunk (absolute)
+    small_s: float = 0.25          # largest coalescible chunk (est. secs)
+
+    def __post_init__(self):
+        if self.max_nonces <= 0 or self.small_s <= 0:
+            object.__setattr__(self, "enabled", False)
+
+
+@dataclass(frozen=True)
 class QosParams:
     """Fair-share QoS dispatch knobs (apps/qos.py + apps/scheduler.py).
 
@@ -545,6 +606,16 @@ def stripe_from_env() -> StripeParams:
         enabled=_int_env("DBM_STRIPE", 1) != 0,
         chunk_s=_float_env("DBM_STRIPE_CHUNK_S", d.chunk_s),
         depth=max(1, _int_env("DBM_STRIPE_DEPTH", d.depth)),
+    )
+
+
+def coalesce_from_env() -> CoalesceParams:
+    d = CoalesceParams()
+    return CoalesceParams(
+        enabled=_int_env("DBM_COALESCE", 1) != 0,
+        lanes=max(2, _int_env("DBM_COALESCE_LANES", d.lanes)),
+        max_nonces=_int_env("DBM_COALESCE_MAX", d.max_nonces),
+        small_s=_float_env("DBM_COALESCE_SMALL_S", d.small_s),
     )
 
 

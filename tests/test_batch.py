@@ -1,0 +1,474 @@
+"""Cross-request batched dispatch property suite (ISSUE 9).
+
+Covers every layer of the batch-coalescing plane:
+
+- **Ops**: the ``search_span_segmin`` per-request segment-min is
+  bit-exact against the per-chunk ``search_span`` oracle across a
+  rem x k x ragged-lane-count grid — mixed messages, multi-block
+  requests, padded pow2 row buckets, masked padded lanes — and the
+  gated pallas batch entry matches on a small interpret case.
+- **Models**: ``NonceSearcher.dispatch_batch``/``finalize_batch``
+  answer exactly like per-job ``search``, refuse incompatible mixes,
+  and respect the pallas gating knob.
+- **Miner**: the pipelined executor's coalescer drains compatible
+  small chunks into shared launches with Results scattered strictly in
+  request order; difficulty/oversize chunks never coalesce; coalescing
+  OFF never drains and reproduces the stock path bit-for-bit (the
+  acceptance pin, re-run under ``DBM_COALESCE=0`` in the tier-1 matrix
+  leg).
+- **Scheduler**: the QoS pump's coalescing window stacks several
+  tenants' mice on one miner within one pump pass (shared
+  ``coalesce_id`` counting as ONE live-FIFO slot) while DRR/admission
+  debits stay per chunk; same-request chunks never share a window;
+  the window never engages for large chunks or with the plane off.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.miner import HostSearcher, MinerWorker
+from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+from distributed_bitcoinminer_tpu.bitcoin.message import (Message, MsgType,
+                                                          new_request)
+from distributed_bitcoinminer_tpu.models import NonceSearcher
+from distributed_bitcoinminer_tpu.ops.search import pow2_bucket
+from distributed_bitcoinminer_tpu.utils.config import (CoalesceParams,
+                                                       LeaseParams,
+                                                       QosParams)
+from distributed_bitcoinminer_tpu.utils.metrics import registry
+
+from tests.test_qos import FakeServer, pin_rate
+from tests.test_scheduler_recovery import join, request
+
+BATCH = 1 << 9          # small lanes: CPU-tier test sizing
+
+
+def _searcher(data: str) -> NonceSearcher:
+    return NonceSearcher(data, batch=BATCH, tier="jnp")
+
+
+def _counter(name: str) -> int:
+    return registry().counter(name).value
+
+
+# ------------------------------------------------------------- ops / models
+
+
+def test_pow2_bucket():
+    assert [pow2_bucket(n) for n in (1, 2, 3, 4, 5, 8, 9, 64)] == \
+        [1, 2, 4, 4, 8, 8, 16, 64]
+
+
+def test_batch_bit_exact_mixed_message_grid():
+    """THE acceptance property: per-request results from a coalesced
+    mixed-message batch equal the per-chunk search_span oracle, across
+    messages of different lengths (rem variety), ranges spanning
+    multiple k classes and blocks, ragged lane counts (padded-lane
+    masking inside rows), and entry counts that force pow2 row-bucket
+    padding."""
+    searchers = {d: _searcher(d) for d in
+                 ("alpha", "bee", "a" * 40, "cmu440")}
+    entries = [
+        (searchers["alpha"], 100_000, 101_000),     # k=6, one block
+        (searchers["bee"], 100_050, 100_949),       # same class, ragged
+        (searchers["a" * 40], 99_000, 102_000),     # crosses 10^5 bound
+        (searchers["cmu440"], 5, 2_500),            # k=1..4 multi-class
+        (searchers["alpha"], 100_123, 100_123),     # single-nonce
+    ]
+    s0 = entries[0][0]
+    for take in (1, 2, 3, 5):       # 3 and 5 force pow2 padding
+        part = entries[:take]
+        handle = s0.dispatch_batch(part)
+        assert handle is not None
+        got = s0.finalize_batch(handle)
+        for (s, lo, up), pair in zip(part, got):
+            assert pair == s.search(lo, up), (s.data, lo, up)
+
+
+def test_batch_matches_oracle_scan():
+    """End-to-end against the pure-host oracle (not just search_span)."""
+    s = _searcher("oracle batch")
+    t = _searcher("oracle batch 2")
+    handle = s.dispatch_batch([(s, 1_000, 3_000), (t, 4_000, 6_000)])
+    assert s.finalize_batch(handle) == [
+        scan_min("oracle batch", 1_000, 3_000),
+        scan_min("oracle batch 2", 4_000, 6_000)]
+
+
+def test_batch_bit_exact_without_hoist():
+    """DBM_HOIST=0-shaped searchers (no hoist operands) batch through
+    the hoists=None kernel path, still bit-exact."""
+    a = NonceSearcher("nohoist a", batch=BATCH, tier="jnp", hoist=False)
+    b = NonceSearcher("nohoist b", batch=BATCH, tier="jnp", hoist=False)
+    handle = a.dispatch_batch([(a, 50_000, 52_000), (b, 60_000, 61_000)])
+    assert handle is not None
+    assert a.finalize_batch(handle) == [a.search(50_000, 52_000),
+                                        b.search(60_000, 61_000)]
+
+
+def test_batch_incompatible_searchers_return_none():
+    a = _searcher("one")
+    b = NonceSearcher("two", batch=BATCH * 2, tier="jnp")  # batch differs
+    assert a.dispatch_batch([(a, 0, 99), (b, 0, 99)]) is None
+
+
+def test_batch_pallas_tier_gated_off_by_default(monkeypatch):
+    monkeypatch.delenv("DBM_COALESCE_PALLAS", raising=False)
+    a = NonceSearcher("gated", batch=BATCH, tier="pallas")
+    b = NonceSearcher("gated2", batch=BATCH, tier="pallas")
+    assert a.dispatch_batch([(a, 0, 99), (b, 0, 99)]) is None
+
+
+def test_batch_empty_range_raises():
+    a = _searcher("inverted")
+    with pytest.raises(ValueError):
+        a.dispatch_batch([(a, 100, 99)])
+
+
+def test_pallas_segmin_interpret_bit_exact(monkeypatch):
+    """The gated pallas batch entry (DBM_COALESCE_PALLAS=1), validated
+    in the Mosaic interpreter: 2 rows (one per message), ~2 grid steps
+    total — same per-request answers as the jnp path and the oracle."""
+    monkeypatch.setenv("DBM_COALESCE_PALLAS", "1")
+    a = NonceSearcher("cmu440", batch=256, tier="pallas")
+    b = NonceSearcher("pallas", batch=256, tier="pallas")
+    entries = [(a, 100_100, 100_300), (b, 100_000, 100_255)]
+    handle = a.dispatch_batch(entries)
+    assert handle is not None
+    got = a.finalize_batch(handle)
+    assert got == [scan_min("cmu440", 100_100, 100_300),
+                   scan_min("pallas", 100_000, 100_255)]
+
+
+def test_host_searcher_batch_contract():
+    a = HostSearcher("host batch a")
+    b = HostSearcher("host batch b")
+    handle = a.dispatch_batch([(a, 0, 999), (b, 500, 1_499)])
+    assert handle is not None
+    assert a.finalize_batch(handle) == [
+        scan_min("host batch a", 0, 999),
+        scan_min("host batch b", 500, 1_499)]
+
+
+# ------------------------------------------------------------ miner coalescer
+
+
+class _ScriptClient:
+    """Fake AsyncClient: serves scripted Requests, records writes, then
+    parks forever (the test cancels the worker)."""
+
+    def __init__(self, payloads):
+        self._payloads = list(payloads)
+        self.writes = []
+        self._forever = asyncio.get_running_loop().create_future()
+
+    async def read(self):
+        if self._payloads:
+            return self._payloads.pop(0)
+        await self._forever
+
+    def write(self, payload):
+        self.writes.append(payload)
+
+    async def close(self):
+        pass
+
+
+def _drive_worker(payloads, expect: int, **worker_kw):
+    """Run a MinerWorker over a scripted client until ``expect`` Results
+    land; returns the decoded replies."""
+    async def scenario():
+        worker = MinerWorker("unused:0", **worker_kw)
+        worker.client = _ScriptClient(payloads)
+        task = asyncio.create_task(worker.run())
+        for _ in range(1200):
+            if len(worker.client.writes) >= expect:
+                break
+            await asyncio.sleep(0.01)
+        task.cancel()
+        return [Message.from_json(w) for w in worker.client.writes]
+    return asyncio.run(scenario())
+
+
+#: jnp-tier factory for the worker tests; the module-level warm in the
+#: first test primes every signature these geometries hit.
+def _jnp_factory(d, b):
+    return _searcher(d)
+
+
+def test_coalescer_batches_queued_chunks_in_order():
+    """Queued compatible chunks drain into shared launches; Results
+    stay strictly in request order and oracle-exact."""
+    ranges = [(100_000 + i * 500, 100_000 + i * 500 + 399)
+              for i in range(6)]
+    before = _counter("miner.chunks_coalesced")
+    replies = _drive_worker(
+        [new_request("coal order", lo, up).to_json() for lo, up in ranges],
+        expect=6, searcher_factory=_jnp_factory, pipeline=True,
+        pipeline_depth=8, coalesce=True, coalesce_lanes=8)
+    assert len(replies) == 6
+    for (lo, up), m in zip(ranges, replies):
+        assert (m.hash, m.nonce) == scan_min("coal order", lo, up)
+    # The drain actually engaged (the scripted queue is pre-filled, so
+    # at least the tail of it coalesces behind the first chunk).
+    assert _counter("miner.chunks_coalesced") > before
+
+
+def test_coalesce_off_reproduces_stock_dispatch_bit_for_bit():
+    """The acceptance pin: DBM_COALESCE=0 (coalesce=False) never drains
+    — zero coalesced dispatches, every chunk its own launch — and the
+    reply stream is byte-identical to the coalescing run's."""
+    ranges = [(100_000 + i * 500, 100_000 + i * 500 + 399)
+              for i in range(5)]
+    payloads = [new_request("coal parity", lo, up).to_json()
+                for lo, up in ranges]
+    on = _drive_worker(list(payloads), expect=5,
+                       searcher_factory=_jnp_factory, pipeline=True,
+                       coalesce=True, coalesce_lanes=8)
+    before_disp = _counter("miner.coalesced_dispatches")
+    before_launch = _counter("model.device_launches")
+    off = _drive_worker(list(payloads), expect=5,
+                        searcher_factory=_jnp_factory, pipeline=True,
+                        coalesce=False)
+    assert _counter("miner.coalesced_dispatches") == before_disp
+    # Stock path: one launch per chunk (each range is one pow2 sub).
+    assert _counter("model.device_launches") - before_launch == 5
+    assert [m.to_json() for m in off] == [m.to_json() for m in on]
+    for (lo, up), m in zip(ranges, off):
+        assert (m.hash, m.nonce) == scan_min("coal parity", lo, up)
+
+
+def test_difficulty_and_oversize_chunks_never_coalesce():
+    """A difficulty chunk between two small argmin chunks splits the
+    drain (it needs the until path); an oversize chunk is equally
+    excluded — all four Results still land in request order."""
+    target = 1 << 60
+    payloads = [
+        new_request("coal mix", 100_000, 100_399).to_json(),
+        new_request("coal mix", 100_400, 100_799, target).to_json(),
+        new_request("coal mix", 100_800, 101_199).to_json(),
+        new_request("coal mix", 101_200, 101_599).to_json(),
+        # OVERSIZE: 1000 nonces > the 450 bound — must run solo.
+        new_request("coal mix", 101_600, 102_599).to_json(),
+    ]
+    before = _counter("miner.chunks_coalesced")
+    before_launches = _counter("model.device_launches")
+    replies = _drive_worker(
+        payloads, expect=5, searcher_factory=_jnp_factory, pipeline=True,
+        coalesce=True, coalesce_lanes=8, coalesce_max=450)
+    assert len(replies) == 5
+    spans = [(100_000, 100_399), (100_400, 100_799), (100_800, 101_199),
+             (101_200, 101_599), (101_600, 102_599)]
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_until
+    for i, ((lo, up), m) in enumerate(zip(spans, replies)):
+        if i == 1:   # difficulty chunk: FIRST qualifying nonce, not argmin
+            want = scan_until("coal mix", lo, up, target)[:2]
+        else:
+            want = scan_min("coal mix", lo, up)
+        assert (m.hash, m.nonce) == want, (i, lo, up)
+    # The target chunk echoes its target; argmin chunks echo 0.
+    assert [m.target for m in replies] == [0, target, 0, 0, 0]
+    # Neither the target chunk nor the oversize chunk rode a batch: at
+    # most the three small argmin chunks coalesced.
+    assert _counter("miner.chunks_coalesced") - before <= 3
+    # Every chunk still launched (the oversize one on the stock
+    # single-chunk path: its 1000-nonce span is its own dispatches).
+    assert _counter("model.device_launches") - before_launches >= 5
+
+
+def test_no_batch_api_degrades_in_order():
+    """Two-phase searchers WITHOUT dispatch_batch (user factories) are
+    served per chunk, in order — the drain must not reorder or lose."""
+    class _TwoPhase:
+        def __init__(self, data):
+            self.data = data
+
+        def dispatch(self, lower, upper):
+            return (lower, upper)
+
+        def finalize(self, handle, lower):
+            return scan_min(self.data, handle[0], handle[1])
+
+    ranges = [(0, 999), (1_000, 1_999), (2_000, 2_999)]
+    replies = _drive_worker(
+        [new_request("degrade", lo, up).to_json() for lo, up in ranges],
+        expect=3, searcher_factory=lambda d, b: _TwoPhase(d),
+        pipeline=True, coalesce=True)
+    assert [(m.hash, m.nonce) for m in replies] == \
+        [scan_min("degrade", lo, up) for lo, up in ranges]
+
+
+# -------------------------------------------------------- scheduler window
+
+
+MINER_A, MINER_B = 101, 102
+TEN_X, TEN_Y, TEN_Z = 1, 2, 3
+
+
+def _window_sched(coalesce=None, **qos_kw):
+    qos_kw.setdefault("wholesale_s", 0.5)
+    qos_kw.setdefault("chunk_s", 1.0)
+    qos_kw.setdefault("depth", 2)
+    server = FakeServer()
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    sched = Scheduler(server, lease=LeaseParams(),
+                      qos=QosParams(**qos_kw),
+                      coalesce=coalesce if coalesce is not None
+                      else CoalesceParams(enabled=True, lanes=4))
+    return sched, server
+
+
+def test_window_stacks_mice_from_many_tenants_on_one_miner():
+    """With the pool saturated by an elephant, queued mice from several
+    tenants are granted into ONE miner's coalescing window in one pump
+    pass: shared coalesce_id, one live slot, per-chunk DRR accounting."""
+    sched, server = _window_sched()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    pin_rate(sched, rate=100.0)
+    # Elephant (est 100s >> wholesale_s): chunked, fills both miners to
+    # the depth cap (chunk ~100 nonces at chunk_s=1.0 — too big for
+    # small_s=0.25 at rate 100, so the elephant never opens windows).
+    request(sched, TEN_X, "elephant", 9_999)
+    assert sched.current.qos_mode == "chunked"
+    # Mice from two other tenants: 10-nonce requests (est 0.1s <=
+    # small_s) — they queue (pool at depth), then one freed slot's pump
+    # grants them all through a window.
+    request(sched, TEN_Y, "mouse y", 9)
+    request(sched, TEN_Z, "mouse z", 9)
+    assert len(sched.queue) == 2
+    # Answer one elephant chunk on miner A: the pump runs with capacity.
+    from distributed_bitcoinminer_tpu.bitcoin.message import new_result
+    c = sched._find_miner(MINER_A).pending[0]
+    sched._on_result(MINER_A, new_result(1_000_000 + c.lower, c.lower))
+    assert sched.stats["qos_window_grants"] >= 1
+    mice_chunks = [ch for m in sched.miners for ch in m.pending
+                   if ch.data.startswith("mouse")]
+    assert len(mice_chunks) == 2
+    cids = {ch.coalesce_id for ch in mice_chunks}
+    assert len(cids) == 1 and None not in cids     # shared window
+    miners_used = {m.conn_id for m in sched.miners
+                   for ch in m.pending if ch.data.startswith("mouse")}
+    assert len(miners_used) == 1                   # one miner's window
+    # The window counts as ONE live slot on its miner.
+    wm = sched._find_miner(miners_used.pop())
+    live_raw = sum(1 for ch in wm.pending if not ch.cancelled)
+    assert sched._miner_live(wm) == live_raw - 1
+    # Per-chunk accounting unchanged: each mouse tenant was debited its
+    # own grant.
+    assert sched.qos_plane.tenants[TEN_Y].granted_chunks == 1
+    assert sched.qos_plane.tenants[TEN_Z].granted_chunks == 1
+
+
+def test_window_never_stacks_same_request():
+    """One request's own chunks never share a window (cross-request
+    batching only): a lone small-chunked request grants at most one
+    chunk per miner slot, exactly like stock."""
+    sched, _server = _window_sched(chunk_s=0.1)
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    pin_rate(sched, rate=100.0)
+    # 100-nonce chunks (est 0.1s <= small_s 0.25): small, but all from
+    # the same job — windows open yet never admit a second chunk.
+    request(sched, TEN_X, "self", 999)
+    assert sched.current.qos_mode == "chunked"
+    assert sched.stats["qos_window_grants"] == 0
+    per_miner = [sum(1 for ch in m.pending if not ch.cancelled)
+                 for m in sched.miners]
+    assert max(per_miner) <= 2        # the stock depth cap held
+
+
+def test_window_disabled_is_stock():
+    """CoalesceParams(enabled=False): no window grants, no coalesce_id,
+    group-counting degenerates to the plain live count."""
+    sched, _server = _window_sched(
+        coalesce=CoalesceParams(enabled=False))
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    pin_rate(sched, rate=100.0)
+    request(sched, TEN_X, "elephant", 9_999)
+    request(sched, TEN_Y, "mouse y", 9)
+    request(sched, TEN_Z, "mouse z", 9)
+    from distributed_bitcoinminer_tpu.bitcoin.message import new_result
+    c = sched._find_miner(MINER_A).pending[0]
+    sched._on_result(MINER_A, new_result(1_000_000 + c.lower, c.lower))
+    assert sched.stats["qos_window_grants"] == 0
+    assert all(ch.coalesce_id is None
+               for m in sched.miners for ch in m.pending)
+    for m in sched.miners:
+        assert sched._miner_live(m) == \
+            sum(1 for ch in m.pending if not ch.cancelled)
+
+
+# ------------------------------------------------------------ e2e (real LSP)
+
+
+def test_e2e_coalescing_cluster_oracle_exact():
+    """A mice train through a real localhost LSP cluster with the full
+    plane on (QoS + window + coalescing miner): every reply oracle-exact
+    and the coalescer measurably engaged. Leases off and signatures
+    pre-warmed (first-compile stalls would otherwise blow leases and
+    nondeterminize the grant flow — the bench-probe discipline)."""
+    from tests.test_apps import Cluster, fast_params
+    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+
+    params = fast_params()
+    elephant = (100_000, 600_000)
+    mice = [(700_000 + i * 500, 700_000 + i * 500 + 399)
+            for i in range(6)]
+    # Warm every signature the legs can hit, incl. the coalesced pow2
+    # row buckets (process-wide jit cache).
+    warm = _searcher("e2e coal")
+    warm.search(elephant[0], elephant[1] + 1)
+    warm.search(100_000, 100_000 + 25_001)
+    entries = [(warm, lo, up) for lo, up in mice]
+    for width in (2, 4, 6):
+        warm.finalize_batch(warm.dispatch_batch(entries[:width]))
+
+    async def scenario():
+        async with Cluster(params) as c:
+            c.scheduler.lease = LeaseParams(enabled=False,
+                                            queue_alarm_s=0.0)
+            c.scheduler.qos = QosParams(enabled=True, wholesale_s=0.2,
+                                        chunk_s=0.5, depth=2)
+            c.scheduler.coalesce = CoalesceParams(enabled=True, lanes=8)
+            worker = MinerWorker(
+                c.hostport, params=params,
+                searcher_factory=_jnp_factory,
+                pipeline=True, coalesce=True, coalesce_lanes=8)
+            await worker.join()
+            c.tasks.append(asyncio.create_task(worker.run()))
+            c.miners.append(worker)
+            pin_rate(c.scheduler, rate=50_000.0)
+
+            async def ask(lo, up, delay=0.0):
+                if delay:
+                    await asyncio.sleep(delay)
+                client = await new_async_client(c.hostport, params)
+                try:
+                    client.write(
+                        new_request("e2e coal", lo, up).to_json())
+                    while True:
+                        m = Message.from_json(
+                            await asyncio.wait_for(client.read(), 120))
+                        if m.type == MsgType.RESULT:
+                            return m
+                finally:
+                    await client.close()
+
+            # The elephant (est 5s at the pinned rate -> chunked into
+            # 25k-nonce grants) occupies the pool; the mice wave lands
+            # behind it and must batch through the window.
+            replies = await asyncio.gather(
+                ask(*elephant),
+                *(ask(lo, up, delay=0.3) for lo, up in mice))
+            return replies
+
+    before = _counter("miner.chunks_coalesced")
+    replies = asyncio.run(scenario())
+    for (lo, up), m in zip([elephant] + mice, replies):
+        assert (m.hash, m.nonce) == scan_min("e2e coal", lo, up + 1), \
+            (lo, up)   # wire upper is inclusive+1 (reference quirk)
+    assert _counter("miner.chunks_coalesced") > before

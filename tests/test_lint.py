@@ -240,6 +240,35 @@ def test_jitstatic_scoped_to_compute_dirs():
     assert run_source("jit-static", JIT_BAD, rel=rel) == []
 
 
+JIT_QUANTIZED = snip("""
+    import functools
+    import jax
+
+    from .search import pow2_bucket
+
+    @functools.partial(jax.jit, static_argnames=("nrows",))
+    def batch_fixture(x, *, nrows):
+        return x
+
+    def caller(x, rows):
+        # A registered quantizer at the boundary: bounded by the
+        # callee's contract (ISSUE 9 batch-geometry statics).
+        ok = batch_fixture(x, nrows=pow2_bucket(len(rows)))
+        # The same expression WITHOUT the quantizer stays a finding.
+        return ok, batch_fixture(x, nrows=len(rows))
+""")
+
+
+def test_jitstatic_bounded_quantizer_call_is_stable():
+    """The pow2_bucket quantizer (ISSUE 9): a call to a registered
+    bounded quantizer at a static boundary is clean; the raw runtime
+    value right next to it still fails — teaching the analyzer, not
+    blanket-suppressing the site."""
+    found = run_source("jit-static", JIT_QUANTIZED, rel=JIT_REL)
+    assert len(found) == 1
+    assert "nrows" in found[0].message
+
+
 # ------------------------------------------------------------ thread-state
 
 THREAD_BAD = snip("""
