@@ -22,8 +22,10 @@ Hardening (round-2, per VERDICT):
   persistent compilation cache is configured so re-runs skip even that.
 - Tier selection via ``DBM_COMPUTE`` (auto | jnp | pallas); auto measures
   both device tiers and reports the faster.
-- ``DBM_TRACE=<dir>`` captures a JAX profiler trace of one timed search
-  per tier into ``<dir>/<tier>`` for TensorBoard/XProf (the A2 hook).
+- ``DBM_TRACE_XPROF=<dir>`` captures a JAX profiler trace of one timed
+  search per tier into ``<dir>/<tier>`` for TensorBoard/XProf (the A2
+  hook; ``DBM_TRACE`` itself switches the request-scoped tracing plane,
+  utils/trace.py).
 """
 
 from __future__ import annotations
@@ -220,6 +222,218 @@ def _pipeline_probe(data: str, lower: int, count: int, batch: int,
     }
 
 
+class _StormHarness:
+    """Shared scaffolding of the bench's mixed-load storm probes
+    (``_qos_probe`` / ``_batch_probe``) — the extraction ISSUE 9
+    deliberately deferred to "the next bench-touching PR" (this one).
+
+    Everything the two probes had duplicated lives here once:
+
+    - the probe transport params (tight epochs, wide window);
+    - the probe batch floor (>= 2^16: at the bench's 8192 a 2^24 share
+      is 2048 Python-level device dispatches whose GIL churn starves
+      the scheduler/client event loops for ~second-long stretches; at
+      2^16 the compute stays inside XLA with the GIL released, so the
+      measured latencies are queueing, not interpreter contention);
+    - the DEDICATED client thread pool (never ``asyncio.to_thread``:
+      blocked client threads would exhaust the default executor that
+      the miners' own ``to_thread`` compute shares — clients holding
+      every worker while waiting for results the workers would compute
+      is a deadlock, observed live while building the batch probe);
+    - the per-leg cluster lifecycle (server + scheduler + N in-process
+      jnp-tier miners over real localhost LSP, with the shared
+      measurement hardening: result cache OFF because rounds repeat
+      identical keys, leases OFF because a first-in-process compile
+      can run minutes and a blown lease would drag re-issue state into
+      the timed round, striping OFF because EWMA-sized stripe chunks
+      recompile mid-leg);
+    - the self-scheduled blocking client (own thread + own event loop
+      per request: the main loop shares the GIL with the miners'
+      jit-dispatch threads and its timers drift ~1s under compute, so
+      clients scheduled on it submit LATE and record near-zero FIFO
+      waits — client-side stamps are honest only off the compute
+      loop; raw ranged Requests on a FRESH conn each, because the
+      ``submit`` helper pins Lower to 0 — dragging in every small
+      digit class and its compile signatures — and a fresh conn per
+      request is exactly the multi-tenant shape);
+    - interleaved order-swapped rounds with median aggregation (the
+      box's cgroup cpu-shares noise is two-sided: a leg can burst
+      above its fair share as easily as lose cycles, so max() measures
+      the luckiest burst and one outlier flips the comparison's sign);
+    - the ``detail.trace`` summary (ISSUE 10): per-phase medians from
+      the stitched miner-side spans of a leg's scheduler, so the probe
+      artifact decomposes where a request's wall time went (scheduler
+      queue vs miner queue vs dispatch vs force) instead of reporting
+      one opaque latency.
+    """
+
+    def __init__(self, data: str, lower: int, batch: int,
+                 max_clients: int):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from distributed_bitcoinminer_tpu.lsp.params import Params
+        self.data = data
+        self.lower = lower
+        self.probe_batch = max(batch, 1 << 16)
+        self.params = Params(epoch_limit=30, epoch_millis=500,
+                             window_size=32, max_backoff_interval=2)
+        self.clients_pool = ThreadPoolExecutor(
+            max_workers=max_clients + 2, thread_name_prefix="bench-client")
+
+    def warm_searcher(self):
+        """A jnp-tier searcher at the probe geometry for precompiling
+        signatures OUTSIDE the legs (the jit cache is process-wide): a
+        first-in-process compile can run minutes on this box — inside a
+        leg that lands mid-warm-storm and skews it."""
+        from distributed_bitcoinminer_tpu.models import NonceSearcher
+        return NonceSearcher(self.data, batch=self.probe_batch,
+                             tier="jnp")
+
+    def cluster(self, qos, coalesce=None, n_miners=2, miner_kw=None):
+        """Async context manager: one leg's scheduler + miner cluster
+        (shared hardening defaults; ``qos``/``coalesce`` are the leg's
+        measured knobs, ``miner_kw`` extra MinerWorker kwargs)."""
+        import asyncio
+        import contextlib
+
+        from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
+        from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+        from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+        from distributed_bitcoinminer_tpu.models import NonceSearcher
+        from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
+                                                               LeaseParams,
+                                                               StripeParams)
+        harness = self
+
+        @contextlib.asynccontextmanager
+        async def _cluster():
+            server = await new_async_server(0, harness.params)
+            sched = Scheduler(
+                server,
+                cache=CacheParams(enabled=False),
+                lease=LeaseParams(enabled=False, queue_alarm_s=0.0),
+                stripe=StripeParams(enabled=False),
+                qos=qos, coalesce=coalesce)
+            sched_task = asyncio.create_task(sched.run())
+            hostport = f"127.0.0.1:{server.port}"
+            workers, tasks = [], []
+            try:
+                for _ in range(n_miners):
+                    w = MinerWorker(
+                        hostport, params=harness.params,
+                        searcher_factory=lambda d, b: NonceSearcher(
+                            d, batch=harness.probe_batch, tier="jnp"),
+                        **(miner_kw or {}))
+                    await w.join()
+                    tasks.append(asyncio.create_task(w.run()))
+                    workers.append(w)
+                yield _Cluster(harness, sched, hostport)
+            finally:
+                for t in tasks:
+                    t.cancel()
+                for w in workers:
+                    await w.close()
+                sched_task.cancel()
+                await server.close()
+
+        return _cluster()
+
+    def interleaved(self, rounds: int, leg) -> tuple[list, list]:
+        """Run ``leg(on: bool)`` over ``rounds`` interleaved rounds with
+        the in-round order swapped each round (kills order bias);
+        returns ``(on_rounds, off_rounds)`` of the legs' dicts."""
+        import asyncio
+        on_rounds, off_rounds = [], []
+        for rnd in range(max(1, rounds)):
+            order = (True, False) if rnd % 2 == 0 else (False, True)
+            for on in order:
+                (on_rounds if on else off_rounds).append(
+                    asyncio.run(leg(on)))
+        return on_rounds, off_rounds
+
+
+class _Cluster:
+    """One live probe cluster (yielded by ``_StormHarness.cluster``)."""
+
+    def __init__(self, harness: _StormHarness, sched, hostport: str):
+        self.harness = harness
+        self.sched = sched
+        self.hostport = hostport
+
+    def ask_blocking(self, lo: int, count: int):
+        """One raw ranged Request -> Result on its own thread's own
+        event loop + fresh conn (see the harness docstring)."""
+        import asyncio
+
+        from distributed_bitcoinminer_tpu.bitcoin.message import (
+            Message, MsgType, new_request)
+        from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+
+        async def go():
+            client = await new_async_client(self.hostport,
+                                            self.harness.params)
+            try:
+                client.write(new_request(
+                    self.harness.data, lo, lo + count - 1).to_json())
+                while True:
+                    m = Message.from_json(
+                        await asyncio.wait_for(client.read(), 600))
+                    if m.type == MsgType.RESULT:
+                        return m
+            finally:
+                await client.close()
+        return asyncio.run(go())
+
+    def run_one(self, t0: float, lo: int, count: int,
+                delay: float) -> tuple[float, float]:
+        """Self-scheduled submit from a common ``t0`` (``time.sleep``,
+        not ``asyncio.sleep`` — honest stamps need the wall clock of a
+        thread the compute loop cannot drift); returns (start, end)."""
+        time.sleep(max(0.0, t0 + delay - time.time()))
+        m0 = time.time()
+        self.ask_blocking(lo, count)
+        return m0, time.time()
+
+    def submit(self, loop, t0: float, lo: int, count: int, delay: float):
+        """``run_one`` on the harness's dedicated client pool."""
+        return loop.run_in_executor(self.harness.clients_pool,
+                                    self.run_one, t0, lo, count, delay)
+
+    def trace_summary(self) -> dict:
+        """``detail.trace``: per-phase medians over this leg's stitched
+        traces (ISSUE 10) — scheduler queue wait plus every miner-side
+        span phase, with span/request counts so a probe whose spans
+        went missing is visible as such rather than silently lacking
+        keys."""
+        from statistics import median
+
+        from distributed_bitcoinminer_tpu.utils.trace import SPAN_PHASES
+        sched_queue, phases = [], {}
+        traces = self.sched.traces.items()
+        for _key, t in traces:
+            events = t.to_dict()["events"]
+            enq = next((e for e in events if e["event"] == "enqueue"),
+                       None)
+            disp = next((e for e in events if e["event"] == "dispatch"),
+                        None)
+            if enq is not None and disp is not None:
+                sched_queue.append(disp["t"] - enq["t"])
+            for e in events:
+                if e["event"] != "miner_span":
+                    continue
+                for ph in SPAN_PHASES:
+                    v = e.get(ph)
+                    if isinstance(v, (int, float)):
+                        phases.setdefault(ph, []).append(float(v))
+        out = {"requests": len(traces),
+               "spans": len(next(iter(phases.values()), []))}
+        if sched_queue:
+            out["sched_queue_s_p50"] = round(median(sched_queue), 6)
+        for ph, xs in sorted(phases.items()):
+            out[f"miner_{ph}_p50"] = round(median(xs), 6)
+        return out
+
+
 def _qos_probe(data: str, lower: int, batch: int) -> dict:
     """Mixed-load QoS before/after (ISSUE 5): one ELEPHANT plus a train
     of MICE through a real scheduler + two jnp-tier miners over localhost
@@ -239,38 +453,23 @@ def _qos_probe(data: str, lower: int, batch: int) -> dict:
     production default of one second of pool work per chunk) while a
     whole mouse fits ONE chunk (2^14): one compile signature each,
     warmed by an untimed storm before the timed rounds, and a mouse
-    pays one grant round-trip instead of eight. Striping is pinned
-    OFF in both legs — stripe chunks are EWMA-sized, so their XLA
-    signatures drift between warm and timed rounds and the off leg
-    would mostly measure recompiles.
-    Legs are INTERLEAVED over ``DBM_BENCH_QOS_ROUNDS`` rounds with the
-    in-round order swapped (the box's cgroup noise is two-sided, see
-    _pipeline_probe) and every aggregate is a MEDIAN across rounds;
-    mice p99 additionally pools every round's latencies. The result
-    cache is OFF in both legs — rounds repeat identical keys.
+    pays one grant round-trip instead of eight.
+    Measurement hardening (client threading, probe batch floor, cache/
+    lease/stripe pins, interleaved order-swapped rounds) lives in
+    :class:`_StormHarness` — shared with ``_batch_probe``; every
+    aggregate is a MEDIAN across rounds, mice p99 additionally pools
+    every round's latencies, and ``trace`` carries the per-phase span
+    medians (ISSUE 10) of the last ON leg.
     """
     import asyncio
     from statistics import median
 
-    from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
-    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
-    from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
-                                                              MsgType,
-                                                              new_request)
-    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
-    from distributed_bitcoinminer_tpu.lsp.params import Params
-    from distributed_bitcoinminer_tpu.lsp.server import new_async_server
-    from distributed_bitcoinminer_tpu.models import NonceSearcher
-    from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
-                                                           LeaseParams,
-                                                           QosParams,
-                                                           StripeParams)
+    from distributed_bitcoinminer_tpu.utils.config import QosParams
 
-    params = Params(epoch_limit=30, epoch_millis=500, window_size=32,
-                    max_backoff_interval=2)
     elephant_count = 1 << 25        # ~1-2s of pool work on the jnp tier
     mouse_count = 1 << 14
     n_mice = 4
+    h = _StormHarness(data, lower, batch, max_clients=n_mice + 1)
 
     def qos_params(enabled: bool) -> QosParams:
         # chunk_s is picked so pool_rate * chunk_s lands in
@@ -283,91 +482,18 @@ def _qos_probe(data: str, lower: int, batch: int) -> dict:
                          max_chunks=8, depth=2)
 
     async def leg(qos_on: bool) -> dict:
-        server = await new_async_server(0, params)
-        sched = Scheduler(
-            server,
-            cache=CacheParams(enabled=False),
-            # Leases OFF: the probe measures queueing, not fault
-            # tolerance — a first-in-process compile can run minutes on
-            # this box, and a blown lease mid-warm-storm would drag
-            # re-issue/quarantine state into the timed round.
-            lease=LeaseParams(enabled=False, queue_alarm_s=0.0),
-            # Striping OFF in BOTH legs: stripe chunks are sized from the
-            # live throughput EWMA, so their XLA signatures drift between
-            # the warm storms and the timed round — on this 2-core box
-            # the off leg then measures mostly recompiles (~20s for a
-            # ~2s elephant). With stock even-split wholesale the off leg
-            # runs exactly the warmed 2^24-per-miner signature and the
-            # comparison isolates the QoS plane.
-            stripe=StripeParams(enabled=False),
-            qos=qos_params(qos_on))
-        sched_task = asyncio.create_task(sched.run())
-        hostport = f"127.0.0.1:{server.port}"
-        workers = []
-        try:
-            for _ in range(2):
-                w = MinerWorker(
-                    hostport, params=params,
-                    searcher_factory=lambda d, b: NonceSearcher(
-                        d, batch=probe_batch, tier="jnp"))
-                await w.join()
-                workers.append(asyncio.create_task(w.run()))
-                workers.append(w)
-
-            def ask_blocking(count):
-                # Raw ranged Request on a FRESH conn: the `submit` helper
-                # pins Lower to 0 (dragging in every small digit class
-                # and its compile signatures, see _pipeline_probe), and a
-                # fresh conn per request is exactly the multi-tenant
-                # shape — each mouse is its own tenant. Each client runs
-                # on its OWN thread + event loop: the main loop shares
-                # the GIL with the miners' jit-dispatch threads and
-                # stalls for up to a second at a time, so clients
-                # scheduled on it submit LATE (an off-leg mouse would
-                # land just before the elephant's merge and record a
-                # near-zero FIFO wait) — client-side stamps are honest
-                # only off the compute loop.
-                async def go():
-                    client = await new_async_client(hostport, params)
-                    try:
-                        client.write(new_request(
-                            data, lower, lower + count - 1).to_json())
-                        while True:
-                            m = Message.from_json(
-                                await asyncio.wait_for(client.read(), 600))
-                            if m.type == MsgType.RESULT:
-                                return m
-                    finally:
-                        await client.close()
-                return asyncio.run(go())
-
+        async with h.cluster(qos=qos_params(qos_on)) as cl:
             async def storm():
-                # Every submit self-schedules on its own thread from a
-                # common t0 (time.sleep, not asyncio.sleep: the main
-                # loop's timers drift ~a second under compute, which
-                # would slide the mice to the elephant's merge and
-                # record near-zero FIFO waits in the off leg).
                 t0 = time.time()
-                mice_lat = []
-
-                def run_one(count, delay):
-                    time.sleep(max(0.0, t0 + delay - time.time()))
-                    m0 = time.time()
-                    ask_blocking(count)
-                    return time.time() - m0
-
-                def mouse(delay):
-                    mice_lat.append(run_one(mouse_count, delay))
-
-                tasks = [asyncio.create_task(
-                    asyncio.to_thread(run_one, elephant_count, 0.0))]
+                loop = asyncio.get_running_loop()
+                tasks = [cl.submit(loop, t0, lower, elephant_count, 0.0)]
                 for i in range(n_mice):
                     # The elephant holds the pool before the mice land.
-                    tasks.append(asyncio.create_task(
-                        asyncio.to_thread(mouse, 0.2 + 0.05 * i)))
-                elephant_s = await tasks[0]
-                await asyncio.gather(*tasks[1:])
-                return elephant_s, mice_lat
+                    tasks.append(cl.submit(loop, t0, lower, mouse_count,
+                                           0.2 + 0.05 * i))
+                e0, e1 = await tasks[0]
+                mice = await asyncio.gather(*tasks[1:])
+                return e1 - e0, sorted(e - s for s, e in mice)
 
             # TWO warm storms (untimed). The first runs on a COLD pool —
             # everything dispatches wholesale by design (reference
@@ -379,31 +505,15 @@ def _qos_probe(data: str, lower: int, batch: int) -> dict:
             await storm()
             await storm()
             elephant_s, mice_lat = await storm()
-            grants = sched.stats["qos_grants"]
-            return {"elephant_s": elephant_s, "mice": sorted(mice_lat),
-                    "qos_grants": grants}
-        finally:
-            for item in workers:
-                if isinstance(item, asyncio.Task):
-                    item.cancel()
-                else:
-                    await item.close()
-            sched_task.cancel()
-            await server.close()
-
-    # The probe's own batch: at the bench's 8192 a 2^24 share is 2048
-    # Python-level device dispatches whose GIL churn starves the
-    # scheduler/client loops for ~second-long stretches; at 2^16 the
-    # same share is 256 dispatches and the compute stays inside XLA
-    # (GIL released), so the latencies measure queueing, not
-    # interpreter contention.
-    probe_batch = max(batch, 1 << 16)
+            return {"elephant_s": elephant_s, "mice": mice_lat,
+                    "qos_grants": cl.sched.stats["qos_grants"],
+                    "trace": cl.trace_summary()}
 
     # Precompile every signature a leg can hit OUTSIDE the legs (the
     # jit cache is process-wide, same idiom as test_pipeline's jnp
     # warm): a first-in-process compile can run minutes on this box —
     # inside a leg that lands mid-warm-storm and skews it.
-    warm = NonceSearcher(data, batch=probe_batch, tier="jnp")
+    warm = h.warm_searcher()
     for span in (elephant_count // 2,      # wholesale share, 2 miners
                  elephant_count // 8,      # QoS elephant chunk (cap 8)
                  mouse_count,              # QoS mouse chunk (whole mouse)
@@ -411,12 +521,7 @@ def _qos_probe(data: str, lower: int, batch: int) -> dict:
         warm.search(lower, lower + span)
 
     rounds = max(1, _int_env("DBM_BENCH_QOS_ROUNDS", 3))
-    on_rounds, off_rounds = [], []
-    for rnd in range(rounds):
-        order = (True, False) if rnd % 2 == 0 else (False, True)
-        for qos_on in order:
-            (on_rounds if qos_on else off_rounds).append(
-                asyncio.run(leg(qos_on)))
+    on_rounds, off_rounds = h.interleaved(rounds, leg)
 
     def pool(legs):
         return sorted(x for r in legs for x in r["mice"])
@@ -455,6 +560,9 @@ def _qos_probe(data: str, lower: int, batch: int) -> dict:
         "elephant_samples": {
             "on": [round(r["elephant_s"], 3) for r in on_rounds],
             "off": [round(r["elephant_s"], 3) for r in off_rounds]},
+        # Per-phase span medians (ISSUE 10) of the last ON leg: where a
+        # request's wall time actually went, end to end.
+        "trace": on_rounds[-1]["trace"],
     }
 
 
@@ -500,136 +608,66 @@ def _batch_probe(data: str, lower: int, batch: int) -> dict:
     deterministic: 32 chunks x one pow2 sub each), then the mixed storm;
     mice launches = mixed delta - elephant-alone delta, divided by the
     mice count. The miners are in-process, so the process registry sees
-    every launch. Measurement hardening inherited from ``_qos_probe``:
-    per-client threads with self-scheduled submits, probe batch >=
-    2^16, signatures warmed by two untimed storms, leases + striping
-    pinned off, result cache off, legs interleaved order-swapped over
-    ``DBM_BENCH_BATCH_ROUNDS`` (default 3) and median-aggregated.
+    every launch. Measurement hardening (per-client threads with
+    self-scheduled submits, the dedicated client pool, probe batch >=
+    2^16, leases + striping + cache pinned off, interleaved
+    order-swapped rounds) lives in :class:`_StormHarness` — shared with
+    ``_qos_probe``; two untimed storms warm the signatures per leg,
+    rounds come from ``DBM_BENCH_BATCH_ROUNDS`` (default 3) and every
+    aggregate is a median. ``trace`` carries the per-phase span medians
+    (ISSUE 10) of the last ON leg — the coalesced path's dispatch/force
+    amortization, visible per request.
     """
     import asyncio
     from statistics import median
 
-    from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
-    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
-    from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
-                                                              MsgType,
-                                                              new_request)
-    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
-    from distributed_bitcoinminer_tpu.lsp.params import Params
-    from distributed_bitcoinminer_tpu.lsp.server import new_async_server
-    from distributed_bitcoinminer_tpu.models import NonceSearcher
-    from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
-                                                           CoalesceParams,
-                                                           LeaseParams,
-                                                           QosParams,
-                                                           StripeParams)
+    from distributed_bitcoinminer_tpu.utils.config import (CoalesceParams,
+                                                           QosParams)
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
-    from concurrent.futures import ThreadPoolExecutor
-
-    params = Params(epoch_limit=30, epoch_millis=500, window_size=32,
-                    max_backoff_interval=2)
     elephant_count = 1 << 25
     mouse_count = 1 << 14
     n_mice = 16
     lanes = 8
-    probe_batch = max(batch, 1 << 16)
     launches = registry().counter("model.device_launches")
-    clients_pool = ThreadPoolExecutor(max_workers=n_mice + 2,
-                                      thread_name_prefix="bench-client")
+    h = _StormHarness(data, lower, batch, max_clients=n_mice + 1)
 
     async def leg(coalesce_on: bool) -> dict:
-        server = await new_async_server(0, params)
-        sched = Scheduler(
-            server,
-            cache=CacheParams(enabled=False),
-            lease=LeaseParams(enabled=False, queue_alarm_s=0.0),
-            stripe=StripeParams(enabled=False),
-            # Deterministic chunk plan (the _qos_probe discipline):
-            # the max_chunks cap (not the EWMA) sizes the elephant at
-            # 32 x 2^20 — one signature, ~0.1s of pool work each, so a
-            # mice window granted behind one elephant chunk waits a
-            # tenth of a second, not half of one. The explicit
-            # max_nonces bound (2^16) keeps elephant chunks OUT of the
-            # windows deterministically (2^20 chunks would pass the
-            # default absolute bound and could join mice windows,
-            # muddying both legs).
-            qos=QosParams(enabled=True, wholesale_s=0.3, chunk_s=0.03,
-                          max_chunks=32, depth=2),
-            coalesce=CoalesceParams(enabled=coalesce_on, lanes=lanes,
-                                    max_nonces=1 << 16))
-        sched_task = asyncio.create_task(sched.run())
-        hostport = f"127.0.0.1:{server.port}"
-        workers = []
-        try:
-            for _ in range(2):
-                w = MinerWorker(
-                    hostport, params=params,
-                    searcher_factory=lambda d, b: NonceSearcher(
-                        d, batch=probe_batch, tier="jnp"),
-                    coalesce=coalesce_on, coalesce_lanes=lanes,
-                    coalesce_max=1 << 16,
-                    # Local queue deeper than a full window, or the
-                    # drain races the reader and splits windows.
-                    pipeline_depth=2 * lanes)
-                await w.join()
-                workers.append(asyncio.create_task(w.run()))
-                workers.append(w)
-
-            def ask_blocking(lo, count):
-                # Own thread + event loop per client (see _qos_probe:
-                # the main loop shares the GIL with jit dispatch and
-                # its timers drift ~1s under compute).
-                async def go():
-                    client = await new_async_client(hostport, params)
-                    try:
-                        client.write(new_request(
-                            data, lo, lo + count - 1).to_json())
-                        while True:
-                            m = Message.from_json(
-                                await asyncio.wait_for(client.read(), 600))
-                            if m.type == MsgType.RESULT:
-                                return m
-                    finally:
-                        await client.close()
-                return asyncio.run(go())
-
+        # Deterministic chunk plan (the _qos_probe discipline): the
+        # max_chunks cap (not the EWMA) sizes the elephant at 32 x 2^20
+        # — one signature, ~0.1s of pool work each, so a mice window
+        # granted behind one elephant chunk waits a tenth of a second,
+        # not half of one. The explicit max_nonces bound (2^16) keeps
+        # elephant chunks OUT of the windows deterministically (2^20
+        # chunks would pass the default absolute bound and could join
+        # mice windows, muddying both legs).
+        async with h.cluster(
+                qos=QosParams(enabled=True, wholesale_s=0.3, chunk_s=0.03,
+                              max_chunks=32, depth=2),
+                coalesce=CoalesceParams(enabled=coalesce_on, lanes=lanes,
+                                        max_nonces=1 << 16),
+                miner_kw=dict(coalesce=coalesce_on, coalesce_lanes=lanes,
+                              coalesce_max=1 << 16,
+                              # Local queue deeper than a full window, or
+                              # the drain races the reader and splits
+                              # windows.
+                              pipeline_depth=2 * lanes)) as cl:
             async def storm(with_mice: bool):
                 t0 = time.time()
-                done = []        # (start, end) per mouse
-
-                def run_one(lo, count, delay):
-                    time.sleep(max(0.0, t0 + delay - time.time()))
-                    m0 = time.time()
-                    ask_blocking(lo, count)
-                    return m0, time.time()
-
-                def mouse(i):
+                loop = asyncio.get_running_loop()
+                tasks = [cl.submit(loop, t0, lower, elephant_count, 0.0)]
+                if with_mice:
                     # One simultaneous wave: the mice must BACKLOG
                     # behind the elephant-saturated pool for a freed
                     # slot to batch them (the coalescing shape); a
                     # staggered wave leaks early mice into solo grants
-                    # and under-measures the structural launch
-                    # collapse.
-                    done.append(run_one(lower + i * mouse_count,
-                                        mouse_count, 0.2))
-
-                # Clients on a DEDICATED pool, never asyncio.to_thread:
-                # 17 blocked client threads would exhaust the default
-                # executor (min(32, cpus+4) workers — 6 on this box),
-                # which the MINERS' own to_thread compute also needs;
-                # clients holding every worker while waiting for
-                # results the workers would compute is a deadlock
-                # (observed live while building this probe).
-                loop = asyncio.get_running_loop()
-                tasks = [loop.run_in_executor(
-                    clients_pool, run_one, lower, elephant_count, 0.0)]
-                if with_mice:
+                    # and under-measures the structural launch collapse.
                     for i in range(n_mice):
-                        tasks.append(loop.run_in_executor(
-                            clients_pool, mouse, i))
+                        tasks.append(cl.submit(
+                            loop, t0, lower + i * mouse_count,
+                            mouse_count, 0.2))
                 e0, e1 = await tasks[0]
-                await asyncio.gather(*tasks[1:])
+                done = await asyncio.gather(*tasks[1:])
                 mice_window = (max(e for _s, e in done)
                                - min(s for s, _e in done)) if done else 0.0
                 return e1 - e0, mice_window
@@ -650,21 +688,14 @@ def _batch_probe(data: str, lower: int, batch: int) -> dict:
                 "mice_window_s": mice_window,
                 "mice_per_s": n_mice / mice_window,
                 "dispatches_per_mouse": mice_launches / n_mice,
-                "window_grants": sched.stats["qos_window_grants"],
+                "window_grants": cl.sched.stats["qos_window_grants"],
+                "trace": cl.trace_summary(),
             }
-        finally:
-            for item in workers:
-                if isinstance(item, asyncio.Task):
-                    item.cancel()
-                else:
-                    await item.close()
-            sched_task.cancel()
-            await server.close()
 
     # Precompile outside the legs (process-wide jit cache): wholesale
     # shares, QoS chunks, and the coalesced pow2 row buckets a mice
     # wave can produce.
-    warm = NonceSearcher(data, batch=probe_batch, tier="jnp")
+    warm = h.warm_searcher()
     for span in (elephant_count // 2, elephant_count // 32,
                  mouse_count, mouse_count // 2):
         warm.search(lower, lower + span)
@@ -674,12 +705,7 @@ def _batch_probe(data: str, lower: int, batch: int) -> dict:
         warm.finalize_batch(warm.dispatch_batch(entries[:width]))
 
     rounds = max(1, _int_env("DBM_BENCH_BATCH_ROUNDS", 3))
-    on_rounds, off_rounds = [], []
-    for rnd in range(rounds):
-        order = (True, False) if rnd % 2 == 0 else (False, True)
-        for on in order:
-            (on_rounds if on else off_rounds).append(
-                asyncio.run(leg(on)))
+    on_rounds, off_rounds = h.interleaved(rounds, leg)
 
     def med(legs, key):
         return median(r[key] for r in legs)
@@ -721,6 +747,8 @@ def _batch_probe(data: str, lower: int, batch: int) -> dict:
             {k: round(r[k], 4) for k in
              ("dispatches_per_mouse", "mice_per_s", "elephant_s")}
             for r in off_rounds],
+        # Per-phase span medians (ISSUE 10) of the last ON leg.
+        "trace": on_rounds[-1]["trace"],
     }
 
 
@@ -771,7 +799,8 @@ def main() -> int:
     from distributed_bitcoinminer_tpu.parallel import make_mesh
     from distributed_bitcoinminer_tpu.utils.config import jax_devices_robust
     from distributed_bitcoinminer_tpu.utils.profiling import (Timer,
-                                                              device_trace)
+                                                              device_trace,
+                                                              xprof_dir)
 
     # Same resolution order as the probe child and the miners — a bare
     # jax.devices() here could crash on the exact pin the robust probe
@@ -852,9 +881,10 @@ def main() -> int:
             t0 = time.time()
             searcher.search(lower, t_upper)  # compile + warm the signature
             warm_s = time.time() - t0
-            trace_dir = _str_env("DBM_TRACE")
-            if trace_dir:
-                with device_trace(os.path.join(trace_dir, tier)):
+            if xprof_dir(tier):
+                # DBM_TRACE_XPROF logdir selection lives inside
+                # profiling.xprof_dir/device_trace (ISSUE 10 satellite).
+                with device_trace(tier=tier):
                     searcher.search(lower, t_upper)
             rate, secs, reps = _measure(searcher, lower, t_upper, min_time_s,
                                         Timer)

@@ -23,6 +23,13 @@ kwargs (every kwarg except the metric-shape ones ``tau_s``/``buckets``):
 
 The metric NAME must be a string literal — a computed name defeats both
 this check and snapshot diffing, and is flagged outright.
+
+Trace-track extension (ISSUE 10): the Perfetto exporter's per-miner /
+per-tenant tracks (``utils/trace.TrackSet``) are labeled entities with
+the exact same churn failure mode, so ``.track("name", miner=conn_id)``
+sites obey the identical rule — a dynamic label needs a same-module
+``.retire("name", ...)`` retirement path (miner drop / tenant GC) or a
+suppression with the boundedness argument.
 """
 
 from __future__ import annotations
@@ -35,20 +42,28 @@ from .core import Finding, SourceFile, scope_map, str_const
 NAME = "cardinality"
 
 SCOPE_PREFIX = "distributed_bitcoinminer_tpu/"
-REGISTRY_METHODS = {"counter", "gauge", "histogram", "ewma"}
+REGISTRY_METHODS = {"counter", "gauge", "histogram", "ewma", "track"}
 SHAPE_KWARGS = {"tau_s", "buckets"}
+#: Which retirement method covers which registration method: metric
+#: series retire via ``Registry.remove``, export tracks (ISSUE 10) via
+#: ``TrackSet.retire`` — a ``.remove`` cannot vouch for a ``.track``
+#: site or vice versa.
+RETIREMENT_FOR = {"counter": "remove", "gauge": "remove",
+                  "histogram": "remove", "ewma": "remove",
+                  "track": "retire"}
 
 
-def _removed_names(tree: ast.AST) -> set:
-    """Metric names passed to any ``.remove("name", ...)`` in the file."""
-    out = set()
+def _removed_names(tree: ast.AST) -> dict:
+    """``retire-method -> {metric names}`` passed to any
+    ``.remove("name", ...)`` / ``.retire("name", ...)`` in the file."""
+    out: dict = {m: set() for m in set(RETIREMENT_FOR.values())}
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) and \
                 isinstance(node.func, ast.Attribute) and \
-                node.func.attr == "remove" and node.args:
+                node.func.attr in out and node.args:
             name = str_const(node.args[0])
             if name is not None:
-                out.add(name)
+                out[node.func.attr].add(name)
     return out
 
 
@@ -114,15 +129,16 @@ def analyze(files: List[SourceFile], repo: str) -> List[Finding]:
                                 and kw.value.id in bounded_here)]
             if not dynamic:
                 continue
-            if metric in removed:
+            retire_via = RETIREMENT_FOR[node.func.attr]
+            if metric in removed[retire_via]:
                 continue   # per-entity series with a retirement path
             out.append(Finding(
                 NAME, f.rel, node.lineno,
                 f"{NAME}:{f.rel}:{metric}:{'/'.join(sorted(dynamic))}",
                 f"metric {metric!r} takes dynamic label(s) "
-                f"{sorted(dynamic)} with no .remove({metric!r}, ...) "
-                f"retirement path in this module — entity churn will "
-                f"exhaust the series bound; retire the series where the "
-                f"entity dies, or suppress with the boundedness "
-                f"argument"))
+                f"{sorted(dynamic)} with no .{retire_via}({metric!r}, "
+                f"...) retirement path in this module — entity churn "
+                f"will exhaust the series bound; retire the series "
+                f"where the entity dies, or suppress with the "
+                f"boundedness argument"))
     return out

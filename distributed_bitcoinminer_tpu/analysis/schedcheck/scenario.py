@@ -26,6 +26,10 @@ schedule the INVARIANT PACK runs:
   violation counters must not grow during the schedule (PR 6's
   THREAD_SHARED ownership tables, re-checked as happens-before facts
   under the virtual scheduler — the executor hops are real threads);
+- **span closure** (ISSUE 10): every request trace the scheduler
+  registered during the schedule is CLOSED (terminal reply/cancel) at
+  quiescence — an open span is a forgotten request or a trace-plane
+  path that lost its terminal event;
 - **no unhandled exceptions** anywhere in the population.
 
 Scenario randomness is layered for shrinkability: BUILD-time constants
@@ -416,6 +420,29 @@ class Scenario:
                     f"(accounting imbalance)")
         return out
 
+    @staticmethod
+    def check_spans_closed(ctx: Ctx) -> List[str]:
+        """Trace-span completeness at quiescence (ISSUE 10): every
+        request trace the scheduler REGISTERED (dispatched, shed, or
+        cache-replayed — queued-then-purged requests never register)
+        must be CLOSED (terminal ``reply``/``cancel`` event) once
+        nothing is in flight and nothing is queued. An open trace at
+        quiescence is a request the scheduler forgot to answer OR a
+        trace-plane path that dropped its terminal event — both real
+        bugs the per-schedule exploration should surface, not just the
+        e2e suites."""
+        out = []
+        sched = ctx.sched
+        if sched is None or sched._inflight or sched.queue:
+            return out     # not quiescent: accounting checks report that
+        for key, trace in sched.traces.items():
+            if not trace.closed:
+                events = [e["event"] for e in trace.to_dict()["events"]]
+                out.append(
+                    f"trace {key!r} open at quiescence (span leak): "
+                    f"events={events}")
+        return out
+
 
 # ---------------------------------------------------------------- executor
 
@@ -494,6 +521,10 @@ def _execute(scenario: Scenario, seed: int,
         loop.drain()
     loop.close()
     violations.extend(scenario.check(ctx))
+    # Generic pack addition (ISSUE 10): every span opened in the
+    # explored schedule must be closed at quiescence, whatever the
+    # scenario — scenario.check() need not opt in.
+    violations.extend(Scenario.check_spans_closed(ctx))
     for name in SANITIZE_COUNTERS:
         delta = _registry().counter(name).value - before[name]
         if delta:

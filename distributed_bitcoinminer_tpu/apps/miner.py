@@ -64,6 +64,7 @@ from ..lsp.client import AsyncClient, new_async_client
 from ..lsp.errors import LspError
 from ..lsp.params import Params
 from ..utils import sanitize as _sanitize
+from ..utils import trace as _trace
 from ..utils._env import int_env as _int_env
 from ..utils.metrics import (OCCUPANCY_BUCKETS, ensure_emitter,
                              registry as _registry)
@@ -91,6 +92,10 @@ _MET_TWO_PHASE = _M.counter("miner.chunks_two_phase")
 _MET_COAL_DISPATCHES = _M.counter("miner.coalesced_dispatches")
 _MET_COAL_CHUNKS = _M.counter("miner.chunks_coalesced")
 _MET_COAL_WIDTH = _M.histogram("miner.coalesce_width", OCCUPANCY_BUCKETS)
+# Tracing plane (ISSUE 10): the compile observer's fresh-signature
+# counter, read around each dispatch so a span can report how many jit
+# compiles it paid (same registry series utils/trace.py increments).
+_MET_JITC = _M.counter("trace.jit_compiles")
 
 
 class _ThroughputWindow:
@@ -300,6 +305,15 @@ class MinerWorker:
         # slow-callback watchdog and arms the off-loop assertions on the
         # compute entry points below.
         self._sanitize = _sanitize.ensure_sanitizer()
+        # Tracing plane (ISSUE 10): DBM_TRACE=1 (default) records one
+        # span per served chunk — reader-queue wait, dispatch, pipeline
+        # wait, force, bubble gap, shared-launch membership — shipped
+        # back on the Result's Span extension for the scheduler to
+        # stitch; 0 leaves every Result byte-identical to stock and the
+        # hooks below are single boolean checks.
+        self._trace = _trace.ensure_tracer()
+        self._trace_launch = 0        # per-miner shared-launch id seq
+        self._trace_last_done = 0.0   # previous chunk's finish stamp
 
     async def join(self) -> None:
         """Connect and send Join (ref: miner.go:24-34)."""
@@ -381,13 +395,18 @@ class MinerWorker:
                     continue
                 if msg.type != MsgType.REQUEST:
                     continue
+                if self._trace:
+                    # Span anchor: the reader-queue wait phase starts
+                    # here (the stamp rides the Message object — local
+                    # bookkeeping, never serialized back out).
+                    msg._recv_t = time.monotonic()
                 # A full queue backpressures here; the LSP engine keeps
                 # acking/heartbeating underneath regardless.
                 await queue.put(msg)
 
         reader_task = asyncio.create_task(reader())
         _IDLE = object()
-        inflight = None     # (msg[s], searcher, handle, t0, dispatch_s)
+        inflight = None  # (msg[s], searcher, handle, t0, dispatch_s, span[s])
         carry = None        # drained-but-incompatible msg (or _STOP)
         try:
             while True:
@@ -461,16 +480,18 @@ class MinerWorker:
                     continue
                 if dtask is not None:
                     try:
-                        searcher, handle, dispatch_s = await dtask
+                        searcher, handle, dispatch_s, sp = await dtask
                     except Exception:
                         await self._exit_broken(
                             msgs[0] if msgs is not None else msg)
                         return
                     if handle is not None and msgs is not None:
-                        inflight = (msgs, searcher, handle, t0, dispatch_s)
+                        inflight = (msgs, searcher, handle, t0,
+                                    dispatch_s, sp)
                         _MET_TWO_PHASE.inc(len(msgs))
                     elif handle is not None:
-                        inflight = (msg, searcher, handle, t0, dispatch_s)
+                        inflight = (msg, searcher, handle, t0,
+                                    dispatch_s, sp)
                         _MET_TWO_PHASE.inc()
                     elif msgs is not None:
                         # No batch API (or gated tier): degrade to the
@@ -495,30 +516,76 @@ class MinerWorker:
         return (msg.target == 0 and msg.lower <= msg.upper
                 and msg.upper - msg.lower + 1 <= self.coalesce_max)
 
+    def _span_open(self, msg) -> Optional[dict]:
+        """Span skeleton at dispatch-worker entry: the reader-queue wait
+        phase closes here, and the compile-counter base is stamped so
+        the span can report fresh-signature compiles it paid. None when
+        tracing is off (the entire span path is then dead)."""
+        if not self._trace:
+            return None
+        now = time.monotonic()
+        return {"queue_s": round(max(0.0, now - getattr(
+            msg, "_recv_t", now)), 6), "_c0": _MET_JITC.value}
+
+    @staticmethod
+    def _span_dispatched(span: Optional[dict], dispatch_s: float) -> None:
+        """Close the dispatch phase (worker thread, right after the
+        device enqueue returned)."""
+        if span is None:
+            return
+        span["dispatch_s"] = round(dispatch_s, 6)
+        span["_d_end"] = time.monotonic()
+        compiles = _MET_JITC.value - span.pop("_c0", 0)
+        if compiles:
+            span["compiles"] = compiles
+
+    def _span_close(self, span: Optional[dict], t0: float, t2: float,
+                    t3: float) -> Optional[dict]:
+        """Finish a span at reply time: pipeline wait (dispatch done →
+        force start), force, and the executor bubble gap BEFORE this
+        chunk (idle time since the previous chunk's finish — the
+        pipeline's lost overlap, visible per chunk instead of only in
+        the aggregate occupancy gauge). Internal keys are stripped; the
+        returned dict is exactly what rides the wire."""
+        if span is None:
+            return None
+        d_end = span.pop("_d_end", t2)
+        span.pop("_c0", None)
+        span["wait_s"] = round(max(0.0, t2 - d_end), 6)
+        span["force_s"] = round(max(0.0, t3 - t2), 6)
+        if self._trace_last_done:
+            span["gap_s"] = round(max(0.0, t0 - self._trace_last_done), 6)
+        return span
+
     def _resolve_and_dispatch(self, msg):
         """Worker-thread half of a two-phase chunk: resolve the searcher
         — possibly CONSTRUCTING it, which on first touch runs JAX backend
         init and must therefore never happen on the event loop — and
-        start its dispatch. Returns ``(searcher, handle, dispatch_s)``;
-        ``handle`` is None when the searcher lacks the two-phase API
-        (caller degrades to the blocking path, which finds the searcher
-        cached). ``dispatch_s`` is the dispatch phase's own elapsed time,
-        so the chunk-latency histogram can report busy time (dispatch +
-        finalize) rather than wall time — a pipelined chunk's wall span
-        includes head-of-line wait behind the previous chunk's
+        start its dispatch. Returns ``(searcher, handle, dispatch_s,
+        span)``; ``handle`` is None when the searcher lacks the two-phase
+        API (caller degrades to the blocking path, which finds the
+        searcher cached). ``dispatch_s`` is the dispatch phase's own
+        elapsed time, so the chunk-latency histogram can report busy time
+        (dispatch + finalize) rather than wall time — a pipelined chunk's
+        wall span includes head-of-line wait behind the previous chunk's
         finalize+write, which would read as a latency regression in
-        BENCH artifact diffs whenever the knob toggles."""
+        BENCH artifact diffs whenever the knob toggles. ``span`` is the
+        chunk's trace-span skeleton (None with ``DBM_TRACE=0``)."""
         if self._sanitize:
             _sanitize.assert_off_loop("miner searcher resolution/dispatch")
+        span = self._span_open(msg)
         t0 = time.monotonic()
         searcher = self._get_searcher(msg.data)
         if hasattr(searcher, "dispatch") and hasattr(searcher, "finalize"):
             handle = searcher.dispatch(msg.lower, msg.upper)
-            return searcher, handle, time.monotonic() - t0
-        return searcher, None, 0.0
+            dispatch_s = time.monotonic() - t0
+            self._span_dispatched(span, dispatch_s)
+            return searcher, handle, dispatch_s, span
+        return searcher, None, 0.0, span
 
     async def _finalize_and_reply(self, msg, searcher, handle, t0: float,
-                                  dispatch_s: float) -> bool:
+                                  dispatch_s: float,
+                                  span: Optional[dict] = None) -> bool:
         """Force a dispatched chunk's results and write its Result; False
         ends the serve loop (transport death or broken compute)."""
         t2 = time.monotonic()
@@ -528,21 +595,27 @@ class MinerWorker:
         except Exception:
             await self._exit_broken(msg)
             return False
-        busy_s = dispatch_s + (time.monotonic() - t2)
+        t3 = time.monotonic()
+        busy_s = dispatch_s + (t3 - t2)
         return self._reply(msg, best_hash, best_nonce, 0, t0,
-                           busy_s=busy_s)
+                           busy_s=busy_s,
+                           span=self._span_close(span, t0, t2, t3))
 
     def _resolve_and_dispatch_batch(self, msgs: list):
         """Worker-thread half of a COALESCED chunk set (ISSUE 9):
         resolve every chunk's searcher (cache-miss construction runs
         JAX backend init — same off-loop rule as the single-chunk path)
         and start ONE batched dispatch through the first searcher's
-        ``dispatch_batch``. Returns ``(searcher, handle, dispatch_s)``;
-        ``handle`` is None when the searchers cannot serve a batch
-        (no batch API, incompatible mix, gated pallas tier) — the
-        caller then degrades to per-chunk serving, still in order."""
+        ``dispatch_batch``. Returns ``(searcher, handle, dispatch_s,
+        spans)``; ``handle`` is None when the searchers cannot serve a
+        batch (no batch API, incompatible mix, gated pallas tier) — the
+        caller then degrades to per-chunk serving, still in order.
+        ``spans`` is one trace-span skeleton per chunk (each with its
+        OWN reader-queue wait; dispatch/force phases are the shared
+        launch's, stamped batch-wide)."""
         if self._sanitize:
             _sanitize.assert_off_loop("miner batched resolution/dispatch")
+        spans = [self._span_open(m) for m in msgs]
         t0 = time.monotonic()
         searchers = [self._get_searcher(m.data) for m in msgs]
         s0 = searchers[0]
@@ -551,12 +624,17 @@ class MinerWorker:
                 [(s, m.lower, m.upper)
                  for s, m in zip(searchers, msgs)])
             if handle is not None:
-                return s0, handle, time.monotonic() - t0
-        return s0, None, 0.0
+                dispatch_s = time.monotonic() - t0
+                for span in spans:
+                    self._span_dispatched(span, dispatch_s)
+                return s0, handle, dispatch_s, spans
+        return s0, None, 0.0, spans
 
     async def _finalize_and_reply_batch(self, msgs: list, searcher,
                                         handle, t0: float,
-                                        dispatch_s: float) -> bool:
+                                        dispatch_s: float,
+                                        spans: Optional[list] = None
+                                        ) -> bool:
         """Force a coalesced dispatch with ONE fetch and scatter the
         per-request Results in request order; False ends the serve
         loop."""
@@ -567,11 +645,14 @@ class MinerWorker:
         except Exception:
             await self._exit_broken(msgs[0])
             return False
-        busy_s = dispatch_s + (time.monotonic() - t2)
-        return self._reply_batch(msgs, results, t0, busy_s)
+        t3 = time.monotonic()
+        busy_s = dispatch_s + (t3 - t2)
+        if spans is not None:
+            spans = [self._span_close(s, t0, t2, t3) for s in spans]
+        return self._reply_batch(msgs, results, t0, busy_s, spans=spans)
 
     def _reply_batch(self, msgs: list, results: list, t0: float,
-                     busy_s: float) -> bool:
+                     busy_s: float, spans: Optional[list] = None) -> bool:
         """Batch-aware accounting + in-order Result scatter (ISSUE 9
         satellite): busy time is attributed ONCE per shared launch —
         observing the same interval per chunk would hand the
@@ -589,16 +670,34 @@ class MinerWorker:
                     if m.upper >= m.lower)
         if total:
             self._window.observe(t0, t1, total)
-        for msg, (best_hash, best_nonce) in zip(msgs, results):
+        launch_id = None
+        if spans is not None and any(s is not None for s in spans):
+            # One shared-launch id per coalesced dispatch: every lane's
+            # span carries it, so the stitched traces of N different
+            # requests show the SAME launch — the cross-request batching
+            # made visible per request.
+            self._trace_launch += 1
+            launch_id = self._trace_launch
+        for i, (msg, (best_hash, best_nonce)) in enumerate(
+                zip(msgs, results)):
             _MET_CHUNKS.inc()
             if msg.upper >= msg.lower:
                 _MET_NONCES.inc(msg.upper - msg.lower + 1)
+            span = spans[i] if spans is not None else None
+            if span is not None:
+                span["launch"] = launch_id
+                span["lanes"] = len(msgs)
             try:
                 self.client.write(
-                    new_result(best_hash, best_nonce, 0).to_json())
+                    new_result(best_hash, best_nonce, 0,
+                               span=span).to_json())
             except LspError:
                 return False
             self.jobs_done += 1
+        if self._trace:
+            self._trace_last_done = t1
+            _trace.flight("chunk_batch_done", lanes=len(msgs),
+                          busy_s=round(busy_s, 6), launch=launch_id)
         return True
 
     async def _serve_two_phase(self, msg) -> bool:
@@ -611,7 +710,7 @@ class MinerWorker:
         per-chunk accounting identical to the stock path."""
         t0 = time.monotonic()
         try:
-            searcher, handle, dispatch_s = await asyncio.to_thread(
+            searcher, handle, dispatch_s, span = await asyncio.to_thread(
                 self._resolve_and_dispatch, msg)
         except Exception:
             await self._exit_broken(msg)
@@ -620,13 +719,18 @@ class MinerWorker:
             return await self._serve_blocking(msg)
         _MET_TWO_PHASE.inc()
         return await self._finalize_and_reply(msg, searcher, handle, t0,
-                                              dispatch_s)
+                                              dispatch_s, span)
 
     async def _serve_blocking(self, msg) -> bool:
         """One chunk through the stock blocking search; False ends the
         serve loop. Shared by the serial loop and the pipelined
         executor's degraded (target / no-two-phase-API) path."""
         # Compute off-loop so LSP heartbeats keep flowing mid-search.
+        span = self._span_open(msg)
+        if span is not None:
+            # Blocking chunk: the whole search is one force-like phase
+            # (there is no dispatch/finalize split to attribute).
+            span["serial"] = 1
         t0 = time.monotonic()
         try:
             best_hash, best_nonce, echo_target = await asyncio.to_thread(
@@ -644,7 +748,9 @@ class MinerWorker:
             # to init in the miner process).
             await self._exit_broken(msg)
             return False
-        return self._reply(msg, best_hash, best_nonce, echo_target, t0)
+        return self._reply(msg, best_hash, best_nonce, echo_target, t0,
+                           span=self._span_close(span, t0, t0,
+                                                 time.monotonic()))
 
     async def _exit_broken(self, msg) -> None:
         """Compute-failure exit path (must be called from an except
@@ -656,7 +762,8 @@ class MinerWorker:
 
     def _reply(self, msg, best_hash: int, best_nonce: int,
                echo_target: int, t0: float,
-               busy_s: Optional[float] = None) -> bool:
+               busy_s: Optional[float] = None,
+               span: Optional[dict] = None) -> bool:
         """Per-chunk accounting + in-order Result write; False on
         transport death. ``busy_s`` (pipelined two-phase chunks) keeps
         the chunk-latency histogram on compute time — dispatch +
@@ -679,10 +786,13 @@ class MinerWorker:
                 self._window.observe(t0, t1, scanned)
         try:
             self.client.write(
-                new_result(best_hash, best_nonce, echo_target).to_json())
+                new_result(best_hash, best_nonce, echo_target,
+                           span=span).to_json())
         except LspError:
             return False
         self.jobs_done += 1
+        if self._trace:
+            self._trace_last_done = t1
         return True
 
     def _get_searcher(self, data: str):

@@ -181,6 +181,7 @@ from ..bitcoin.message import Message, MsgType, new_request, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
 from ..utils import sanitize as _sanitize
+from ..utils import trace as _tracing
 from ..utils.config import CacheParams, CoalesceParams, LeaseParams, \
     QosParams, StripeParams, coalesce_from_env, qos_from_env, \
     stripe_from_env
@@ -435,6 +436,16 @@ class Scheduler:
                                                     OCCUPANCY_BUCKETS)
         self.traces = TraceBuffer()
         self._cache_trace_seq = 0
+        # Cross-process tracing plane (ISSUE 10, DBM_TRACE=1 default):
+        # miner-side chunk spans arriving on the Result's Span extension
+        # are stitched into the request's trace, and the Perfetto export
+        # draws one track per miner/tenant. Track identity lives in a
+        # TrackSet under the same cardinality discipline as labeled
+        # metric series — registered on first sight, RETIRED on miner
+        # drop / tenant GC so conn churn cannot grow the export without
+        # bound. DBM_TRACE=0 turns every hook into one boolean check.
+        self._trace_on = _tracing.ensure_tracer()
+        self._tracks = _tracing.TrackSet()
         # Fair-share QoS plane (ISSUE 5): always constructed (tenant
         # accounting is a few dicts), consulted only when qos.enabled.
         # ``clock`` (ISSUE 8) feeds the admission token buckets: the
@@ -510,6 +521,59 @@ class Scheduler:
                        json.dumps(trace.to_dict(), sort_keys=True,
                                   default=str))
 
+    def _fold_span(self, trace, conn_id: int, chunk: Chunk,
+                   span: Optional[dict]) -> None:
+        """Stitch one miner-side chunk span (the Result's Span wire
+        extension) into the request's trace as a ``miner_span`` event
+        (ISSUE 10). The span vocabulary is whitelisted (a hostile peer
+        cannot inject arbitrary keys into dumps), the DOMINANT phase is
+        named inline so a stalled request's dump reads "force stalled on
+        miner 7" without arithmetic, and the owning miner's export track
+        is registered (retired again on miner drop)."""
+        if span is None or trace is None or not self._trace_on:
+            return
+        clean = {}
+        for key in _tracing.SPAN_PHASES + _tracing.SPAN_EXTRAS:
+            v = span.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                clean[key] = v
+        if not clean:
+            return
+        self._tracks.track("trace_track", miner=str(conn_id))
+        slow = _tracing.slow_phase(clean)
+        if slow is not None:
+            clean["slow"] = slow
+        trace.event("miner_span", miner=conn_id, idx=chunk.idx, **clean)
+
+    def export_trace(self, path: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON (Perfetto-loadable) of every retained
+        request trace: one track per tenant (scheduler process) and per
+        miner, request slices + instant fault events + the stitched
+        miner-side phase spans (``scripts/dbmtrace.py`` is the CLI
+        wrapper). Returns the document; ``path`` also writes it."""
+        dicts = []
+        for _key, t in self.traces.items():
+            d = t.to_dict()
+            d["t0"] = t.t0
+            dicts.append(d)
+        tenant_tracks, miner_tracks = {}, {}
+        for labels, tid in self._tracks.items("trace_track"):
+            labels = dict(labels)
+            if "tenant" in labels:
+                tenant_tracks[labels["tenant"]] = tid
+            if "miner" in labels:
+                miner_tracks[labels["miner"]] = tid
+        doc = _tracing.to_chrome_trace(dicts, tenant_tracks=tenant_tracks,
+                                       miner_tracks=miner_tracks)
+        if path:
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, sort_keys=True)
+        return doc
+
+    def _track_tenant(self, conn_id: int) -> None:
+        if self._trace_on:
+            self._tracks.track("trace_track", tenant=str(conn_id))
+
     # ------------------------------------------------------------- main loop
 
     async def run(self) -> None:
@@ -555,10 +619,16 @@ class Scheduler:
                     # work, nothing granted outstanding, and a full
                     # admission bucket carries no state worth keeping —
                     # dropping it frees its metric series so conn churn
-                    # stays bounded over a long server life.
+                    # stays bounded over a long server life. Tenants the
+                    # GC forgets also lose their export track (ISSUE 10):
+                    # the track registry obeys the same churn rule.
+                    before = set(self.qos_plane.tenants)
                     self.qos_plane.gc(
                         {r.conn_id for r in self.queue}
                         | {r.conn_id for r in self._inflight.values()})
+                    for tenant in before - set(self.qos_plane.tenants):
+                        self._tracks.retire("trace_track",
+                                            tenant=str(tenant))
             except Exception:   # noqa: BLE001 — the sweep must never die
                 logger.exception("lease sweep failed; continuing")
 
@@ -622,6 +692,7 @@ class Scheduler:
         trace.event("cache_hit", at="request")
         trace.event("reply", hash=h, nonce=nonce, cached=True)
         self.traces.register(f"cache:{self._cache_trace_seq}", trace)
+        self._track_tenant(conn_id)
 
     def _on_join(self, conn_id: int) -> None:
         if self._owner is not None:
@@ -664,6 +735,11 @@ class Scheduler:
             stale = self.traces.get(chunk.job_id)
             if stale is not None:
                 stale.event("stale_result", miner=conn_id, idx=chunk.idx)
+                # A wedged/slow miner's span arrives LATE by definition
+                # (its chunk was re-issued and the request already
+                # replied): stitching it into the closed trace is what
+                # names the miner-side phase that stalled.
+                self._fold_span(stale, conn_id, chunk, msg.span)
             # A freed miner may unblock a queued/ungranted chunk.
             if self.qos.enabled:
                 self._maybe_dispatch()
@@ -674,6 +750,7 @@ class Scheduler:
             # the identical range, so dropping the duplicate changes
             # nothing but the stats.
             self._count("dup_results")
+            self._fold_span(curr.trace, conn_id, chunk, msg.span)
             curr.trace.event("result", miner=conn_id, idx=chunk.idx,
                              duplicate=True)
             logger.info("duplicate Result for job %d chunk %d from miner %d "
@@ -689,6 +766,7 @@ class Scheduler:
         curr.answered[chunk.idx] = True
         if self.qos.enabled:
             self.qos_plane.on_chunk_answered(curr.conn_id)
+        self._fold_span(curr.trace, conn_id, chunk, msg.span)
         curr.trace.event("result", miner=conn_id, idx=chunk.idx)
         curr.trace.event("merge", idx=chunk.idx,
                          answered=sum(curr.answered))
@@ -734,6 +812,11 @@ class Scheduler:
             # bound over a long server life.
             self.metrics.remove("miner_rate_nps", miner=str(conn_id))
             self.metrics.remove("lease_remaining_s", miner=str(conn_id))
+            # Export-track retirement (ISSUE 10): same churn rule as the
+            # labeled series above — a dead conn id's track must free
+            # its slot under the cardinality bound.
+            self._tracks.retire("trace_track", miner=str(conn_id))
+            _tracing.flight("miner_drop", miner=conn_id)
             if not self._inflight:
                 return
             for req in self._inflight.values():
@@ -765,6 +848,7 @@ class Scheduler:
                     req.trace.event("cancel", reason="client_drop")
             self.queue = [r for r in self.queue if r.conn_id != conn_id]
             self._queue_depth.set(len(self.queue))
+            self._tracks.retire("trace_track", tenant=str(conn_id))
             if self.qos.enabled:
                 self.qos_plane.forget(conn_id)
             for req in [r for r in self._inflight.values()
@@ -790,6 +874,9 @@ class Scheduler:
         elapsed = time.monotonic() - curr.started
         curr.trace.event("reply", hash=h, nonce=nonce, early=early,
                          weak=curr.weak, elapsed_s=round(elapsed, 6))
+        if self._trace_on:
+            _tracing.flight("reply", job=curr.job_id, tenant=curr.conn_id,
+                            elapsed_s=round(elapsed, 6))
         logger.info(
             "request %d served in %.3fs: [%d, %d) over %d chunks%s%s",
             curr.job_id, elapsed,
@@ -952,6 +1039,7 @@ class Scheduler:
         req.trace.event("reply", hash=hit[0], nonce=hit[1], cached=True)
         self._cache_trace_seq += 1
         self.traces.register(f"cache:{self._cache_trace_seq}", req.trace)
+        self._track_tenant(req.conn_id)
         logger.info(
             "queued request %r [%d, %d] answered from "
             "the result cache at dispatch", req.data,
@@ -1223,11 +1311,15 @@ class Scheduler:
         req.started = time.monotonic()
         self._queue_wait.observe(req.started - req.queued_at)
         self.traces.register(req.job_id, req.trace)
+        self._track_tenant(req.conn_id)
         self._inflight[req.job_id] = req
         req.upper += 1  # inclusive -> exclusive
         total = req.upper - req.lower
         req.trace.event("dispatch", job=req.job_id, mode="chunked",
                         miners=[m.conn_id for m in pool])
+        if self._trace_on:
+            _tracing.flight("dispatch", job=req.job_id, mode="chunked",
+                            tenant=req.conn_id)
         if total <= 0:
             # Empty/inverted range, same answer as the wholesale path.
             self._finish(req, MAX_U64, 0)
@@ -1314,6 +1406,10 @@ class Scheduler:
             self._cache_trace_seq += 1
             self.traces.register(f"shed:{self._cache_trace_seq}",
                                  victim.trace)
+            self._track_tenant(victim.conn_id)
+            if self._trace_on:
+                _tracing.flight("shed", tenant=victim.conn_id,
+                                reason=reason)
         logger.warning(
             "QoS shed (%s): request %r [%d, %d] from tenant %d "
             "(+%d queued sibling(s)); closing its conn so the client "
@@ -1342,9 +1438,13 @@ class Scheduler:
         request.started = time.monotonic()
         self._queue_wait.observe(request.started - request.queued_at)
         self.traces.register(request.job_id, request.trace)
+        self._track_tenant(request.conn_id)
         request.trace.event("dispatch", job=request.job_id,
                             miners=[m.conn_id for m in pool],
                             desperate=desperate)
+        if self._trace_on:
+            _tracing.flight("dispatch", job=request.job_id,
+                            mode="wholesale", tenant=request.conn_id)
         if desperate:
             self._count("desperation_dispatch")
             m = pool[0]
@@ -1450,6 +1550,9 @@ class Scheduler:
                         lower=chunk.lower, upper=chunk.upper, kind=kind,
                         fifo_pos=len(miner.pending) - 1,
                         lease_started=chunk.lease_started)
+        if self._trace_on:
+            _tracing.flight("assign", job=chunk.job_id, idx=chunk.idx,
+                            miner=miner.conn_id, kind=kind)
         self._write(miner.conn_id,
                     new_request(chunk.data, chunk.lower, chunk.upper,
                                 chunk.target))
@@ -1611,6 +1714,12 @@ class Scheduler:
                             tenant=req.conn_id,
                             grant_share=round(share, 4))
             self._dump_trace("in-flight age alarm", req.trace)
+        if self._trace_on and (queue_alarmed or inflight_due):
+            # Flight-recorder post-mortem (ISSUE 10): the alarm's trace
+            # dump explains ONE request; the ring shows what the whole
+            # control plane did around the stall. Once per sweep even
+            # when both alarm kinds fired — the ring is one document.
+            _tracing.flight_dump("queue-age / in-flight alarm")
 
     def _check_leases(self) -> None:
         """One lease sweep: blow expired leases (quarantining repeat
@@ -1663,6 +1772,11 @@ class Scheduler:
                                      idx=chunk.idx,
                                      streak=miner.blown_streak,
                                      spurious=spurious)
+                    if self._trace_on:
+                        _tracing.flight("lease_blown", job=chunk.job_id,
+                                        idx=chunk.idx,
+                                        miner=miner.conn_id,
+                                        streak=miner.blown_streak)
                     logger.warning(
                         "miner %d blew the lease on job %d chunk %d "
                         "[%d, %d) after %.2fs (streak %d)%s",
@@ -1693,6 +1807,11 @@ class Scheduler:
                 curr.trace.event("reissue", idx=chunk.idx,
                                  from_miner=miner.conn_id,
                                  to_miner=takeover.conn_id)
+                if self._trace_on:
+                    _tracing.flight("reissue", job=chunk.job_id,
+                                    idx=chunk.idx,
+                                    from_miner=miner.conn_id,
+                                    to_miner=takeover.conn_id)
                 logger.warning(
                     "speculatively re-issuing job %d chunk %d [%d, %d) "
                     "from miner %d to miner %d",

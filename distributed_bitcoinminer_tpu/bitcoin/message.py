@@ -13,6 +13,15 @@ the unknown key (``encoding/json`` ignores fields with no struct match)
 and performs a full arg-min scan — a valid, if slower, answer to the same
 Request. ``target == 0`` means "no target": no uint64 hash is ``< 0``, so
 zero could never qualify a nonce anyway.
+
+Trace extension (ISSUE 10, same mechanics as ``Target``): a miner→server
+Result may carry a ``Span`` object — the chunk's device-timing span
+(utils/trace.py phase vocabulary) that the scheduler stitches into the
+request's trace. Appended only when set (``DBM_TRACE=1``) so a span-less
+message keeps stock bytes bit-for-bit; a stock endpoint drops the
+unknown key. Parsing tolerates ANY malformed value by dropping it to
+None — an observability field must never kill a message that carries a
+valid answer.
 """
 
 from __future__ import annotations
@@ -46,9 +55,13 @@ class Message:
     hash: int = 0
     nonce: int = 0
     target: int = 0   # extension; 0 = absent (stock bytes)
+    span: dict = None  # trace extension; None = absent (stock bytes)
 
     def to_json(self) -> bytes:
         tail = f',"Target":{self.target}' if self.target else ""
+        if self.span:
+            tail += ',"Span":%s' % json.dumps(
+                self.span, sort_keys=True, separators=(",", ":"))
         return (
             '{"Type":%d,"Data":%s,"Lower":%d,"Upper":%d,"Hash":%d,"Nonce":%d%s}'
             % (int(self.type), _go_json_string(self.data), self.lower, self.upper,
@@ -88,6 +101,12 @@ class Message:
         type_value = obj.get("Type", 0)
         if isinstance(type_value, bool) or not isinstance(type_value, int):
             raise ValueError("Type is not an integer")
+        # Span is observability-only: a malformed value (non-dict, junk
+        # from a hostile peer) is dropped, never an error — the message
+        # still carries a valid answer the merge must not lose.
+        span = obj.get("Span")
+        if not isinstance(span, dict):
+            span = None
         return cls(
             type=MsgType(type_value),
             data=obj.get("Data", ""),
@@ -96,6 +115,7 @@ class Message:
             hash=u64("Hash"),
             nonce=u64("Nonce"),
             target=u64("Target"),
+            span=span,
         )
 
     def __str__(self) -> str:
@@ -116,9 +136,12 @@ def new_request(data: str, lower: int, upper: int, target: int = 0) -> Message:
                    target=target)
 
 
-def new_result(hash_value: int, nonce: int, target: int = 0) -> Message:
+def new_result(hash_value: int, nonce: int, target: int = 0,
+               span: dict = None) -> Message:
     """``target``: until-speaking miners echo the Request's target so the
     scheduler can tell which responders honored the extension (a stock
-    miner drops the key; 0 serializes to reference-identical bytes)."""
+    miner drops the key; 0 serializes to reference-identical bytes).
+    ``span``: the chunk's device-timing span (``DBM_TRACE=1`` miners;
+    None serializes to reference-identical bytes)."""
     return Message(type=MsgType.RESULT, hash=hash_value, nonce=nonce,
-                   target=target)
+                   target=target, span=span)

@@ -30,6 +30,7 @@ from ..ops.sha256_host import sha256_midstate
 from ..ops.sha256_jnp import build_hoist, build_tail_template
 from ..utils._env import str_env as _str_env
 from ..utils.metrics import registry as _registry
+from ..utils.trace import observe_launch as _observe_launch
 
 _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
 #: Row cap per coalesced launch: a batch wider than this splits into
@@ -271,9 +272,16 @@ class NonceSearcher:
 
     def search_block(self, plan: _BlockPlan) -> list:
         """Dispatch one block as pow2 sub-dispatches; returns a list of
-        (hi, lo, idx) device-scalar triples, ascending by span."""
+        (hi, lo, idx) device-scalar triples, ascending by span.
+
+        Each sub-dispatch runs under the compile observer
+        (utils/trace.py): the launch's static signature — the exact
+        tuple the jit-static lint guards — is what the recompile-storm
+        alarm watches, and a fresh signature's first-call elapsed is the
+        compile estimate."""
         subs = self._sub_dispatches(plan)
         _MET_LAUNCHES.inc(len(subs))
+        out = []
         if self.tier == "pallas":
             from ..ops.sha256_pallas import pallas_argmin
 
@@ -282,18 +290,30 @@ class NonceSearcher:
             # right interpret signal here (the mesh path derives it from
             # the mesh instead); off-TPU the kernel runs in the Mosaic
             # TPU simulator, on the chip it lowers through Mosaic.
-            return [pallas_argmin(
-                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-                rem=plan.rem, k=plan.k, total=self.batch * nbatches,
-                platform=self._platform(), hoist=plan.hoist_ops)
-                for i0, nbatches in subs]
-        return [search_span(
-            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-            np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-            plan.hoist_ops,
-            rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches)
-            for i0, nbatches in subs]
+            for i0, nbatches in subs:
+                with _observe_launch(("pallas_argmin", plan.rem, plan.k,
+                                      self.batch, nbatches)):
+                    out.append(pallas_argmin(
+                        np.asarray(plan.midstate, dtype=np.uint32),
+                        plan.template,
+                        np.uint32(i0), np.uint32(plan.lo_i),
+                        np.uint32(plan.hi_i),
+                        rem=plan.rem, k=plan.k,
+                        total=self.batch * nbatches,
+                        platform=self._platform(), hoist=plan.hoist_ops))
+            return out
+        for i0, nbatches in subs:
+            with _observe_launch(("search_span", plan.rem, plan.k,
+                                  self.batch, nbatches)):
+                out.append(search_span(
+                    np.asarray(plan.midstate, dtype=np.uint32),
+                    plan.template,
+                    np.uint32(i0), np.uint32(plan.lo_i),
+                    np.uint32(plan.hi_i),
+                    plan.hoist_ops,
+                    rem=plan.rem, k=plan.k, batch=self.batch,
+                    nbatches=nbatches))
+        return out
 
     def dispatch(self, lower: int, upper: int) -> list:
         """Dispatch every block of the range WITHOUT forcing results.
@@ -465,14 +485,19 @@ class NonceSearcher:
         _MET_BATCH_ROWS.inc(n)
         if self.tier == "pallas":
             from ..ops.sha256_pallas import pallas_segmin
-            triple = pallas_segmin(
-                midstates, templates, i0s, lo_is, hi_is, seg,
-                rem=rem, k=k, total=self.batch * nbatches, nrows=nrows,
-                platform=self._platform(), hoists=hoists)
+            with _observe_launch(("pallas_segmin", rem, k, self.batch,
+                                  nbatches, nrows)):
+                triple = pallas_segmin(
+                    midstates, templates, i0s, lo_is, hi_is, seg,
+                    rem=rem, k=k, total=self.batch * nbatches,
+                    nrows=nrows, platform=self._platform(),
+                    hoists=hoists)
         else:
-            triple = search_span_segmin(
-                midstates, templates, i0s, lo_is, hi_is, seg, hoists,
-                rem=rem, k=k, batch=self.batch, nbatches=nbatches)
+            with _observe_launch(("search_span_segmin", rem, k, self.batch,
+                                  nbatches, nrows)):
+                triple = search_span_segmin(
+                    midstates, templates, i0s, lo_is, hi_is, seg, hoists,
+                    rem=rem, k=k, batch=self.batch, nbatches=nbatches)
         return seg_meta, triple
 
     def finalize_batch(self, handle) -> list:
@@ -539,21 +564,27 @@ class NonceSearcher:
                 # Lowering/compile failures surface at the call; runtime
                 # kernel faults surface at the force — _until_force
                 # catches those (same degradation either way).
-                return ("pallas", pallas_until(
-                    np.asarray(plan.midstate, dtype=np.uint32),
-                    plan.template,
-                    np.uint32(i0), np.uint32(plan.lo_i),
-                    np.uint32(plan.hi_i),
-                    np.uint32(t_hi), np.uint32(t_lo),
-                    rem=plan.rem, k=plan.k, total=self.batch * nbatches,
-                    platform=self._platform(), hoist=plan.hoist_ops))
+                with _observe_launch(("pallas_until", plan.rem, plan.k,
+                                      self.batch, nbatches)):
+                    return ("pallas", pallas_until(
+                        np.asarray(plan.midstate, dtype=np.uint32),
+                        plan.template,
+                        np.uint32(i0), np.uint32(plan.lo_i),
+                        np.uint32(plan.hi_i),
+                        np.uint32(t_hi), np.uint32(t_lo),
+                        rem=plan.rem, k=plan.k,
+                        total=self.batch * nbatches,
+                        platform=self._platform(), hoist=plan.hoist_ops))
             except Exception:
                 self._degrade_until()
-        return ("jnp", search_span_until(
-            np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-            np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
-            np.uint32(t_hi), np.uint32(t_lo), plan.hoist_ops,
-            rem=plan.rem, k=plan.k, batch=self.batch, nbatches=nbatches))
+        with _observe_launch(("search_span_until", plan.rem, plan.k,
+                              self.batch, nbatches)):
+            return ("jnp", search_span_until(
+                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
+                np.uint32(i0), np.uint32(plan.lo_i), np.uint32(plan.hi_i),
+                np.uint32(t_hi), np.uint32(t_lo), plan.hoist_ops,
+                rem=plan.rem, k=plan.k, batch=self.batch,
+                nbatches=nbatches))
 
     def _until_force(self, plan: _BlockPlan, i0: int, nbatches: int,
                      t_hi: int, t_lo: int, handle):

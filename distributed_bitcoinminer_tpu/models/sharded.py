@@ -14,6 +14,7 @@ import numpy as np
 from ..parallel.mesh_search import (device_spans, make_mesh,
                                     sharded_search_span,
                                     sharded_search_span_until)
+from ..utils.trace import observe_launch as _observe_launch
 from .miner_model import NonceSearcher
 
 
@@ -46,11 +47,15 @@ class ShardedNonceSearcher(NonceSearcher):
         out = []
         for i0, nbatches in self._sub_dispatches(plan):
             i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
-            out.append(sharded_search_span(
-                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                i0_d, plan.lo_i, plan.hi_i, plan.hoist_ops,
-                mesh=self.mesh, rem=plan.rem, k=plan.k,
-                batch=self.batch, nbatches=nbatches, tier=self.tier))
+            with _observe_launch(("sharded_search_span", self.tier,
+                                  plan.rem, plan.k, self.batch, nbatches,
+                                  self.n_devices)):
+                out.append(sharded_search_span(
+                    np.asarray(plan.midstate, dtype=np.uint32),
+                    plan.template,
+                    i0_d, plan.lo_i, plan.hi_i, plan.hoist_ops,
+                    mesh=self.mesh, rem=plan.rem, k=plan.k,
+                    batch=self.batch, nbatches=nbatches, tier=self.tier))
         return out
 
     def _sub_dispatches(self, plan, per_step=None):
@@ -74,11 +79,16 @@ class ShardedNonceSearcher(NonceSearcher):
         i0_d = device_spans(i0, self.n_devices, self.batch, nbatches)
         tier = "jnp" if self._until_degraded else self.tier
         try:
-            return (tier, sharded_search_span_until(
-                np.asarray(plan.midstate, dtype=np.uint32), plan.template,
-                i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo, plan.hoist_ops,
-                mesh=self.mesh, rem=plan.rem, k=plan.k,
-                batch=self.batch, nbatches=nbatches, tier=tier))
+            with _observe_launch(("sharded_search_span_until", tier,
+                                  plan.rem, plan.k, self.batch, nbatches,
+                                  self.n_devices)):
+                return (tier, sharded_search_span_until(
+                    np.asarray(plan.midstate, dtype=np.uint32),
+                    plan.template,
+                    i0_d, plan.lo_i, plan.hi_i, t_hi, t_lo,
+                    plan.hoist_ops,
+                    mesh=self.mesh, rem=plan.rem, k=plan.k,
+                    batch=self.batch, nbatches=nbatches, tier=tier))
         except Exception:
             if tier != "pallas":
                 raise

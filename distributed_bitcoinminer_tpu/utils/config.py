@@ -190,8 +190,34 @@ Environment variables:
 - ``DBM_PEEL`` (default 0): pallas-tier peeled-compression kernel
   variant (ops/sha256_pallas.peel_enabled; chip-gated rollout — see
   scripts/chip_chain.py).
-- ``DBM_TRACE``: directory for a JAX profiler trace of one timed
-  search (bench.py; unset = no trace).
+- ``DBM_TRACE`` (default 1; 0 disables): the cross-process tracing
+  plane (utils/trace.py, ISSUE 10). With it on, the miner records one
+  span per served chunk (reader-queue wait, dispatch, pipeline wait,
+  force, bubble gap, shared coalesced-launch id) and ships it back on
+  the Result's ``Span`` wire extension; the scheduler stitches spans
+  into the request's trace (``miner_span`` events naming the dominant
+  phase), keeps per-miner/per-tenant export tracks, and
+  ``Scheduler.export_trace()`` / ``scripts/dbmtrace.py`` emit
+  Perfetto-loadable Chrome trace JSON. The model layer's compile
+  observer and both processes' flight recorders ride the same knob.
+  ``DBM_TRACE=0`` reproduces stock behavior bit-for-bit: no Span
+  bytes on the wire, no span events, every hook one boolean check.
+- ``DBM_TRACE_FLIGHT``: flight-recorder ring capacity (default 512;
+  0 disables) — a bounded ring of control-plane events in scheduler
+  AND miner processes, dumped as one JSON line on queue-age /
+  in-flight alarms, sanitizer warnings, recompile storms, and
+  unhandled-exception exit (utils/trace.FlightRecorder).
+- ``DBM_TRACE_STORM_N`` / ``DBM_TRACE_STORM_S``: recompile-storm alarm
+  bound of the jit-compile observer (default 12 fresh signatures within
+  30 seconds — above a cold process's legitimate warmup burst, far
+  below a per-request churn): the dynamic complement of the ``jit-static`` dbmlint
+  analyzer — a runtime-derived value reaching a static jit boundary
+  shows up as a burst of fresh compile signatures, warned once per
+  episode with a flight-recorder dump (utils/trace.CompileObserver).
+- ``DBM_TRACE_XPROF``: directory for a JAX device-profiler (XProf)
+  trace of one timed search per tier (bench.py via
+  utils/profiling.device_trace; unset = no capture). Orthogonal to
+  ``DBM_TRACE``: this captures kernels, that captures requests.
 - ``DBM_BENCH_INIT_TIMEOUT``: deadline in seconds for the bench /
   chip-script backend probe subprocess (default 300).
 - ``DBM_BENCH_REM_SWEEP`` (default 0): bench.py's opt-in rem-sweep

@@ -469,6 +469,12 @@ class RequestTrace:
             return any(e["event"] in ("reply", "cancel")
                        for e in reversed(self.events))
 
+    @property
+    def t0(self) -> float:
+        """Monotonic birth stamp (event ``t`` values are relative to it;
+        the Perfetto exporter uses it to place traces on one timeline)."""
+        return self._t0
+
     def to_dict(self) -> dict:
         """JSON-native dump (the queue-age alarm logs this wholesale)."""
         with self._lock:

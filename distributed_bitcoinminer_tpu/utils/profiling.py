@@ -3,18 +3,31 @@
 The reference has no profiler (SURVEY §5); the TPU build adds two:
 ``Timer`` for host-side rate meters (nonces/sec — the BASELINE metric) and
 ``device_trace`` wrapping ``jax.profiler.trace`` so a search can be captured
-for TensorBoard/XProf without touching call sites.
+for TensorBoard/XProf without touching call sites. The XProf logdir knob is
+``DBM_TRACE_XPROF`` (ISSUE 10 satellite; ``DBM_TRACE`` itself now switches
+the request-scoped tracing plane, utils/trace.py — the two are orthogonal:
+this one captures kernels, that one captures requests).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Iterator, Optional
 
+from ._env import str_env as _str_env
+
 
 class Timer:
-    """Wall-clock meter: ``with Timer() as t: ...; t.rate(n)``."""
+    """Wall-clock meter: ``with Timer() as t: ...; t.rate(n)``.
+
+    Tolerates misuse before ``__enter__`` (ISSUE 10 satellite): an
+    un-entered timer reads 0.0 seconds and 0.0 rate instead of raising
+    ``TypeError`` from ``None - float`` — a profiling helper must never
+    be the thing that kills a measurement path (the bench's exception
+    envelope would record the TypeError as the tier's failure).
+    """
 
     def __init__(self) -> None:
         self.seconds = 0.0
@@ -25,6 +38,8 @@ class Timer:
         return self
 
     def __exit__(self, *exc) -> None:
+        if self._t0 is None:
+            return          # never entered: stay at 0.0, don't raise
         self.seconds = time.perf_counter() - self._t0
 
     def rate(self, items: int) -> float:
@@ -32,9 +47,28 @@ class Timer:
         return items / self.seconds if self.seconds else 0.0
 
 
+def xprof_dir(tier: Optional[str] = None) -> Optional[str]:
+    """The configured XProf capture directory (``DBM_TRACE_XPROF``;
+    None/empty = capture disabled), with an optional per-tier subdir —
+    the one place the knob is read, so the knob-hygiene lint covers it
+    and every call site composes paths the same way."""
+    base = _str_env("DBM_TRACE_XPROF")
+    if not base:
+        return None
+    return os.path.join(base, tier) if tier else base
+
+
 @contextlib.contextmanager
-def device_trace(logdir: Optional[str]) -> Iterator[None]:
-    """Capture a JAX profiler trace into ``logdir`` (no-op when None)."""
+def device_trace(logdir: Optional[str] = None,
+                 tier: Optional[str] = None) -> Iterator[None]:
+    """Capture a JAX profiler trace into ``logdir`` (no-op when None).
+
+    ``logdir=None`` reads ``DBM_TRACE_XPROF`` via :func:`xprof_dir`
+    (with the optional ``tier`` subdir), so call sites need no knob
+    plumbing of their own; an explicit ``logdir`` wins.
+    """
+    if logdir is None:
+        logdir = xprof_dir(tier)
     if not logdir:
         yield
         return

@@ -45,6 +45,20 @@ from .metrics import registry as _registry
 _log = logging.getLogger("dbm.sanitize")
 
 
+def _flight_dump(event: str, **detail) -> None:
+    """Mirror a sanitizer warning into the flight recorder and dump the
+    ring (ISSUE 10): a loop stall or ownership violation is exactly the
+    moment the surrounding control-plane event window matters. Imported
+    lazily (trace -> metrics -> _env is the import chain; sanitize sits
+    beside trace, not under it) and guarded by the trace plane's own
+    knob — a sanitized-but-untraced run keeps stock behavior."""
+    from . import trace as _trace
+    if not _trace.enabled():
+        return
+    _trace.flight(event, **detail)
+    _trace.flight_dump(f"sanitizer: {event}")
+
+
 def enabled() -> bool:
     """True when the sanitizer plane is switched on (``DBM_SANITIZE=1``).
 
@@ -123,11 +137,14 @@ def install_watchdog(threshold_s: Optional[float] = None) -> None:
                     slow.inc()
                     if dt > worst.value:
                         worst.set(dt)
+                    who = _describe_callback(self)
                     _log.warning(
                         "event-loop stall: %s held the loop %.3fs "
                         "(bound %.3fs) — move the blocking work to a "
                         "worker thread (asyncio.to_thread)",
-                        _describe_callback(self), dt, _threshold_s)
+                        who, dt, _threshold_s)
+                    _flight_dump("slow_callback", callback=who,
+                                 held_s=round(dt, 4))
 
         asyncio.events.Handle._run = _timed_run
 
@@ -186,6 +203,8 @@ class ThreadOwner:
             "thread-ownership violation: %s touched from thread %r "
             "(owner: %r)", self.what, threading.current_thread().name,
             self._name)
+        _flight_dump("ownership_violation", what=self.what,
+                     thread=threading.current_thread().name)
         return False
 
 
@@ -205,4 +224,5 @@ def assert_off_loop(what: str) -> bool:
     _log.warning(
         "%s ran ON the event loop; expected a worker thread "
         "(asyncio.to_thread)", what)
+    _flight_dump("loop_blocking", what=what)
     return False

@@ -1,0 +1,550 @@
+"""Cross-process request tracing + device-timing flight recorder (ISSUE 10).
+
+PR 3's request traces stop at the scheduler's LSP boundary: once a chunk
+is granted, the miner's pipeline wait, coalesced-batch membership, device
+dispatch/force latency, and jit recompiles are invisible per request.
+This module is the shared substrate of the end-to-end plane; the apps
+wire it up:
+
+- **Chunk spans** (apps/miner.py): the miner records one span per served
+  chunk — reader-queue wait, dispatch enqueue, pipeline wait, force/
+  finalize, inter-chunk bubble gap, and (for coalesced batches) the
+  shared-launch id + lane count — and ships it back PIGGYBACKED on the
+  Result as a ``Span`` wire extension (bitcoin/message.py; appended only
+  when tracing is on, so ``DBM_TRACE=0`` keeps stock bytes bit-for-bit;
+  a stock Go endpoint drops the unknown key). Span context needs no new
+  identifiers: LSP is in-order exactly-once, so the k-th Result from a
+  miner answers the k-th pending chunk — the scheduler's existing
+  ``(job_id, chunk idx)`` FIFO pop machinery IS the stitch key.
+- **Stitching** (apps/scheduler.py): ``_on_result`` folds the span into
+  the request's existing :class:`~.metrics.RequestTrace` as a
+  ``miner_span`` event (same TraceBuffer/cardinality discipline as
+  PR 3), naming the DOMINANT phase so a stalled request's dump reads
+  "the force stalled on miner 7", not a pile of floats.
+- **Jit-compile observer** (:class:`CompileObserver`, hooked at the
+  model layer's launch sites): every device launch carries a static
+  SIGNATURE (entry, rem, k, batch, nbatches, ...) — the same tuple the
+  ``jit-static`` dbmlint analyzer guards statically. The first launch of
+  a fresh signature is (trace +) compile; its elapsed is recorded
+  per-signature, and a burst of NEW signatures inside a short window —
+  the recompile storm an unquantized runtime scalar causes — fires a
+  structured alarm (``trace.recompile_storms``) plus a flight-recorder
+  dump. The dynamic complement to the static lint.
+- **Flight recorder** (:class:`FlightRecorder`): a bounded ring of
+  control-plane events in BOTH processes (scheduler grant/assign/alarm
+  edges, miner chunk lifecycle), dumped as one JSON line on queue-age /
+  in-flight alarms, sanitizer warnings, and unhandled-exception exit —
+  post-mortem for the chaos failures dbmcheck's deterministic scenarios
+  cannot reach in real nondeterministic runs.
+- **Perfetto export** (:func:`to_chrome_trace`, ``Scheduler.
+  export_trace``, ``scripts/dbmtrace.py``): stitched traces render as
+  Chrome trace-event JSON — one track per process/miner/tenant, spans as
+  complete (``X``) slices and lease blows/sheds/re-issues as instant
+  events — loadable in ui.perfetto.dev / chrome://tracing.
+
+Knobs (all routed through utils/_env; catalog in utils/config.py):
+``DBM_TRACE`` (default 1; 0 restores stock behavior bit-for-bit),
+``DBM_TRACE_FLIGHT`` (ring capacity; 0 disables the recorder),
+``DBM_TRACE_STORM_N`` / ``DBM_TRACE_STORM_S`` (storm alarm: N fresh
+compile signatures within S seconds). ``DBM_TRACE_XPROF`` (the XProf
+logdir, utils/profiling.py) selects the ORTHOGONAL JAX device profiler;
+this plane is request-scoped, that one is kernel-scoped.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from ._env import float_env as _float_env, int_env as _int_env
+from .metrics import registry as _registry
+
+_log = logging.getLogger("dbm.trace")
+
+#: Span phase keys a miner-side chunk span may carry, in pipeline order.
+#: Everything is seconds; ``launch``/``lanes`` (shared coalesced launch),
+#: ``compiles`` (fresh jit signatures compiled during this chunk's
+#: dispatch) and ``serial`` (blocking-path chunk) are the non-phase
+#: extras. The wire dict draws from exactly these keys — a fixed
+#: vocabulary so the exporter and the golden-format test can pin keys.
+SPAN_PHASES = ("queue_s", "dispatch_s", "wait_s", "force_s", "gap_s")
+SPAN_EXTRAS = ("launch", "lanes", "compiles", "serial")
+
+
+def enabled() -> bool:
+    """True when the tracing plane is on (``DBM_TRACE``, default 1).
+
+    Read per call (not cached at import) so tests and embedded drivers
+    can toggle the knob around individual constructions — the same
+    contract as ``sanitize.enabled``. With it off, every hook in the
+    apps reduces to this one boolean check: no span dicts, no wire
+    extension, no flight events, no observer bookkeeping.
+    """
+    return _int_env("DBM_TRACE", 1) != 0
+
+
+def slow_phase(span: dict) -> Optional[str]:
+    """The dominant PHASE of a span dict (None when empty/malformed) —
+    what a stalled chunk was actually doing, named without the ``_s``
+    suffix (``force``, ``queue``, ...) to match the exported slice
+    names. The stitched ``miner_span`` event carries it so a wedged
+    request's trace dump names the phase, not just the miner."""
+    best, best_v = None, 0.0
+    for key in SPAN_PHASES:
+        v = span.get(key)
+        if isinstance(v, (int, float)) and v > best_v:
+            best, best_v = key[:-2], float(v)
+    return best
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+class FlightRecorder:
+    """Bounded ring of control-plane events, dumped on demand.
+
+    ``record()`` is one deque append under a lock — cheap enough to ride
+    every grant/assign/result edge. ``dump(why)`` logs the whole ring as
+    ONE structured JSON line through ``dbm.trace`` (the same sink the
+    metrics emitter uses) and counts in ``trace.flight_dumps``; the ring
+    keeps accumulating afterwards (a second alarm dumps the newer
+    window). ``cap=0`` disables: record() is a no-op returning
+    immediately.
+    """
+
+    def __init__(self, cap: Optional[int] = None):
+        self.cap = cap if cap is not None else _int_env(
+            "DBM_TRACE_FLIGHT", 512)
+        self._d: deque = deque(maxlen=max(1, self.cap))
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._dumps = _registry().counter("trace.flight_dumps")
+
+    def record(self, event: str, **detail) -> None:
+        if self.cap <= 0:
+            return
+        ev = {"t": round(time.monotonic() - self._t0, 6), "event": event}
+        if detail:
+            ev.update(detail)
+        with self._lock:
+            self._d.append(ev)
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._d)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def dump(self, why: str) -> None:
+        """One JSON line with the whole ring (oldest first)."""
+        if self.cap <= 0:
+            return
+        self._dumps.inc()
+        _log.warning("flight recorder dump (%s): %s", why, json.dumps(
+            {"why": why, "events": self.events()}, sort_keys=True,
+            default=str))
+
+
+_flight: Optional[FlightRecorder] = None
+_flight_lock = threading.Lock()
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process flight recorder (constructed on first use)."""
+    global _flight
+    with _flight_lock:
+        if _flight is None:
+            _flight = FlightRecorder()
+        return _flight
+
+
+def flight(event: str, **detail) -> None:
+    """Record one control-plane event into the process ring (no-op when
+    the plane or the ring is off — one boolean check)."""
+    if not enabled():
+        return
+    flight_recorder().record(event, **detail)
+
+
+def flight_dump(why: str) -> None:
+    """Dump the process ring (no-op when the plane or ring is off)."""
+    if not enabled():
+        return
+    flight_recorder().dump(why)
+
+
+_excepthook_installed = False
+
+
+def _install_excepthook() -> None:
+    """Chain-wrap ``sys.excepthook`` so an unhandled-exception exit dumps
+    the flight recorder first — the post-mortem window for the crash
+    shapes chaos testing cannot reproduce deterministically. Idempotent;
+    never installed when the plane is off at ensure time."""
+    global _excepthook_installed
+    if _excepthook_installed:
+        return
+    _excepthook_installed = True
+    prev = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            flight_recorder().record("unhandled_exception",
+                                     exception=repr(exc)[:200])
+            flight_recorder().dump("unhandled-exception exit")
+        except Exception:   # noqa: BLE001 — never mask the real crash
+            pass
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+
+
+def ensure_tracer() -> bool:
+    """Arm the process-level pieces iff ``DBM_TRACE=1``; returns enabled().
+
+    Scheduler and miner call this at construction (the ensure_emitter /
+    ensure_sanitizer shape): one knob arms the flight recorder's
+    crash-exit dump and the compile observer in every endpoint with no
+    call-site changes.
+    """
+    if not enabled():
+        return False
+    flight_recorder()
+    _install_excepthook()
+    return True
+
+
+# ---------------------------------------------------------- compile observer
+
+
+class CompileObserver:
+    """Per-signature device-launch/compile bookkeeping + storm alarm.
+
+    ``launch(sig)`` is called (via :func:`observe_launch`) around every
+    jitted device dispatch at the model layer with the launch's STATIC
+    signature tuple. A signature's first launch pays jit trace+compile
+    on the calling thread, so its elapsed is the compile estimate; later
+    launches only count. ``storm_n`` fresh signatures within
+    ``storm_s`` seconds is a RECOMPILE STORM — the dynamic symptom of a
+    runtime-derived scalar reaching a static boundary (the bug class the
+    ``jit-static`` dbmlint analyzer catches in source) — and fires a
+    structured warning + ``trace.recompile_storms`` + a flight dump,
+    once per storm episode (re-armed once the window drains).
+    """
+
+    def __init__(self, storm_n: Optional[int] = None,
+                 storm_s: Optional[float] = None):
+        # Default 12: a COLD process legitimately warms ~8 fresh
+        # signatures (digit classes x pow2 subs + the batch-width
+        # buckets) in its first seconds — the bound must clear that
+        # startup burst, while a true unquantized churn mints a fresh
+        # signature per REQUEST and blows past any constant.
+        self.storm_n = storm_n if storm_n is not None else _int_env(
+            "DBM_TRACE_STORM_N", 12)
+        self.storm_s = storm_s if storm_s is not None else _float_env(
+            "DBM_TRACE_STORM_S", 30.0)
+        self._lock = threading.Lock()
+        self.sigs: Dict[tuple, dict] = {}      # sig -> {n, compile_s}
+        self._fresh: deque = deque()           # monotonic stamps of new sigs
+        self._storming = False
+        self._compiles = _registry().counter("trace.jit_compiles")
+        self._launches = _registry().counter("trace.observed_launches")
+        self._storms = _registry().counter("trace.recompile_storms")
+        self._worst = _registry().gauge("trace.jit_compile_worst_s")
+
+    def launch(self, sig: tuple, seconds: float) -> Optional[float]:
+        """Record one launch of ``sig`` that took ``seconds`` on the
+        dispatching thread. Returns the compile estimate when this was
+        the signature's FIRST launch (the span records it), else None."""
+        now = time.monotonic()
+        storm = None
+        with self._lock:
+            self._launches.inc()
+            rec = self.sigs.get(sig)
+            if rec is not None:
+                rec["n"] += 1
+                return None
+            self.sigs[sig] = {"n": 1, "compile_s": seconds}
+            self._compiles.inc()
+            if seconds > self._worst.value:
+                self._worst.set(seconds)
+            self._fresh.append(now)
+            while self._fresh and now - self._fresh[0] > self.storm_s:
+                self._fresh.popleft()
+            if len(self._fresh) >= self.storm_n:
+                if not self._storming:
+                    self._storming = True
+                    self._storms.inc()
+                    storm = len(self._fresh)
+            else:
+                self._storming = False
+        if storm is not None:
+            _log.warning(
+                "recompile storm: %d fresh jit signatures within %.0fs "
+                "(bound %d) — a runtime-derived value is reaching a "
+                "static jit boundary (latest: %r); expect multi-second "
+                "stalls per launch until the signature set stabilizes",
+                storm, self.storm_s, self.storm_n, sig)
+            flight("recompile_storm", fresh=storm, sig=repr(sig)[:120])
+            flight_dump("recompile storm")
+        return seconds
+
+    def snapshot(self) -> dict:
+        """JSON-native per-signature view (ordered by compile cost)."""
+        with self._lock:
+            items = [(repr(sig), dict(rec))
+                     for sig, rec in self.sigs.items()]
+        items.sort(key=lambda kv: -kv[1].get("compile_s", 0.0))
+        return {sig: {"n": rec["n"],
+                      "compile_s": round(rec["compile_s"], 6)}
+                for sig, rec in items}
+
+
+_observer: Optional[CompileObserver] = None
+_observer_lock = threading.Lock()
+
+
+def compile_observer() -> CompileObserver:
+    """The process compile observer (constructed on first use)."""
+    global _observer
+    with _observer_lock:
+        if _observer is None:
+            _observer = CompileObserver()
+        return _observer
+
+
+class observe_launch:
+    """Context manager the model layer wraps each jitted dispatch in:
+
+        with observe_launch(("search_span", rem, k, batch, nbatches)) as ob:
+            triple = search_span(...)
+        # ob.compile_s is set when this launch compiled a fresh signature
+
+    With the plane off this is one boolean check and no bookkeeping.
+    """
+
+    __slots__ = ("sig", "compile_s", "_t0", "_on")
+
+    def __init__(self, sig: tuple):
+        self.sig = sig
+        self.compile_s: Optional[float] = None
+        self._on = enabled()
+        self._t0 = 0.0
+
+    def __enter__(self) -> "observe_launch":
+        if self._on:
+            self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if self._on and exc_type is None:
+            self.compile_s = compile_observer().launch(
+                self.sig, time.monotonic() - self._t0)
+
+
+# ----------------------------------------------------------------- trackset
+
+
+class TrackSet:
+    """Export-track registry under the metrics cardinality discipline.
+
+    The Perfetto export draws one track per miner and per tenant; track
+    identity is a labeled name exactly like a metric series, and the
+    same failure mode applies — conn churn minting a track per dead conn
+    id grows the export without bound. Tracks therefore live behind the
+    ``DBM_METRICS_MAX_SERIES`` bound (overflow collapses into one
+    ``{overflow=true}`` track) and MUST be retired where the entity dies
+    (miner drop, tenant GC) — the ``cardinality`` dbmlint analyzer
+    checks ``.track()`` sites for a same-module ``.retire()`` path, the
+    same rule it applies to labeled metric series.
+    """
+
+    _OVERFLOW: Tuple[Tuple[str, str], ...] = (("overflow", "true"),)
+
+    def __init__(self, max_tracks: Optional[int] = None):
+        self.max_tracks = (max_tracks if max_tracks is not None
+                           else _int_env("DBM_METRICS_MAX_SERIES", 64))
+        self._lock = threading.Lock()
+        self._d: Dict[str, Dict[tuple, int]] = {}
+        self._next_tid = 0
+        self._overflows = 0
+
+    @staticmethod
+    def _key(labels: dict) -> tuple:
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def track(self, name: str, **labels) -> int:
+        """Stable integer track id for one labeled entity (registers on
+        first sight; collapses to the overflow track past the bound)."""
+        key = self._key(labels)
+        with self._lock:
+            family = self._d.setdefault(name, {})
+            tid = family.get(key)
+            if tid is None:
+                if key and len(family) >= self.max_tracks \
+                        and key != self._OVERFLOW:
+                    self._overflows += 1
+                    key = self._OVERFLOW
+                    tid = family.get(key)
+                if tid is None:
+                    self._next_tid += 1
+                    tid = family[key] = self._next_tid
+            return tid
+
+    def retire(self, name: str, **labels) -> None:
+        """Free one entity's track slot (no-op when absent) — the
+        miner-drop / tenant-GC path, mirroring ``Registry.remove``."""
+        with self._lock:
+            family = self._d.get(name)
+            if family is not None:
+                family.pop(self._key(labels), None)
+
+    def items(self, name: str) -> list:
+        """``[(labels_tuple, tid), ...]`` of one family's live tracks."""
+        with self._lock:
+            return list(self._d.get(name, {}).items())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(f) for f in self._d.values())
+
+
+# ------------------------------------------------------------- chrome export
+
+#: Scheduler-side request events drawn as INSTANT markers on the owning
+#: tenant's track (everything else is a slice or span detail).
+_INSTANT_EVENTS = ("lease_blown", "reissue", "quarantine", "park",
+                   "queue_alarm", "inflight_alarm", "miner_drop",
+                   "stale_result", "cache_hit")
+
+#: Fixed synthetic pids: one "process" per role. Miners get
+#: ``_PID_MINERS`` with one thread per miner conn; tenants ride the
+#: scheduler process with one thread per tenant.
+_PID_SCHED = 1
+_PID_MINERS = 2
+
+
+def _span_events(trace_dict: dict, base_us: int, t0_us: int,
+                 tenant_tid: int, miner_tids: dict) -> list:
+    """Chrome events for ONE stitched RequestTrace dict.
+
+    The scheduler timeline anchors everything: request-level slices
+    (queued, in-flight) land on the tenant's track; each ``miner_span``
+    is laid out BACKWARDS from its fold stamp on the owning miner's
+    track (miner clocks are a different process's monotonic — the span
+    ships durations, the scheduler stamp places them)."""
+    events = trace_dict.get("events", [])
+    meta = trace_dict.get("meta", {})
+    key = trace_dict.get("key")
+    out = []
+
+    def at(ev) -> int:
+        return t0_us + int(ev["t"] * 1e6) - base_us
+
+    by_name: dict = {}
+    for ev in events:
+        by_name.setdefault(ev["event"], []).append(ev)
+    enq = by_name.get("enqueue", [None])[0]
+    disp = by_name.get("dispatch", [None])[0]
+    done = (by_name.get("reply", []) or by_name.get("cancel", [None]))[0]
+    args = {"key": str(key), "range": [meta.get("lower"),
+                                       meta.get("upper")]}
+    if meta.get("target"):
+        args["target"] = meta["target"]
+    if enq is not None and disp is not None:
+        out.append({"name": "queued", "ph": "X", "pid": _PID_SCHED,
+                    "tid": tenant_tid, "ts": at(enq),
+                    "dur": max(0, at(disp) - at(enq)), "args": args})
+    start = disp if disp is not None else enq
+    if start is not None and done is not None:
+        out.append({"name": f"request {key}", "ph": "X",
+                    "pid": _PID_SCHED, "tid": tenant_tid, "ts": at(start),
+                    "dur": max(0, at(done) - at(start)), "args": args})
+    for name in _INSTANT_EVENTS:
+        for ev in by_name.get(name, []):
+            out.append({"name": name, "ph": "i", "s": "t",
+                        "pid": _PID_SCHED, "tid": tenant_tid,
+                        "ts": at(ev),
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("t", "event")}})
+    for ev in by_name.get("miner_span", []):
+        miner = str(ev.get("miner"))
+        tid = miner_tids.get(miner)
+        if tid is None:
+            tid = miner_tids[miner] = \
+                max(miner_tids.values(), default=0) + 1
+        total_us = sum(int(float(ev.get(k, 0.0) or 0.0) * 1e6)
+                       for k in SPAN_PHASES)
+        ts = at(ev) - total_us
+        sargs = {"job": str(key), "idx": ev.get("idx")}
+        if ev.get("launch") is not None:
+            sargs["launch"] = ev["launch"]
+            sargs["lanes"] = ev.get("lanes")
+        if ev.get("slow"):
+            sargs["slow"] = ev["slow"]
+        # Layout order differs from the vocabulary order: gap_s is the
+        # executor's idle time BEFORE this chunk, so it renders FIRST —
+        # ending the chain at force so the force slice abuts the fold
+        # stamp (rendering gap last would displace force earlier and
+        # draw a phantom post-force stall — code review).
+        for phase in ("gap_s",) + tuple(k for k in SPAN_PHASES
+                                        if k != "gap_s"):
+            dur = int(float(ev.get(phase, 0.0) or 0.0) * 1e6)
+            if dur <= 0:
+                continue
+            out.append({"name": phase[:-2], "ph": "X", "pid": _PID_MINERS,
+                        "tid": tid, "ts": ts, "dur": dur, "args": sargs})
+            ts += dur
+    return out
+
+
+def to_chrome_trace(trace_dicts: list, tenant_tracks: Optional[dict] = None,
+                    miner_tracks: Optional[dict] = None) -> dict:
+    """Chrome trace-event JSON (Perfetto-loadable) from stitched trace
+    dicts (``RequestTrace.to_dict()`` shape, plus an optional ``t0``
+    monotonic stamp — absent t0s are laid out in list order).
+
+    ``tenant_tracks`` / ``miner_tracks`` map entity id strings to track
+    ids (the scheduler passes its :class:`TrackSet` view); unknown
+    entities get tracks appended after the known ones. Events are sorted
+    by (pid, tid, ts) so every track's timeline is monotonic — the
+    golden-format contract tests/test_trace.py pins.
+    """
+    tenant_tids = dict(tenant_tracks or {})
+    miner_tids = dict(miner_tracks or {})
+    t0s = [d.get("t0") for d in trace_dicts]
+    known = [t for t in t0s if isinstance(t, (int, float))]
+    base = min(known) if known else 0.0
+    base_us = int(base * 1e6)
+    events: list = []
+    for i, d in enumerate(trace_dicts):
+        t0 = d.get("t0")
+        t0_us = int(t0 * 1e6) if isinstance(t0, (int, float)) \
+            else base_us + i
+        tenant = str(d.get("meta", {}).get("client"))
+        tid = tenant_tids.get(tenant)
+        if tid is None:
+            tid = tenant_tids[tenant] = \
+                max(tenant_tids.values(), default=0) + 1
+        events.extend(_span_events(d, base_us, t0_us, tid, miner_tids))
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": _PID_SCHED, "tid": 0,
+         "args": {"name": "scheduler"}},
+        {"name": "process_name", "ph": "M", "pid": _PID_MINERS, "tid": 0,
+         "args": {"name": "miners"}},
+    ]
+    for tenant, tid in sorted(tenant_tids.items(), key=lambda kv: kv[1]):
+        meta.append({"name": "thread_name", "ph": "M", "pid": _PID_SCHED,
+                     "tid": tid, "args": {"name": f"tenant {tenant}"}})
+    for miner, tid in sorted(miner_tids.items(), key=lambda kv: kv[1]):
+        meta.append({"name": "thread_name", "ph": "M", "pid": _PID_MINERS,
+                     "tid": tid, "args": {"name": f"miner {miner}"}})
+    events.sort(key=lambda e: (e["pid"], e["tid"], e["ts"],
+                               -e.get("dur", 0)))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""dbmtrace — Perfetto export CLI for the cross-process tracing plane.
+
+Two modes (ISSUE 10):
+
+``python scripts/dbmtrace.py convert DUMP... -o trace.json``
+    Convert dumped request traces to Chrome trace-event JSON. Inputs are
+    files of JSON lines: raw ``RequestTrace.to_dict()`` objects, or log
+    lines containing a ``trace dump (...): {...}`` payload (the
+    queue-age alarm's output — paste a log file straight in). The output
+    loads in ui.perfetto.dev or chrome://tracing: one track per
+    process/miner/tenant, request slices, instant fault events, and the
+    stitched miner-side phase spans.
+
+``python scripts/dbmtrace.py demo -o trace.json``
+    Run the acceptance scenario in-process — a mixed-load storm
+    (one elephant + a wave of mice, coalescing on, one wedged miner)
+    over real localhost LSP — and export the scheduler's stitched
+    traces. The printed summary shows a mouse request decomposing into
+    scheduler queue -> grant -> miner queue -> shared coalesced launch
+    -> force -> reply (shared-launch id visible) and the wedged miner's
+    stall attributed to its phase.
+
+No new knobs: the demo forces ``DBM_TRACE=1`` semantics by constructing
+its own endpoints in-process with tracing on (run it with ``DBM_TRACE=0``
+exported and it refuses — there would be nothing to export).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from distributed_bitcoinminer_tpu.utils import trace as tracing  # noqa: E402
+
+_DUMP_MARK = "trace dump ("
+
+
+def _iter_trace_dicts(path: str):
+    """Trace dicts from one file of JSON lines or log lines."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            if _DUMP_MARK in line:
+                # Log line: the payload is the JSON object suffix. A
+                # truncated/wrapped line (log rotation mid-write) has no
+                # payload separator — skip it like any malformed input.
+                at = line.find("): ", line.index(_DUMP_MARK))
+                if at < 0:
+                    continue
+                line = line[at + 3:]
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "events" in obj:
+                yield obj
+
+
+def convert(paths: list, out: str) -> int:
+    dicts = [d for p in paths for d in _iter_trace_dicts(p)]
+    if not dicts:
+        print(f"dbmtrace: no trace dicts found in {paths}",
+              file=sys.stderr)
+        return 1
+    doc = tracing.to_chrome_trace(dicts)
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True)
+    print(f"dbmtrace: {len(dicts)} trace(s) -> {out} "
+          f"({len(doc['traceEvents'])} events)")
+    return 0
+
+
+# --------------------------------------------------------------------- demo
+
+
+class _DemoSearcher:
+    """Host-oracle searcher with the full two-phase + batch surface the
+    miner coalescer needs, plus an injectable one-shot FORCE stall (the
+    wedged-miner shape: transport heartbeats, compute stuck)."""
+
+    def __init__(self, data: str, wedge_s: float = 0.0):
+        from concurrent.futures import ThreadPoolExecutor
+        self.data = data
+        self._wedge_s = wedge_s
+        self._pool = ThreadPoolExecutor(max_workers=2,
+                                        thread_name_prefix="demo-scan")
+
+    def search(self, lower: int, upper: int):
+        from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+        return scan_min(self.data, lower, upper)
+
+    def dispatch(self, lower: int, upper: int):
+        return self._pool.submit(self.search, lower, upper)
+
+    def finalize(self, handle, lower: int):
+        if self._wedge_s:
+            stall, self._wedge_s = self._wedge_s, 0.0
+            time.sleep(stall)
+        return handle.result()
+
+    def dispatch_batch(self, entries: list):
+        if not all(isinstance(s, _DemoSearcher) for s, _l, _u in entries):
+            return None
+        return [s.dispatch(lo, up) for s, lo, up in entries]
+
+    def finalize_batch(self, handle) -> list:
+        return [f.result() for f in handle]
+
+
+async def _demo_run(out: str) -> dict:
+    from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                              MsgType,
+                                                              new_request)
+    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+    from distributed_bitcoinminer_tpu.lsp.params import Params
+    from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+    from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
+                                                           CoalesceParams,
+                                                           LeaseParams,
+                                                           QosParams)
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    params = Params(epoch_limit=30, epoch_millis=200, window_size=32,
+                    max_backoff_interval=2)
+    # Clients on a DEDICATED pool (the bench-probe lesson): blocked
+    # client threads on the default executor would starve the miners'
+    # own to_thread compute — a deadlock, not a demo.
+    clients = ThreadPoolExecutor(max_workers=10,
+                                 thread_name_prefix="demo-client")
+    server = await new_async_server(0, params)
+    sched = Scheduler(
+        server,
+        cache=CacheParams(enabled=False),
+        # Tight sub-second leases so the wedged miner is caught (and its
+        # chunk re-issued) within the demo's few seconds.
+        lease=LeaseParams(grace_s=1.0, factor=4.0, floor_s=0.5,
+                          tick_s=0.05, queue_alarm_s=0.0),
+        qos=QosParams(enabled=True, wholesale_s=0.15, chunk_s=0.1,
+                      max_chunks=16, depth=2),
+        coalesce=CoalesceParams(enabled=True, lanes=8,
+                                max_nonces=1 << 14))
+    sched_task = asyncio.create_task(sched.run())
+    hostport = f"127.0.0.1:{server.port}"
+    workers, tasks = [], []
+    try:
+        for wedge_s in (0.0, 0.0, 2.0):     # two healthy + one wedged
+            w = MinerWorker(
+                hostport, params=params,
+                searcher_factory=lambda d, b, _w=wedge_s: _DemoSearcher(
+                    d, wedge_s=_w),
+                pipeline_depth=16)
+            await w.join()
+            tasks.append(asyncio.create_task(w.run()))
+            workers.append(w)
+
+        def ask(lo: int, count: int):
+            async def go():
+                client = await new_async_client(hostport, params)
+                try:
+                    client.write(new_request(
+                        "dbmtrace-demo", lo, lo + count - 1).to_json())
+                    while True:
+                        m = Message.from_json(
+                            await asyncio.wait_for(client.read(), 60))
+                        if m.type == MsgType.RESULT:
+                            return m
+                finally:
+                    await client.close()
+            return asyncio.run(go())
+
+        loop = asyncio.get_running_loop()
+        # Warm request: seeds the pool-rate EWMA so the elephant below
+        # activates CHUNKED (a cold pool dispatches wholesale by design).
+        await loop.run_in_executor(clients, ask, 0, 60_000)
+        await asyncio.sleep(0.3)
+        # The storm: one elephant (chunked; its chunks cycle through the
+        # wedged miner too, whose stalled force blows a lease and gets
+        # re-issued) + a simultaneous wave of mice that backlog behind
+        # the saturated pool and coalesce into shared launches.
+        elephant = loop.run_in_executor(clients, ask, 100_000, 120_000)
+        await asyncio.sleep(0.15)
+        mice = [loop.run_in_executor(clients, ask, 400_000 + i * 600, 600)
+                for i in range(6)]
+        await asyncio.gather(elephant, *mice)
+        # Drain: let the wedged miner's LATE stale Result arrive so its
+        # span (naming the stalled force phase) stitches into the trace.
+        await asyncio.sleep(2.2)
+        return sched.export_trace(out)
+    finally:
+        for t in tasks:
+            t.cancel()
+        for w in workers:
+            await w.close()
+        sched_task.cancel()
+        await server.close()
+
+
+def demo(out: str) -> int:
+    if not tracing.enabled():
+        print("dbmtrace: DBM_TRACE=0 — the tracing plane is off, there "
+              "would be nothing to export", file=sys.stderr)
+        return 1
+    doc = asyncio.run(_demo_run(out))
+    events = doc["traceEvents"]
+    launches = sorted({e["args"].get("launch") for e in events
+                       if e.get("args", {}).get("launch") is not None})
+    slow = sorted({(e["args"].get("slow"), e["tid"]) for e in events
+                   if e.get("args", {}).get("slow")})
+    print(f"dbmtrace: demo trace -> {out} ({len(events)} events)")
+    print(f"  shared coalesced launches: {launches or 'none'}")
+    print(f"  stalled-phase attributions (phase, miner track): "
+          f"{slow or 'none'}")
+    print("  load it at ui.perfetto.dev (Open trace file)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dbmtrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    conv = sub.add_parser("convert", help="trace dumps -> Perfetto JSON")
+    conv.add_argument("paths", nargs="+")
+    conv.add_argument("-o", "--out", default="dbmtrace.json")
+    dm = sub.add_parser("demo", help="run the mixed-load demo + export")
+    dm.add_argument("-o", "--out", default="dbmtrace.json")
+    args = ap.parse_args(argv)
+    if args.cmd == "convert":
+        return convert(args.paths, args.out)
+    return demo(args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,29 +80,32 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
-# Knob-off matrix leg (ISSUE 4 + ISSUE 5 + ISSUE 9): the dispatch
-# pipeline, request striping, the fair-share QoS plane, and
-# cross-request coalescing default ON, so the full run above exercises
-# the overlapped/fair-share/batched path — re-run the
-# recovery/chaos/parity-sensitive modules (plus the QoS suite and the
-# batch suite, whose FIFO/stock-dispatch parity pins are exactly what
-# this leg exists for) with DBM_PIPELINE=0 DBM_STRIPE=0 DBM_QOS=0
-# DBM_COALESCE=0 so the stock serial loop + reference even split +
-# FIFO dispatch order + one-chunk-one-dispatch (the Go-parity shape)
-# stays covered in CI too. The leg also runs with DBM_SANITIZE=1
-# (ISSUE 7): the chaos and QoS suites under it exercise real wedges,
-# kills, and concurrent dispatch, so the sanitizer's loop-stall
-# watchdog and thread-ownership assertions sweep the paths most likely
-# to regress — violations warn and count, never fail a test, so this
-# costs nothing when clean. Skipped when the main leg already blew the
-# budget. DBM_TIER1_MATRIX=0 opts out.
+# Knob-off matrix leg (ISSUE 4 + ISSUE 5 + ISSUE 9 + ISSUE 10): the
+# dispatch pipeline, request striping, the fair-share QoS plane,
+# cross-request coalescing, and the tracing plane default ON, so the
+# full run above exercises the overlapped/fair-share/batched/traced
+# path — re-run the recovery/chaos/parity-sensitive modules (plus the
+# QoS suite, the batch suite, and the trace suite, whose
+# FIFO/stock-dispatch/stock-bytes parity pins are exactly what this
+# leg exists for) with DBM_PIPELINE=0 DBM_STRIPE=0 DBM_QOS=0
+# DBM_COALESCE=0 DBM_TRACE=0 so the stock serial loop + reference even
+# split + FIFO dispatch order + one-chunk-one-dispatch + span-less
+# stock wire bytes (the Go-parity shape) stays covered in CI too. The
+# leg also runs with DBM_SANITIZE=1 (ISSUE 7): the chaos and QoS
+# suites under it exercise real wedges, kills, and concurrent
+# dispatch, so the sanitizer's loop-stall watchdog and
+# thread-ownership assertions sweep the paths most likely to regress —
+# violations warn and count, never fail a test, so this costs nothing
+# when clean. Skipped when the main leg already blew the budget.
+# DBM_TIER1_MATRIX=0 opts out.
 if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
-        DBM_QOS=0 DBM_COALESCE=0 DBM_SANITIZE=1 \
+        DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
         tests/test_apps.py tests/test_qos.py tests/test_batch.py \
+        tests/test_trace.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}

@@ -235,7 +235,17 @@ def test_coalesce_off_reproduces_stock_dispatch_bit_for_bit():
     assert _counter("miner.coalesced_dispatches") == before_disp
     # Stock path: one launch per chunk (each range is one pow2 sub).
     assert _counter("model.device_launches") - before_launch == 5
-    assert [m.to_json() for m in off] == [m.to_json() for m in on]
+
+    def normalized(m):
+        # The Span trace extension (ISSUE 10) carries per-run TIMINGS,
+        # so with DBM_TRACE=1 (the default leg) it legitimately differs
+        # between the runs; the parity claim is about the ANSWER bytes.
+        # The tier-1 matrix leg re-runs this test with DBM_TRACE=0,
+        # where no Span exists and this normalization is the identity —
+        # true byte-for-bit coverage stays pinned there.
+        m.span = None
+        return m.to_json()
+    assert [normalized(m) for m in off] == [normalized(m) for m in on]
     for (lo, up), m in zip(ranges, off):
         assert (m.hash, m.nonce) == scan_min("coal parity", lo, up)
 

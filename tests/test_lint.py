@@ -113,6 +113,34 @@ CARD_GOOD_LITERAL = snip("""
         return outcomes
 """)
 
+# Trace-track extension (ISSUE 10): .track() with a dynamic entity
+# label is the known-bad shape unless the module also retires it via
+# .retire(...) — a .remove() does NOT vouch for a track (different
+# registry, different lifecycle).
+CARD_TRACK_BAD = snip("""
+    class Sched:
+        def span(self, tracks, conn_id):
+            tracks.track("trace_track", miner=str(conn_id))
+""")
+
+CARD_TRACK_GOOD = snip("""
+    class Sched:
+        def span(self, tracks, conn_id):
+            tracks.track("trace_track", miner=str(conn_id))
+
+        def on_drop(self, tracks, conn_id):
+            tracks.retire("trace_track", miner=str(conn_id))
+""")
+
+CARD_TRACK_WRONG_RETIREMENT = snip("""
+    class Sched:
+        def span(self, tracks, metrics, conn_id):
+            tracks.track("trace_track", miner=str(conn_id))
+
+        def on_drop(self, metrics, conn_id):
+            metrics.remove("trace_track", miner=str(conn_id))
+""")
+
 
 def test_cardinality_catches_unretired_dynamic_label():
     found = run_source("cardinality", CARD_BAD)
@@ -127,6 +155,25 @@ def test_cardinality_accepts_retirement_path():
 
 def test_cardinality_accepts_literals_and_bounded_comprehensions():
     assert run_source("cardinality", CARD_GOOD_LITERAL) == []
+
+
+def test_cardinality_catches_unretired_trace_track():
+    found = run_source("cardinality", CARD_TRACK_BAD)
+    assert len(found) == 1
+    assert "trace_track" in found[0].message
+    assert ".retire(" in found[0].message
+
+
+def test_cardinality_accepts_track_retirement_path():
+    assert run_source("cardinality", CARD_TRACK_GOOD) == []
+
+
+def test_cardinality_track_not_vouched_by_remove():
+    """A ``.remove()`` on the same name is a METRIC retirement; it must
+    not satisfy a ``.track()`` site (different registry, different
+    lifecycle) — the known-bad cross-vouching shape."""
+    found = run_source("cardinality", CARD_TRACK_WRONG_RETIREMENT)
+    assert len(found) == 1 and ".retire(" in found[0].message
 
 
 # ----------------------------------------------------------- knob-hygiene

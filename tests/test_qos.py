@@ -51,9 +51,16 @@ def _sanitize_armed(monkeypatch):
     the loop-stall watchdog and thread-ownership assertions sweep.
     Violations warn and count, never fail a test; the watchdog is
     uninstalled afterwards so timing-sensitive suites see stock
-    callbacks."""
-    from distributed_bitcoinminer_tpu.utils import sanitize
+    callbacks.
+
+    ISSUE 10: the flight recorder rides along (DBM_TRACE=1, overriding
+    a matrix leg's DBM_TRACE=0 for THIS suite's shed/grant storms) so
+    the QoS paths run with ring recording + dump triggers armed —
+    dumps are log lines, never failures."""
+    from distributed_bitcoinminer_tpu.utils import sanitize, trace
     monkeypatch.setenv("DBM_SANITIZE", "1")
+    monkeypatch.setenv("DBM_TRACE", "1")
+    trace.ensure_tracer()
     yield
     sanitize.uninstall_watchdog()
 

@@ -1,0 +1,536 @@
+"""End-to-end cross-process tracing + flight recorder (ISSUE 10).
+
+Layers:
+
+1. Wire + span units — the ``Span`` Result extension (stock bytes when
+   absent, malformed values dropped), the phase vocabulary, and the
+   dominant-phase naming.
+2. Flight recorder / compile observer / TrackSet units — ring bound,
+   dump triggers (alarm, sanitizer warning, unhandled-exception exit),
+   the recompile-storm alarm under a REAL unquantized jit-signature
+   churn, track retirement discipline.
+3. Chrome/Perfetto export — golden format (valid JSON, pinned event key
+   set, monotonic ts per track), file writing, and the
+   ``scripts/dbmtrace.py convert`` CLI on dumped traces.
+4. E2E — a real localhost LSP cluster where a WEDGED miner's stitched
+   trace names the miner-side phase that stalled (the late stale-Result
+   fold), and the ``DBM_TRACE=0`` parity pin (byte-identical Results,
+   zero trace paths: no stamps, no span dicts, no Span bytes).
+"""
+
+import asyncio
+import json
+import logging
+import sys
+import time
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                          new_request,
+                                                          new_result)
+from distributed_bitcoinminer_tpu.utils import trace
+from distributed_bitcoinminer_tpu.utils.metrics import (
+    registry as process_registry)
+
+from tests.test_scheduler_recovery import (CLIENT_X, MINER_A, MINER_B,
+                                           FakeServer, join, request,
+                                           result)
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    """Force the plane ON (the tier-1 matrix leg runs this module with
+    DBM_TRACE=0; tests that exercise tracing must pin it themselves)
+    and isolate the process singletons so counters/rings start fresh."""
+    monkeypatch.setenv("DBM_TRACE", "1")
+    monkeypatch.setattr(trace, "_flight", None)
+    monkeypatch.setattr(trace, "_observer", None)
+    yield
+    trace._flight = None
+    trace._observer = None
+
+
+def make_traced_scheduler():
+    server = FakeServer()
+    return Scheduler(server), server
+
+
+SPAN = {"queue_s": 0.001, "dispatch_s": 0.002, "wait_s": 0.0005,
+        "force_s": 0.8, "gap_s": 0.0, "launch": 3, "lanes": 4}
+
+
+# ------------------------------------------------------------- wire + spans
+
+
+def test_span_rides_result_and_absent_keeps_stock_bytes():
+    stock = new_result(5, 3).to_json()
+    assert b"Span" not in stock
+    on_wire = new_result(5, 3, span={"force_s": 0.5}).to_json()
+    assert b'"Span":{"force_s":0.5}' in on_wire
+    decoded = Message.from_json(on_wire)
+    assert decoded.span == {"force_s": 0.5}
+    # Round-trip of a span-less message is bit-stable.
+    assert Message.from_json(stock).to_json() == stock
+
+
+def test_malformed_span_dropped_not_fatal():
+    for junk in ('"x"', "5", "[1,2]", "null", "true"):
+        raw = (b'{"Type":2,"Data":"","Lower":0,"Upper":0,"Hash":1,'
+               b'"Nonce":2,"Span":' + junk.encode() + b"}")
+        msg = Message.from_json(raw)     # must not raise
+        assert msg.span is None and msg.hash == 1
+
+
+def test_slow_phase_names_dominant_phase():
+    assert trace.slow_phase(SPAN) == "force"
+    assert trace.slow_phase({"queue_s": 1.0, "force_s": 0.1}) == "queue"
+    assert trace.slow_phase({}) is None
+    assert trace.slow_phase({"force_s": "junk"}) is None
+
+
+def test_fold_span_whitelists_and_names_slow(traced):
+    sched, _server = make_traced_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "fold", 99)
+    evil = dict(SPAN, hostile="x" * 1000, miner=999)   # injected keys
+    sched._on_result(MINER_A, Message.from_json(
+        new_result(7, 1, span=evil).to_json()))
+    events = sched.trace(1).to_dict()["events"]
+    span_ev = next(e for e in events if e["event"] == "miner_span")
+    assert span_ev["miner"] == MINER_A          # not the injected 999
+    assert "hostile" not in span_ev
+    assert span_ev["slow"] == "force"
+    assert span_ev["launch"] == 3 and span_ev["lanes"] == 4
+    assert events[-1]["event"] == "reply"
+
+
+def test_trace_off_no_fold_no_tracks(monkeypatch):
+    monkeypatch.setenv("DBM_TRACE", "0")
+    sched, _server = make_traced_scheduler()
+    assert sched._trace_on is False
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "off", 99)
+    sched._on_result(MINER_A, Message.from_json(
+        new_result(7, 1, span=dict(SPAN)).to_json()))
+    events = sched.trace(1).to_dict()["events"]
+    assert all(e["event"] != "miner_span" for e in events)
+    assert len(sched._tracks) == 0
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_bound_and_dump(traced, caplog):
+    fr = trace.FlightRecorder(cap=4)
+    for i in range(10):
+        fr.record("ev", i=i)
+    events = fr.events()
+    assert len(events) == 4
+    assert [e["i"] for e in events] == [6, 7, 8, 9]   # oldest dropped
+    with caplog.at_level(logging.WARNING, logger="dbm.trace"):
+        fr.dump("unit test")
+    line = next(r.message for r in caplog.records
+                if "flight recorder dump" in r.message)
+    doc = json.loads(line[line.index("): ") + 3:])
+    assert doc["why"] == "unit test"
+    assert [e["i"] for e in doc["events"]] == [6, 7, 8, 9]
+
+
+def test_flight_recorder_cap_zero_disables(traced, caplog):
+    fr = trace.FlightRecorder(cap=0)
+    fr.record("ev")
+    assert len(fr.events()) == 0
+    with caplog.at_level(logging.WARNING, logger="dbm.trace"):
+        fr.dump("nope")
+    assert not any("flight recorder dump" in r.message
+                   for r in caplog.records)
+
+
+def test_flight_helpers_respect_knob(monkeypatch):
+    monkeypatch.setenv("DBM_TRACE", "0")
+    monkeypatch.setattr(trace, "_flight", None)
+    trace.flight("ev")                       # no-op: ring never built
+    trace.flight_dump("why")
+    assert trace._flight is None
+
+
+def test_excepthook_dumps_flight_ring(traced, monkeypatch, caplog):
+    seen = []
+    monkeypatch.setattr(sys, "excepthook", lambda *a: seen.append(a))
+    monkeypatch.setattr(trace, "_excepthook_installed", False)
+    trace.ensure_tracer()
+    trace.flight("pre_crash", detail="x")
+    with caplog.at_level(logging.WARNING, logger="dbm.trace"):
+        sys.excepthook(ValueError, ValueError("boom"), None)
+    assert seen and seen[0][0] is ValueError     # prior hook still ran
+    dump = next(r.message for r in caplog.records
+                if "flight recorder dump" in r.message)
+    assert "unhandled-exception exit" in dump and "pre_crash" in dump
+
+
+def test_sanitizer_warning_dumps_flight(traced, caplog):
+    from distributed_bitcoinminer_tpu.utils import sanitize
+
+    async def on_loop():
+        with caplog.at_level(logging.WARNING):
+            sanitize.assert_off_loop("trace-test compute")
+    asyncio.run(on_loop())
+    assert any("flight recorder dump" in r.message
+               and "loop_blocking" in r.message for r in caplog.records)
+
+
+# --------------------------------------------------------- compile observer
+
+
+def test_compile_observer_counts_and_storm_episode(traced, caplog):
+    ob = trace.CompileObserver(storm_n=3, storm_s=60.0)
+    storms = process_registry().counter("trace.recompile_storms")
+    before = storms.value
+    with caplog.at_level(logging.WARNING, logger="dbm.trace"):
+        assert ob.launch(("e", 1), 0.5) == 0.5      # fresh: compile
+        assert ob.launch(("e", 1), 0.001) is None   # warm: counted only
+        ob.launch(("e", 2), 0.2)
+        ob.launch(("e", 3), 0.2)                    # 3rd fresh: storm
+        ob.launch(("e", 4), 0.2)                    # still same episode
+    assert storms.value == before + 1               # once per episode
+    assert ob.sigs[("e", 1)]["n"] == 2
+    assert any("recompile storm" in r.message for r in caplog.records)
+    snap = ob.snapshot()
+    assert len(snap) == 4 and all("compile_s" in v for v in snap.values())
+
+
+def test_recompile_storm_fires_on_unquantized_signature_churn(
+        traced, monkeypatch, caplog):
+    """ISSUE 10 acceptance: churning an UNQUANTIZED value through a jit
+    static boundary (here: a per-request batch size — exactly what
+    pow2_bucket exists to prevent) must fire the storm alarm via the
+    real model-layer launch hooks."""
+    from distributed_bitcoinminer_tpu.models import NonceSearcher
+    monkeypatch.setenv("DBM_TRACE_STORM_N", "4")
+    storms = process_registry().counter("trace.recompile_storms")
+    before = storms.value
+    with caplog.at_level(logging.WARNING, logger="dbm.trace"):
+        for batch in (193, 197, 199, 211, 223):     # unquantized churn
+            NonceSearcher("storm", batch=batch, tier="jnp").search(
+                100, 160)
+    assert storms.value > before
+    assert any("recompile storm" in r.message for r in caplog.records)
+
+
+def test_observe_launch_off_is_one_bool_check(monkeypatch):
+    monkeypatch.setenv("DBM_TRACE", "0")
+    monkeypatch.setattr(trace, "_observer", None)
+    with trace.observe_launch(("e", 1)) as ob:
+        pass
+    assert ob.compile_s is None
+    assert trace._observer is None          # never constructed
+
+
+# ------------------------------------------------------------------ tracks
+
+
+def test_trackset_ids_retire_and_overflow_bound():
+    ts = trace.TrackSet(max_tracks=2)
+    a = ts.track("trace_track", miner="1")
+    assert ts.track("trace_track", miner="1") == a   # stable
+    b = ts.track("trace_track", miner="2")
+    assert b != a
+    c = ts.track("trace_track", miner="3")           # past bound
+    assert c == ts.track("trace_track", miner="4")   # collapsed together
+    # The overflow track holds a slot (Registry discipline): one retire
+    # is not enough to mint a fresh track while it lives...
+    ts.retire("trace_track", miner="1")
+    assert ts.track("trace_track", miner="5") == c
+    # ...but retiring the overflow track itself frees real room.
+    ts.retire("trace_track", overflow="true")
+    d = ts.track("trace_track", miner="6")
+    assert d not in (a, b, c)
+    assert dict(ts.items("trace_track")).keys() >= {
+        (("miner", "2"),), (("miner", "6"),)}
+
+
+def test_scheduler_retires_tracks_on_miner_and_client_drop(traced):
+    sched, _server = make_traced_scheduler()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "tracked", 99)
+    sched._on_result(MINER_A, Message.from_json(
+        new_result(7, 1, span=dict(SPAN)).to_json()))
+    labels = [dict(k) for k, _ in sched._tracks.items("trace_track")]
+    assert {"miner": str(MINER_A)} in labels
+    assert {"tenant": str(CLIENT_X)} in labels
+    sched._on_drop(MINER_A)
+    sched._on_drop(CLIENT_X)
+    assert sched._tracks.items("trace_track") == []
+
+
+# ------------------------------------------------------------------ export
+
+#: Every exported event draws from this key set (golden contract).
+_EVENT_KEYS = {"name", "ph", "pid", "tid", "ts", "dur", "args", "s"}
+
+
+def _scripted_export():
+    sched, _server = make_traced_scheduler()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "golden", 199)          # 2 chunks
+    sched._on_result(MINER_A, Message.from_json(
+        new_result(9, 5, span=dict(SPAN)).to_json()))
+    sched._on_result(MINER_B, Message.from_json(
+        new_result(7, 150, span=dict(SPAN, launch=4, lanes=2,
+                                     gap_s=0.01)).to_json()))
+    return sched
+
+
+def test_export_golden_format(traced):
+    sched = _scripted_export()
+    doc = sched.export_trace()
+    json.loads(json.dumps(doc))                     # valid JSON
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = doc["traceEvents"]
+    assert events, "empty export"
+    per_track = {}
+    for e in events:
+        assert set(e) <= _EVENT_KEYS | {"args"}
+        assert {"name", "ph", "pid"} <= set(e)
+        if e["ph"] == "M":
+            continue
+        assert isinstance(e["ts"], int)
+        per_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    for track, tss in per_track.items():
+        assert tss == sorted(tss), f"non-monotonic ts on track {track}"
+    # One track per role: scheduler/tenant + miners, with thread names.
+    names = {(e["pid"], e["args"]["name"]) for e in events
+             if e["name"] == "thread_name"}
+    assert (1, f"tenant {CLIENT_X}") in names
+    assert (2, f"miner {MINER_A}") in names and \
+        (2, f"miner {MINER_B}") in names
+    # The request decomposes: queued + request slices on the tenant
+    # track, per-phase slices (with launch args) on the miner tracks.
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    assert "queued" in by_name and "request 1" in by_name
+    for phase in ("queue", "dispatch", "wait", "force"):
+        assert phase in by_name, f"missing {phase} slice"
+    launches = {e["args"].get("launch") for e in by_name["force"]}
+    assert launches == {3, 4}
+    # Layout pin (code review): gap is idle time BEFORE the chunk — it
+    # renders FIRST on its track, and force abuts the fold stamp (no
+    # phantom post-force stall).
+    assert "gap" in by_name
+    gap = by_name["gap"][0]
+    force = next(e for e in by_name["force"]
+                 if e["tid"] == gap["tid"])
+    assert gap["ts"] + gap["dur"] <= force["ts"]
+
+
+def test_export_writes_file(traced, tmp_path):
+    sched = _scripted_export()
+    out = tmp_path / "trace.json"
+    doc = sched.export_trace(str(out))
+    assert json.loads(out.read_text()) == json.loads(
+        json.dumps(doc, sort_keys=True))
+
+
+def test_dbmtrace_convert_cli(traced, tmp_path):
+    sched = _scripted_export()
+    dump = tmp_path / "dump.jsonl"
+    lines = []
+    for _key, t in sched.traces.items():
+        lines.append(json.dumps(t.to_dict(), sort_keys=True))
+    # One raw dict line, one alarm-style log line, one junk line, and a
+    # TRUNCATED dump line (log rotation mid-write) with the marker but
+    # no payload separator — skipped, never a crash (code review).
+    lines.append("trace dump (queue-age alarm: stalled request): "
+                 + lines[0])
+    lines.append("not json at all")
+    lines.append("trace dump (queue-age alarm: stalled requ")
+    dump.write_text("\n".join(lines) + "\n")
+    sys.path.insert(0, "scripts")
+    try:
+        import dbmtrace
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "out.json"
+    assert dbmtrace.main(["convert", str(dump), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+    phases = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert "force" in phases
+
+
+# ------------------------------------------------------------ profiling fix
+
+
+def test_timer_tolerates_misuse_before_enter():
+    from distributed_bitcoinminer_tpu.utils.profiling import Timer
+    t = Timer()
+    assert t.rate(100) == 0.0       # no TypeError
+    t.__exit__(None, None, None)    # no TypeError
+    assert t.seconds == 0.0
+    with t:
+        time.sleep(0.01)
+    assert t.seconds > 0 and t.rate(10) > 0
+
+
+def test_xprof_dir_knob_routing(monkeypatch, tmp_path):
+    from distributed_bitcoinminer_tpu.utils.profiling import (device_trace,
+                                                              xprof_dir)
+    monkeypatch.delenv("DBM_TRACE_XPROF", raising=False)
+    assert xprof_dir() is None and xprof_dir("jnp") is None
+    with device_trace():            # env unset: no-op, no jax import
+        pass
+    monkeypatch.setenv("DBM_TRACE_XPROF", str(tmp_path))
+    assert xprof_dir() == str(tmp_path)
+    assert xprof_dir("jnp") == str(tmp_path / "jnp")
+
+
+# -------------------------------------------------------------------- e2e
+
+
+def _miner_with_fake_client(monkeypatch, trace_on: bool):
+    from distributed_bitcoinminer_tpu.apps.miner import MinerWorker
+
+    monkeypatch.setenv("DBM_TRACE", "1" if trace_on else "0")
+
+    class FakeClient:
+        def __init__(self):
+            self.writes = []
+
+        def write(self, payload):
+            self.writes.append(payload)
+
+    class TwoPhase:
+        def __init__(self, data):
+            self.data = data
+
+        def search(self, lower, upper):
+            from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+            return scan_min(self.data, lower, upper)
+
+        def dispatch(self, lower, upper):
+            return (lower, upper)
+
+        def finalize(self, handle, lower):
+            return self.search(*handle)
+
+    w = MinerWorker("127.0.0.1:1", searcher_factory=lambda d, b: TwoPhase(d))
+    w.client = FakeClient()
+    return w
+
+
+def test_trace_zero_parity_pin(monkeypatch):
+    """DBM_TRACE=0: byte-identical Results (no Span key anywhere) and
+    the zero-overhead paths — no span skeletons, no recv stamps, no
+    fold. DBM_TRACE=1 on the identical chunk: same answer bytes except
+    the Span extension, whose payload stays inside the vocabulary."""
+    msg = Message.from_json(new_request("parity", 0, 99).to_json())
+
+    async def serve(w, m):
+        t0 = time.monotonic()
+        searcher, handle, dispatch_s, span = w._resolve_and_dispatch(m)
+        assert await w._finalize_and_reply(m, searcher, handle, t0,
+                                           dispatch_s, span)
+        return w.client.writes
+
+    off = _miner_with_fake_client(monkeypatch, trace_on=False)
+    assert off._trace is False
+    assert off._span_open(msg) is None
+    off_writes = asyncio.run(serve(off, msg))
+
+    on = _miner_with_fake_client(monkeypatch, trace_on=True)
+    on_writes = asyncio.run(serve(on, Message.from_json(
+        new_request("parity", 0, 99).to_json())))
+
+    assert len(off_writes) == len(on_writes) == 1
+    assert b"Span" not in off_writes[0]
+    off_msg = Message.from_json(off_writes[0])
+    on_msg = Message.from_json(on_writes[0])
+    assert (off_msg.hash, off_msg.nonce) == (on_msg.hash, on_msg.nonce)
+    assert on_msg.span is not None
+    assert set(on_msg.span) <= set(trace.SPAN_PHASES
+                                   + trace.SPAN_EXTRAS)
+    for k in ("queue_s", "dispatch_s", "wait_s", "force_s"):
+        assert k in on_msg.span
+    # Stripping the Span extension reproduces the stock bytes exactly.
+    on_msg.span = None
+    assert on_msg.to_json() == off_writes[0]
+
+
+def test_wedged_miner_stall_attributed_to_phase(traced):
+    """ISSUE 10 acceptance (scripted e2e): a wedged miner's chunk blows
+    its lease, the re-issue rescues the request, and the wedged miner's
+    LATE stale Result — carrying its span — stitches into the closed
+    trace naming the miner-side phase that stalled (the blocking
+    compute: force)."""
+    from distributed_bitcoinminer_tpu.apps.client import submit
+    from tests.test_chaos import ChaosCluster, expected, tight_lease
+
+    async def scenario():
+        async with ChaosCluster(lease=tight_lease()) as c:
+            wedged = await c.add_miner("wedged")
+            await c.add_miner("healthy")
+            wedged_conn = wedged.conn_id
+            wedged.wedge()
+            r = await asyncio.wait_for(
+                submit(c.hostport, "stalls", 799, c.params), 30)
+            assert r == expected("stalls", 799)
+            wedged.unwedge()
+            assert await c.settle()
+            # The late stale Result has now popped: its span is stitched
+            # into the (closed) trace and names the stalled phase.
+            s = c.scheduler
+            for _ in range(100):
+                events = s.trace(1).to_dict()["events"]
+                spans = [e for e in events if e["event"] == "miner_span"
+                         and e["miner"] == wedged_conn]
+                if spans:
+                    break
+                await asyncio.sleep(0.02)
+            assert spans, "wedged miner's span never stitched"
+            stalled = spans[-1]
+            assert stalled["slow"] == "force"
+            assert stalled["force_s"] > 0.3      # the wedge, not noise
+            assert stalled.get("serial") == 1    # blocking compute path
+            # The healthy rescue also stitched (order-independent).
+            others = [e for e in events if e["event"] == "miner_span"
+                      and e["miner"] != wedged_conn]
+            assert others
+            assert s.trace(1).closed
+            doc = s.export_trace()
+            slows = {e["args"].get("slow")
+                     for e in doc["traceEvents"] if e.get("args")}
+            assert "force" in slows
+    asyncio.run(scenario())
+
+
+def test_e2e_pipelined_spans_stitch_and_flight_records(traced):
+    """Happy-path e2e over real localhost LSP: every chunk of a served
+    request carries a span (two-phase pipelined path), the stitched
+    trace closes, and the scheduler's flight ring holds the
+    dispatch/assign/reply edges."""
+    from distributed_bitcoinminer_tpu.apps.client import submit
+    from tests.test_chaos import ChaosCluster, expected
+
+    async def scenario():
+        async with ChaosCluster() as c:
+            await c.add_miner("a")
+            await c.add_miner("b")
+            r = await asyncio.wait_for(
+                submit(c.hostport, "traced e2e", 999, c.params), 30)
+            assert r == expected("traced e2e", 999)
+            s = c.scheduler
+            events = s.trace(1).to_dict()["events"]
+            spans = [e for e in events if e["event"] == "miner_span"]
+            answered = len([e for e in events if e["event"] == "result"])
+            assert len(spans) == answered >= 2    # one span per chunk
+            for e in spans:
+                assert e["queue_s"] >= 0 and e["force_s"] >= 0
+            flight = {e["event"] for e in trace.flight_recorder().events()}
+            assert {"dispatch", "assign", "reply"} <= flight
+    asyncio.run(scenario())
