@@ -752,6 +752,68 @@ def _batch_probe(data: str, lower: int, batch: int) -> dict:
     }
 
 
+def _load_probe() -> dict:
+    """Control-plane load curve (ISSUE 11): tenants vs p50/p99/shed-rate
+    for 1 vs N in-process scheduler replicas, on the socket-free detnet
+    transport with instant miners (``apps/loadharness.py`` — compute is
+    removed so the CONTROL PLANE is the only thing measured).
+
+    Legs are interleaved order-swapped (1-replica then N, order
+    flipped each round) and median-aggregated, the repo's storm-probe
+    noise discipline; queue capacity is split across replicas so the
+    1-vs-N comparison runs at equal total admission capacity (equal
+    shed rate by construction). The top tenant count additionally runs
+    a DE-MELT knob comparison — ``DBM_RECV_BATCH=1`` +
+    ``DBM_TRACE_SAMPLE=1.0`` (the gated de-melts off, i.e. stock recv
+    and full per-request trace allocation) vs the tuned settings — so
+    the artifact carries before/after evidence for the knob-gated part
+    of the ISSUE 11 de-melt (the structural part — indexed queues,
+    backlogged-only DRR ring, hoisted pump bounds, O(1) pump no-op
+    exits — is knobless and in both legs; the session's profile put
+    the pre-fix shape at ~4.6x slower at 2k tenants).
+
+    ``DBM_BENCH_LOAD=0`` skips; ``DBM_BENCH_LOAD_TENANTS`` (comma list,
+    default "500,2000") sets the curve points — the checked-in
+    BENCH_r06 artifact was generated at "500,2000,10000" — and
+    ``DBM_BENCH_LOAD_ROUNDS`` (default 2) the rounds per point.
+    """
+    from distributed_bitcoinminer_tpu.apps.loadharness import (load_curve,
+                                                               run_load)
+
+    points = []
+    for part in _str_env("DBM_BENCH_LOAD_TENANTS", "500,2000").split(","):
+        part = part.strip()
+        if part.isdigit() and int(part) > 0:
+            points.append(int(part))
+    points = points or [500, 2000]
+    rounds = max(1, _int_env("DBM_BENCH_LOAD_ROUNDS", 2))
+    curve = load_curve(points, replica_counts=(1, 4), rounds=rounds,
+                       max_queued=4 * max(points))
+    top = max(points)
+    knob_stock = run_load(tenants=top, replicas=1, recv_batch=1,
+                          trace_sample=1.0, max_queued=4 * top)
+    tuned = run_load(tenants=top, replicas=1, recv_batch=64,
+                     trace_sample=0.01, max_queued=4 * top)
+    return {
+        "points": curve["points"],
+        "rounds": rounds,
+        "demelt": {
+            "tenants": top,
+            "knobs_stock": {k: knob_stock[k] for k in
+                            ("makespan_s", "p50_s", "p99_s",
+                             "cpu_s_per_request")},
+            "tuned": {k: tuned[k] for k in
+                      ("makespan_s", "p50_s", "p99_s",
+                       "cpu_s_per_request")},
+        },
+        "samples": [
+            {k: leg.get(k) for k in
+             ("tenants", "replicas", "makespan_s", "admitted_per_s",
+              "p50_s", "p99_s", "shed_rate", "cpu_s_per_request")}
+            for leg in curve["samples"]],
+    }
+
+
 def main() -> int:
     from distributed_bitcoinminer_tpu.utils.config import probe_backend
     from distributed_bitcoinminer_tpu.utils.metrics import ensure_emitter
@@ -1031,6 +1093,17 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             batch_detail = {"batch": {"error": repr(exc)[:300]}}
 
+    # Control-plane load curve (ISSUE 11): tenants vs p50/p99/shed-rate
+    # for 1 vs 4 scheduler replicas on detnet with instant miners —
+    # no JAX compute involved, so it runs on any box. DBM_BENCH_LOAD=0
+    # skips it.
+    load_detail = {}
+    if _str_env("DBM_BENCH_LOAD", "1") != "0":
+        try:
+            load_detail = {"load": _load_probe()}
+        except Exception as exc:  # noqa: BLE001
+            load_detail = {"load": {"error": repr(exc)[:300]}}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
@@ -1062,6 +1135,7 @@ def main() -> int:
         **pipeline_detail,
         **qos_detail,
         **batch_detail,
+        **load_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
         # (midstate cache behavior, until-tier degradations) stay

@@ -450,6 +450,147 @@ class DifficultyPrefix(Scenario):
         return out
 
 
+# ------------------------------------------------------------ plane_split
+
+class PlaneSplit(Scenario):
+    """The ISSUE 11 tenant/miner plane split under ONE combined storm:
+    a chunked elephant, mice trains, striping, the coalescing window,
+    a misbehaving (wedged or slow) miner driving the lease plane, and
+    an optional mid-storm client drop — every grant crosses the
+    tenant→miner interface, every Result crosses complete, and every
+    blown lease crosses lease-event, with the full invariant pack
+    (exactly-once oracle-exact per-tenant replies, accounting balance,
+    span closure, sanitizer silence) proving the split preserved the
+    monolith's semantics."""
+
+    name = "plane_split"
+
+    def build(self, ctx: Ctx) -> None:
+        rng = ctx.rng
+        _make_sched(ctx, lease=LeaseParams(
+            grace_s=1.2, factor=4.0, floor_s=0.8, tick_s=0.1,
+            quarantine_after=rng.choice((1, 2)), queue_alarm_s=30.0),
+            qos=QosParams(
+                enabled=True, chunk_s=0.2, max_chunks=16, depth=2,
+                wholesale_s=0.5),
+            stripe=StripeParams(enabled=True, chunk_s=0.3, depth=3),
+            coalesce=CoalesceParams(enabled=True,
+                                    lanes=rng.choice((3, 4)),
+                                    small_s=0.25))
+        bad = rng.choice((None, 0, 1, 2))
+        slow = rng.random() < 0.5
+        for i in range(3):
+            kw = {}
+            mrng = _fork(rng)
+            if bad == i and not slow:
+                kw["wedge_after"] = rng.choice((0, 1))
+            elif bad == i and slow:
+                kw["delay_fn"] = \
+                    lambda size, r=mrng: r.uniform(1.5, 3.0)
+            else:
+                kw["delay_fn"] = lambda size, r=mrng: \
+                    size / 1000.0 * r.uniform(0.8, 1.2)
+            ctx.add_miner(f"m{i}", **kw)
+        ctx.spawn(_warm_rates(ctx, 3, 1000.0))
+        # Tenant 1: elephant (est ~0.7s > wholesale 0.5 at the warmed
+        # 3x1000 nps pool -> chunked activation across the pool slice).
+        ctx.add_client("elephant", [
+            Req(rng.choice(_DATA), 0, 1999, pre_delay=0.5)])
+        # Tenants 2+3: mice trains landing against the elephant's
+        # grants (coalescible at the warmed rate).
+        for t, n in (("mice_a", 2), ("mice_b", rng.choice((1, 2)))):
+            reqs = [Req(f"{rng.choice(_DATA)}#{t}{j}", 0,
+                        rng.choice((99, 199)),
+                        pre_delay=0.5 + rng.uniform(0.0, 1.2))
+                    for j in range(n)]
+            ctx.add_client(t, reqs)
+        if rng.random() < 0.4:
+            # A client that drops right after sending: the cancel path
+            # must free both planes without corrupting the others.
+            ctx.add_client("dropper", [
+                Req(f"{rng.choice(_DATA)}#d", 0, 149,
+                    pre_delay=rng.uniform(0.3, 1.0), close_after=True)])
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        return out
+
+
+# -------------------------------------------------------- replica_takeover
+
+class ReplicaTakeover(Scenario):
+    """ISSUE 11 replica sharding: a 2-replica :class:`~...apps.replicas.
+    ReplicaSet` over ONE detnet transport, tenants consistent-hashed
+    across the replicas and miners sliced between them — then one
+    replica is KILLED at a seed-drawn virtual time, possibly
+    mid-request. Lease takeover must re-serve the dead replica's queued
+    and in-flight requests EXACTLY ONCE, oracle-exact, through the
+    survivors (adopted miners' stale answers popping in order), with
+    accounting balanced and every live trace closed at quiescence."""
+
+    name = "replica_takeover"
+
+    def build(self, ctx: Ctx) -> None:
+        from ...apps.replicas import ReplicaSet
+        from ...utils.config import CacheParams as _Cache
+        rng = ctx.rng
+        rs = ReplicaSet(
+            ctx.server, 2,
+            lease=LeaseParams(grace_s=5.0, factor=4.0, floor_s=2.0,
+                              tick_s=0.1, queue_alarm_s=30.0),
+            cache=_Cache(),
+            qos=QosParams(enabled=True, chunk_s=0.3, max_chunks=8,
+                          depth=2, wholesale_s=0.5),
+            stripe=StripeParams(enabled=False),
+            coalesce=CoalesceParams(enabled=False),
+            clock=ctx.loop.time)
+        ctx.sched = rs
+        ctx.spawn(rs.run())
+        for i in range(3):
+            ctx.add_miner(
+                f"m{i}",
+                delay_fn=lambda size, r=_fork(rng):
+                    size / 1000.0 * r.uniform(0.8, 1.2))
+
+        async def warm():
+            import asyncio as _a
+            while sum(len(s.miners) for s in rs.replicas.values()) < 3:
+                await _a.sleep(0.01)
+            for sched in rs.replicas.values():
+                for m in sched.miners:
+                    m.rate_ewma = 1000.0
+                sched._pool_rate = 1000.0
+        ctx.spawn(warm())
+
+        victim = rng.choice((0, 1))
+        kill_at = rng.uniform(0.6, 2.5)
+
+        async def killer():
+            import asyncio as _a
+            await _a.sleep(kill_at)
+            if victim in rs.live and len(rs.live) > 1:
+                rs.kill(victim)
+        ctx.spawn(killer())
+
+        # Several tenants so BOTH replicas own some: an elephant that
+        # may be chunked-in-flight when the kill lands, plus mice.
+        ctx.add_client("elephant", [
+            Req(rng.choice(_DATA), 0, rng.choice((1499, 1999)),
+                pre_delay=0.4)])
+        for t, n in (("mice_a", 2), ("mice_b", 2)):
+            reqs = [Req(f"{rng.choice(_DATA)}#{t}{j}", 0,
+                        rng.choice((99, 199)),
+                        pre_delay=0.3 + rng.uniform(0.0, 1.5))
+                    for j in range(n)]
+            ctx.add_client(t, reqs)
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        return out
+
+
 # ------------------------------------------------------- known-bad fixtures
 
 class FixtureLostUpdate(Scenario):
@@ -512,6 +653,8 @@ SCENARIOS = {
     "pipelined_dispatch": PipelinedDispatch,
     "batched_dispatch": BatchedDispatch,
     "difficulty_prefix": DifficultyPrefix,
+    "plane_split": PlaneSplit,
+    "replica_takeover": ReplicaTakeover,
 }
 
 FIXTURES = {

@@ -1,0 +1,254 @@
+"""O(10k)-tenant control-plane load harness (ISSUE 11).
+
+The bench's compute probes measure the KERNELS; this harness measures
+the CONTROL PLANE — what one scheduler process costs per request as
+the tenant count grows, with compute removed from the equation:
+
+- Transport is the socket-free :mod:`~..lspnet.detnet` shim in
+  non-recording mode (``DetServer(record=False)``): every message is a
+  queue put, so 10k conns cost 10k× one message, not sockets, epochs,
+  or capture lists.
+- Miners are INSTANT actors: each Request is answered immediately with
+  a cheap deterministic fake hash (the scheduler never verifies hashes;
+  merge/lease/accounting mechanics are identical), plus an honest
+  miner-side Span (measured queue/force wall times of the actor) so the
+  per-phase trace medians the probes embed stay populated.
+- Tenants are one DetChannel each, storming ``requests_per_tenant``
+  small unique-keyed requests at t0 and reading until replied or shed
+  (a shed closes the conn — the client observes the LSP death exactly
+  like production ``submit_with_retry`` would).
+
+What a leg reports: completed/shed counts, wall makespan, admitted
+throughput (completed / makespan), reply-latency p50/p99, CPU seconds
+per completed request (``time.process_time`` over the leg — the
+"per-request CPU cost" acceptance number), and the scheduler-side
+trace summary (sampled traces only, by design — the harness runs
+traced at ``DBM_TRACE_SAMPLE``-style rates without tracing being the
+bottleneck).
+
+Replica legs construct an :class:`~.replicas.ReplicaSet`; the QUEUE
+CAPACITY IS SPLIT across replicas (``max_queued / n`` each) so 1-vs-N
+comparisons run at EQUAL total admission capacity — equal shed rate by
+construction — and the throughput difference is the sharding win, not
+a bigger buffer.
+
+``scripts/loadharness.py`` is the CLI (and the tier-1 mini-load leg);
+``bench.py detail.load`` sweeps the tenant curve and checks the
+result in as the BENCH artifact.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from statistics import median
+from typing import Optional
+
+from ..bitcoin.message import Message, MsgType, new_join, new_request, \
+    new_result
+from ..lsp.errors import LspError
+from ..lspnet.detnet import DetServer
+from ..utils.config import CacheParams, LeaseParams, QosParams
+from ..utils.trace import SPAN_PHASES
+
+__all__ = ["run_load", "load_curve"]
+
+#: A 64-bit odd multiplier (splitmix64 finalizer constant): the fake
+#: miner's answer must be a deterministic function of the chunk so
+#: speculative re-issues merge idempotently, and cheap (no SHA-256 —
+#: compute is exactly what this harness removes).
+_MIX = 0xBF58476D1CE4E5B9
+_MASK = (1 << 64) - 1
+
+
+def _fake_hash(data: str, lower: int) -> int:
+    return (hash(data) * _MIX + lower * 0x9E3779B97F4A7C15) & _MASK
+
+
+async def _fake_miner(chan, trace_spans: bool) -> None:
+    """Instant miner actor: JOIN, then answer every Request with the
+    fake hash — attaching a measured (honest, if tiny) span when
+    ``trace_spans``."""
+    chan.write(new_join().to_json())
+    while True:
+        try:
+            payload = await chan.read()
+        except LspError:
+            return
+        arrived = time.monotonic()
+        msg = Message.from_json(payload)
+        if msg.type != MsgType.REQUEST:
+            continue
+        h = _fake_hash(msg.data, msg.lower)
+        span = None
+        if trace_spans:
+            done = time.monotonic()
+            span = {"queue_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
+                    "force_s": round(done - arrived, 9), "gap_s": 0.0}
+        try:
+            chan.write(new_result(h, msg.lower, msg.target,
+                                  span=span).to_json())
+        except LspError:
+            return
+
+
+async def _tenant(chan, data: str, count: int, nonces: int,
+                  latencies: list, sheds: list) -> None:
+    """One tenant: submit ``count`` unique requests back-to-back at
+    storm start, then read replies; a dead conn = shed."""
+    stamps = []
+    try:
+        for i in range(count):
+            stamps.append(time.monotonic())
+            chan.write(new_request(f"{data}#{i}", 0, nonces - 1).to_json())
+        got = 0
+        while got < count:
+            payload = await chan.read()
+            msg = Message.from_json(payload)
+            if msg.type == MsgType.RESULT:
+                latencies.append(time.monotonic() - stamps[got])
+                got += 1
+    except LspError:
+        sheds.append(len(stamps))
+
+
+def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
+             *, requests_per_tenant: int = 1, req_nonces: int = 256,
+             max_queued: int = 4096, recv_batch: Optional[int] = None,
+             trace_sample: Optional[float] = None,
+             timeout_s: float = 300.0) -> dict:
+    """One storm leg; returns the leg's measurement dict."""
+
+    async def leg() -> dict:
+        from .replicas import ReplicaSet
+        from .scheduler import Scheduler
+        server = DetServer(record=False)
+        qos = QosParams(enabled=True, max_queued=max(
+            1, max_queued // max(1, replicas)))
+        lease = LeaseParams(grace_s=120.0, floor_s=60.0,
+                            queue_alarm_s=0.0)
+        kw = dict(lease=lease, cache=CacheParams(enabled=False), qos=qos,
+                  recv_batch=recv_batch, trace_sample=trace_sample)
+        if replicas > 1:
+            coord = ReplicaSet(server, replicas, **kw)
+        else:
+            coord = Scheduler(server, **kw)
+        coord_task = asyncio.create_task(coord.run())
+        miner_tasks = [asyncio.create_task(
+            _fake_miner(server.connect(), trace_spans=True))
+            for _ in range(miners)]
+        # Let the JOINs land before the storm.
+        for _ in range(4):
+            await asyncio.sleep(0)
+        latencies: list = []
+        sheds: list = []
+        cpu0 = time.process_time()
+        t0 = time.monotonic()
+        tenant_tasks = [asyncio.create_task(
+            _tenant(server.connect(), f"t{t}", requests_per_tenant,
+                    req_nonces, latencies, sheds))
+            for t in range(tenants)]
+        try:
+            await asyncio.wait_for(asyncio.gather(*tenant_tasks),
+                                   timeout_s)
+            timed_out = False
+        except asyncio.TimeoutError:
+            timed_out = True
+        makespan = time.monotonic() - t0
+        cpu_s = time.process_time() - cpu0
+        for task in tenant_tasks + miner_tasks + [coord_task]:
+            task.cancel()
+        total = tenants * requests_per_tenant
+        completed = len(latencies)
+        latencies.sort()
+
+        def pct(q: float):
+            if not latencies:
+                return None
+            return round(latencies[min(len(latencies) - 1,
+                                       int(q * len(latencies)))], 4)
+
+        out = {
+            "tenants": tenants,
+            "replicas": replicas,
+            "miners": miners,
+            "requests": total,
+            "completed": completed,
+            "shed_tenants": len(sheds),
+            "shed_rate": round(1 - completed / total, 4) if total else 0.0,
+            "makespan_s": round(makespan, 3),
+            "admitted_per_s": round(completed / makespan, 1)
+            if makespan > 0 else None,
+            "p50_s": pct(0.50),
+            "p99_s": pct(0.99),
+            "cpu_s_per_request": round(cpu_s / completed, 6)
+            if completed else None,
+            "trace": _trace_summary(coord, replicas),
+        }
+        if timed_out:
+            out["timed_out"] = True
+        return out
+
+    return asyncio.run(leg())
+
+
+def _trace_summary(coord, replicas: int) -> dict:
+    """Per-phase medians over the (sampled) traces of a finished leg —
+    the same shape as ``bench._Cluster.trace_summary`` so ``detail.load``
+    artifacts decompose like the other storm probes'."""
+    sched_queue, phases = [], {}
+    traces = coord.traces.items()
+    for _key, t in traces:
+        events = t.to_dict()["events"]
+        enq = next((e for e in events if e["event"] == "enqueue"), None)
+        disp = next((e for e in events if e["event"] == "dispatch"), None)
+        if enq is not None and disp is not None:
+            sched_queue.append(disp["t"] - enq["t"])
+        for e in events:
+            if e["event"] != "miner_span":
+                continue
+            for ph in SPAN_PHASES:
+                v = e.get(ph)
+                if isinstance(v, (int, float)):
+                    phases.setdefault(ph, []).append(float(v))
+    out = {"sampled_traces": len(traces)}
+    if sched_queue:
+        out["sched_queue_s_p50"] = round(median(sched_queue), 6)
+    for ph, xs in sorted(phases.items()):
+        out[f"miner_{ph}_p50"] = round(median(xs), 6)
+    return out
+
+
+def load_curve(points, replica_counts=(1, 4), rounds: int = 2,
+               **kw) -> dict:
+    """The BENCH load curve: for each tenant count in ``points`` and
+    each replica count, run ``rounds`` interleaved order-swapped legs
+    (the repo's storm-probe noise discipline) and report medians.
+
+    Returns ``{"points": [{"tenants": N, "r<k>": {...medians...}}, ...],
+    "samples": [...]}``.
+    """
+    samples = []
+    curve = []
+    for tenants in points:
+        entry: dict = {"tenants": tenants}
+        per_rep: dict = {n: [] for n in replica_counts}
+        for rnd in range(max(1, rounds)):
+            order = (list(replica_counts) if rnd % 2 == 0
+                     else list(reversed(replica_counts)))
+            for n in order:
+                leg = run_load(tenants=tenants, replicas=n, **kw)
+                per_rep[n].append(leg)
+                samples.append(leg)
+        for n, legs in per_rep.items():
+            med = {}
+            for key in ("makespan_s", "admitted_per_s", "p50_s", "p99_s",
+                        "cpu_s_per_request", "shed_rate"):
+                vals = [leg[key] for leg in legs
+                        if leg.get(key) is not None]
+                med[key] = round(median(vals), 6) if vals else None
+            med["completed"] = legs[0]["completed"]
+            med["trace"] = legs[-1]["trace"]
+            entry[f"r{n}"] = med
+        curve.append(entry)
+    return {"points": curve, "samples": samples}

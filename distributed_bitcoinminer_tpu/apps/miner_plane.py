@@ -1,0 +1,654 @@
+"""Miner plane: pool membership, leases, striping, dispatch execution.
+
+One half of the ISSUE 11 plane split. ``apps/scheduler.py`` grew ~9 PRs
+of features into one 1.8k-line class; this module owns everything
+MINER-FACING — the pool roster and per-miner pending FIFOs, the lease
+plane (EWMA-sized leases, speculative re-issue, quarantine, the
+position-aware FIFO clock), the stripe planner, parked-chunk recovery,
+the windowed throughput sampler and pool EWMA, the QoS capacity pool,
+and the coalescing-window slot logic — while ``apps/tenant_plane.py``
+owns the tenant-facing half and the :class:`~.scheduler.Scheduler`
+keeps only the request state machine (merge rules, barriers) and the
+pump that joins the two.
+
+The planes are joined by an EXPLICIT internal interface, so each side
+is independently testable (tests/test_plane_split.py drives this plane
+with stub callbacks) and replicable (apps/replicas.py instantiates N
+scheduler replicas, each owning a miner-pool slice):
+
+- **grant** — :meth:`MinerPlane.assign_chunk`: the scheduler (having
+  decided WHO via the tenant plane's DRR) hands one chunk to one miner;
+  the plane stamps the lease, appends to the miner's pending FIFO, and
+  writes the wire Request through the injected ``write`` callback.
+- **complete** — :meth:`MinerPlane.pop_result`: an arriving Result pops
+  the miner's oldest pending chunk (in-order exactly-once LSP makes the
+  k-th Result answer the k-th Request), feeds the throughput window,
+  starts the next chunk's lease, absorbs parked work — and returns the
+  ``(miner, chunk)`` pair for the scheduler to MERGE. The plane never
+  touches merge state.
+- **lease-event** — the injected ``lease_event(kind, chunk, miner, ...)``
+  callback: every lease-plane state transition (``blown``, ``reissue``,
+  ``quarantine``, ``quarantine_lifted``, ``park``) is reported upward
+  for the scheduler's trace/flight/log fanout, keeping observability
+  (tenant-plane concern) out of the mechanics. Events fire in
+  transition order — ``blown`` strictly before any ``reissue`` of the
+  same chunk, ``quarantine`` only after its triggering ``blown``.
+
+State here is exactly the miner-side slice the old monolith kept:
+``miners`` (join order), ``parked``, the pool-rate EWMA, and the metric
+series those feed. The scheduler re-exports ``Chunk``/``MinerState``
+for compatibility.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..bitcoin.message import new_request
+from ..utils import trace as _tracing
+from ..utils.config import CoalesceParams, LeaseParams, StripeParams
+from ..utils.metrics import LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS, Registry
+
+logger = logging.getLogger("dbm.scheduler")
+
+__all__ = ["Chunk", "MinerState", "MinerPlane"]
+
+
+@dataclass
+class Chunk:
+    job_id: int
+    data: str
+    lower: int
+    upper: int              # exclusive end, as sent on the wire
+    target: int = 0         # difficulty target; rides every (re)assignment
+    idx: int = 0            # position in the request's ascending chunk order
+    # Set when the requesting client drops: the chunk stays in the miner's
+    # pending FIFO (its Result must still pop in order) but no longer
+    # counts against the miner's availability.
+    cancelled: bool = False
+    # Lease plane. Each FIFO entry is one ASSIGNMENT: a speculative
+    # re-issue pushes a fresh Chunk object (same job/idx/range) onto the
+    # takeover miner's FIFO with its own lease, while the blown original
+    # stays in its miner's FIFO awaiting the in-order pop.
+    assigned_at: float = 0.0   # monotonic stamp; reset when the lease starts
+    deadline: float = 0.0      # lease expiry (monotonic)
+    # Position-aware lease clock (fifo_aware): False until the chunk
+    # reaches the head of its miner's FIFO. Until then the deadline is a
+    # BUDGET covering the predecessors too; at the head it is re-stamped
+    # to the tight single-chunk lease.
+    lease_started: bool = False
+    lease_blown: bool = False  # expiry observed (counted once per entry)
+    reissued: bool = False     # a speculative copy is already in flight
+    # Coalescing grant hint (ISSUE 9): chunks sharing a coalesce_id were
+    # granted into one miner's coalescing window — they may share a
+    # device launch, and they count as ONE live chunk against the QoS
+    # depth cap (miner_live). None = stock accounting. A speculative
+    # re-issue copy never inherits the id (fresh Chunk): the takeover
+    # miner runs it solo.
+    coalesce_id: Optional[int] = None
+
+    @property
+    def size(self) -> int:
+        """Nonce count the miner actually scans (``Upper`` read inclusive —
+        the reference bound quirk, see the scheduler module docstring)."""
+        return self.upper - self.lower + 1
+
+
+@dataclass
+class MinerState:
+    conn_id: int
+    # Every Request written to this miner, in write order (see the
+    # scheduler module docstring's bookkeeping-divergence note).
+    pending: list = field(default_factory=list)
+    # Lease plane: observed per-chunk throughput (nonces/sec EWMA; None
+    # until the first Result), consecutive blown leases, and the
+    # quarantine latch (set at quarantine_after blown leases, cleared by
+    # any Result pop from this miner).
+    rate_ewma: Optional[float] = None
+    blown_streak: int = 0
+    quarantined: bool = False
+    # Windowed throughput sampling (ISSUE 5; see observe_result): the
+    # wall-clock window currently accumulating answered nonces. Per-pop
+    # size/elapsed sampling is a lie under the pipelined miner — a
+    # prefetched chunk's Result lands ~1ms after its lease re-stamp and
+    # reads as 10^9 nonces/s.
+    win_t0: float = 0.0
+    win_nonces: int = 0
+
+    @property
+    def available(self) -> bool:
+        """Derived, not stored (ADVICE r2): a miner is available iff it has
+        no LIVE pending chunk. Cancelled chunks still occupy the FIFO (their
+        stale Results pop in order) without blocking new assignments."""
+        return not any(not c.cancelled for c in self.pending)
+
+
+class MinerPlane:
+    """The miner-facing half of the scheduler (see module docstring).
+
+    Injected callbacks (the internal interface's upward edges):
+
+    - ``write(conn_id, msg)`` — wire write (the scheduler's LSP write
+      with its awaiting-drop error swallow);
+    - ``inflight`` — the scheduler's live ``{job_id: Request}`` mapping
+      (read-only here: the sweep skips answered chunks, recovery skips
+      retired jobs);
+    - ``trace_get(job_id)`` — the request trace to record ``assign``
+      events on (None when unsampled/unknown);
+    - ``lease_event(kind, chunk, miner_conn, **info)`` — lease-plane
+      transition fanout (trace/flight/log live scheduler-side);
+    - ``dispatch()`` — re-enter the scheduler pump (quarantine lift
+      frees capacity mid-pop, exactly like the monolith did).
+    """
+
+    #: Wall-clock span one throughput sample must cover (window-union
+    #: accounting, the scheduler-side analog of the miner's
+    #: _ThroughputWindow from ISSUE 4).
+    RATE_WINDOW_S = 0.5
+
+    def __init__(self, metrics: Registry, count: Callable[..., None],
+                 lease: LeaseParams, stripe: StripeParams,
+                 coalesce: CoalesceParams, *,
+                 write: Callable, inflight: dict, trace_get: Callable,
+                 lease_event: Callable, dispatch: Callable,
+                 trace_on: bool = False):
+        self.metrics = metrics
+        self._count = count
+        self.lease = lease
+        self.stripe = stripe
+        self.coalesce = coalesce
+        self._write = write
+        self._inflight = inflight
+        self._trace_get = trace_get
+        self._lease_event = lease_event
+        self._dispatch = dispatch
+        self._trace_on = trace_on
+        self.miners: list[MinerState] = []      # join order, like minersArray
+        self._by_conn: dict[int, MinerState] = {}   # O(1) lookup (ISSUE 11)
+        self.parked: list[Chunk] = []           # chunks of dropped miners
+        self.pool_rate: Optional[float] = None  # pool-wide throughput EWMA
+        self._next_coalesce_id = 0
+        self._pool_size = metrics.gauge("pool_size")
+        self._pool_quarantined = metrics.gauge("pool_quarantined")
+        self._lease_min_remaining = metrics.gauge("lease_min_remaining_s")
+        self._lease_wait = metrics.histogram("lease_wait_s",
+                                             LATENCY_BUCKETS_S)
+        # Striping plane (dispatch pipeline): chunks per miner share.
+        self._stripe_depth = metrics.histogram("stripe_chunks_per_share",
+                                               OCCUPANCY_BUCKETS)
+
+    # ------------------------------------------------------------- roster
+
+    def update_pool_gauges(self) -> None:
+        self._pool_size.set(len(self.miners))
+        self._pool_quarantined.set(
+            sum(1 for m in self.miners if m.quarantined))
+
+    def find_miner(self, conn_id: int) -> Optional[MinerState]:
+        return self._by_conn.get(conn_id)
+
+    def on_join(self, conn_id: int) -> MinerState:
+        """A joining miner immediately absorbs one parked chunk, if any
+        (ref: server.go:222-244)."""
+        miner = MinerState(conn_id=conn_id)
+        chunk = self.next_parked()
+        if chunk is not None:
+            self.assign_chunk(miner, chunk, kind="parked")
+        self.miners.append(miner)
+        self._by_conn[conn_id] = miner
+        self.update_pool_gauges()
+        return miner
+
+    def adopt_miner(self, conn_id: int, pending: Optional[list] = None,
+                    rate_ewma: Optional[float] = None) -> MinerState:
+        """Replica lease takeover (apps/replicas.py): adopt a miner that
+        a DEAD replica owned. Its still-pending chunk records arrive
+        marked CANCELLED — the miner will answer them in order and each
+        pops here as stale, preserving the k-th-Result-answers-k-th-
+        Request discipline across the ownership change — and its
+        observed throughput EWMA carries over so lease sizing stays
+        warm. The adopting replica assigns NEW chunks behind the dead
+        ones."""
+        miner = MinerState(conn_id=conn_id, rate_ewma=rate_ewma)
+        for chunk in pending or []:
+            chunk.cancelled = True
+            miner.pending.append(chunk)
+        self.miners.append(miner)
+        self._by_conn[conn_id] = miner
+        self.update_pool_gauges()
+        return miner
+
+    def drop_miner(self, conn_id: int) -> Optional[MinerState]:
+        """Remove a dropped miner and retire its labeled series; the
+        caller (scheduler) recovers its chunks via :meth:`recover`."""
+        miner = self._by_conn.pop(conn_id, None)
+        if miner is None:
+            return None
+        self.miners.remove(miner)
+        self.update_pool_gauges()
+        # Retire the dead conn-id's labeled series: stale values must
+        # not linger in snapshots, and reconnect churn (every rejoin
+        # is a fresh conn id) must not exhaust the family cardinality
+        # bound over a long server life.
+        self.metrics.remove("miner_rate_nps", miner=str(conn_id))
+        self.metrics.remove("lease_remaining_s", miner=str(conn_id))
+        return miner
+
+    def recover(self, miner: MinerState) -> None:
+        """Recover every unanswered chunk of a dropped miner
+        (ref: server.go:326-376, single-chunk version). Chunks whose idx
+        already merged (speculation winner landed first) and chunks with
+        a live speculative copy in another FIFO need no recovery — the
+        copy is tracked independently."""
+        for chunk in miner.pending:
+            req = self._inflight.get(chunk.job_id)
+            if req is None or chunk.cancelled:
+                continue
+            if req.answered[chunk.idx] or chunk.reissued:
+                continue
+            takeover = next((m for m in self.eligible()), None)
+            if takeover is not None:
+                self.assign_chunk(takeover, chunk, kind="recovered")
+            else:
+                self.parked.append(chunk)
+                self._lease_event("park", chunk, miner.conn_id)
+
+    # ----------------------------------------------------------- selection
+
+    def next_parked(self, skip_key=None) -> Optional[Chunk]:
+        """Pop the next parked chunk that still NEEDS executing, discarding
+        stale ones: a parked chunk whose idx was meanwhile answered by a
+        speculation winner (its copy blew a lease, was re-issued, and the
+        re-issue landed first) — or whose ``(job_id, idx)`` matches
+        ``skip_key``, the assignment the caller is answering right now —
+        would only burn a full scan to pop as a duplicate."""
+        while self.parked:
+            chunk = self.parked.pop(0)
+            req = self._inflight.get(chunk.job_id)
+            if req is None or req.answered[chunk.idx]:
+                continue
+            if skip_key is not None and \
+                    (chunk.job_id, chunk.idx) == skip_key:
+                continue
+            return chunk
+        return None
+
+    def eligible(self) -> list[MinerState]:
+        """Miners that may take new work: available and not quarantined."""
+        return [m for m in self.miners
+                if m.available and not m.quarantined]
+
+    def desperation_pool(self) -> list[MinerState]:
+        """Last-resort pool when the WHOLE pool is quarantined: the
+        least-bad available quarantined miner (lowest blown streak, then
+        highest observed throughput), or nothing. Any non-quarantined
+        miner — even a busy one that will free up — disables desperation:
+        waiting for a healthy miner beats feeding a known-bad one."""
+        if not self.lease.desperation or not self.miners:
+            return []
+        if not all(m.quarantined for m in self.miners):
+            return []
+        avail = [m for m in self.miners if m.available]
+        if not avail:
+            return []
+        return [min(avail, key=lambda m: (m.blown_streak,
+                                          -(m.rate_ewma or 0.0)))]
+
+    def miner_live(self, miner: MinerState) -> int:
+        """Live (non-cancelled) chunks in a miner's pending FIFO, with
+        a coalescing window's chunks counting as ONE (they share one
+        device launch on the miner — ISSUE 9): the QoS depth cap bounds
+        launches in flight, not rows per launch."""
+        n = 0
+        groups = set()
+        for c in miner.pending:
+            if c.cancelled:
+                continue
+            if c.coalesce_id is None:
+                n += 1
+            else:
+                groups.add(c.coalesce_id)
+        return n + len(groups)
+
+    def capacity_pool(self, depth: int) -> list[MinerState]:
+        """Miners that may take an incremental QoS chunk: not
+        quarantined, below the per-miner live-FIFO cap, and not sitting
+        on a blown-lease chunk (a wedged miner's blown original stays
+        live in its FIFO awaiting the in-order pop — the stock path's
+        ``available`` never feeds such a miner either, and a mouse
+        granted behind it would stall a full lease period), least-loaded
+        first (ties keep join order — the reference's assignment
+        order)."""
+        pool = [m for m in self.miners
+                if not m.quarantined and self.miner_live(m) < depth
+                and not any(c.lease_blown and not c.cancelled
+                            for c in m.pending)]
+        pool.sort(key=self.miner_live)
+        return pool
+
+    # ------------------------------------------------- coalescing windows
+
+    def coalescible_cost(self, target: int, cost: int) -> bool:
+        """May a grant of ``cost`` nonces (difficulty ``target``) enter
+        a coalescing window? Argmin mode only, and SMALL twice over: an
+        absolute nonce bound (``max_nonces``) and an estimated-seconds
+        bound at the pool rate (``small_s``) — only a chunk whose scan
+        is launch-overhead-scale belongs in a shared launch; an absolute
+        bound alone would misclassify a slow pool's rate-scaled
+        elephant chunks as mice and serialize the elephant onto one
+        miner's window."""
+        if not self.coalesce.enabled or target \
+                or cost > self.coalesce.max_nonces:
+            return False
+        rate = self.pool_rate
+        if rate is not None and rate > 0:
+            return cost <= rate * self.coalesce.small_s
+        return True
+
+    def window_slot(self, window: dict, job_id: int):
+        """The first open coalescing-window slot that can take a chunk
+        of ``job_id``: a free lane, NOT already holding this job
+        (windows batch across requests; stacking one request's own
+        chunks would just re-merge what the chunk planner split), on a
+        live non-quarantined miner. Returns ``(miner, slot)`` or
+        ``(None, None)``. ONE definition shared by pump candidacy
+        (:meth:`window_room`) and the grant itself — if the two
+        drifted, the pump could admit a candidate the grant cannot
+        place and spin (code review, PR 8)."""
+        for conn_id, slot in window.items():
+            if slot[1] >= self.coalesce.lanes or job_id in slot[2]:
+                continue
+            m = self.find_miner(conn_id)
+            if m is not None and not m.quarantined:
+                return m, slot
+        return None, None
+
+    def window_room(self, window: dict, job_id: int = 0) -> bool:
+        """Any joinable window for ``job_id``? (See :meth:`window_slot`.)"""
+        if not window:
+            return False
+        return self.window_slot(window, job_id)[0] is not None
+
+    def open_window(self, window: dict, miner: MinerState,
+                    job_id: int) -> int:
+        """Open a fresh window on ``miner`` for this pump pass; returns
+        the new coalesce id."""
+        self._next_coalesce_id += 1
+        cid = self._next_coalesce_id
+        window[miner.conn_id] = [cid, 1, {job_id}]
+        return cid
+
+    # ------------------------------------------------------------ striping
+
+    def stripe_chunks(self, miner: MinerState, share: int) -> int:
+        """Chunk count for one miner's share: ``ceil(share / (rate *
+        chunk_s))`` capped at ``stripe.depth``. 1 (the stock even split)
+        when striping is off, the share is trivial, or no throughput has
+        been observed yet — a cold pool's first request is always
+        bit-identical to the reference split, so the parity/conformance
+        shape needs no knob to reproduce."""
+        if not self.stripe.enabled or share <= 1:
+            return 1
+        rate = miner.rate_ewma if miner.rate_ewma is not None \
+            else self.pool_rate
+        if rate is None or rate <= 0:
+            return 1
+        target = max(1, int(rate * self.stripe.chunk_s))
+        return max(1, min(self.stripe.depth, -(-share // target)))
+
+    def observe_stripe(self, n_chunks: int) -> None:
+        self._stripe_depth.observe(n_chunks)
+
+    # ------------------------------------------------------ grant/complete
+
+    def assign_chunk(self, miner: MinerState, chunk: Chunk,
+                     kind: str = "initial") -> None:
+        """GRANT edge of the internal interface: one chunk onto one
+        miner's pending FIFO, lease stamped, wire Request written."""
+        chunk.assigned_at = time.monotonic()
+        chunk.lease_blown = False
+        chunk.reissued = False
+        chunk.lease_started = False
+        chunk.deadline = 0.0
+        miner.pending.append(chunk)
+        # Position-aware lease clock (see the scheduler docstring): a
+        # chunk at the FIFO head starts its tight lease now; one
+        # assigned behind other entries gets a BUDGET deadline (latest
+        # predecessor expiry + its own lease) that is tightened when it
+        # reaches the head (pop_result) — so a deep healthy FIFO never
+        # blows spuriously, but a FIFO wedged at its head still expires.
+        # fifo_aware=False restores the at-assignment clock.
+        if not self.lease.fifo_aware or len(miner.pending) == 1:
+            self.start_lease(miner, chunk)
+        else:
+            now = chunk.assigned_at
+            ahead = max((c.deadline for c in miner.pending[:-1]),
+                        default=now)
+            chunk.deadline = max(now, ahead) + self.lease_for(miner, chunk)
+        trace = self._trace_get(chunk.job_id)
+        if trace is not None:
+            trace.event("assign", miner=miner.conn_id, idx=chunk.idx,
+                        lower=chunk.lower, upper=chunk.upper, kind=kind,
+                        fifo_pos=len(miner.pending) - 1,
+                        lease_started=chunk.lease_started)
+        if self._trace_on:
+            _tracing.flight("assign", job=chunk.job_id, idx=chunk.idx,
+                            miner=miner.conn_id, kind=kind)
+        self._write(miner.conn_id,
+                    new_request(chunk.data, chunk.lower, chunk.upper,
+                                chunk.target))
+
+    def pop_result(self, conn_id: int):
+        """COMPLETE edge: an arriving Result pops the miner's oldest
+        pending chunk. Feeds the throughput window, starts the next
+        FIFO entry's lease, absorbs one parked chunk when freed —
+        returns ``(miner, chunk)`` for the scheduler to merge, or None
+        when the conn is no miner / has nothing pending."""
+        miner = self.find_miner(conn_id)
+        if miner is None or not miner.pending:
+            return None
+        chunk = miner.pending.pop(0)   # the Result answers the oldest Request
+        self.observe_result(miner, chunk)
+        # Position-aware leases: the next FIFO entry is what the miner
+        # computes now — start its clock (no-op when already started, i.e.
+        # fifo_aware off or it was assigned to an empty FIFO).
+        if miner.pending and not miner.pending[0].lease_started:
+            self.start_lease(miner, miner.pending[0])
+        # A freed miner immediately absorbs one parked chunk
+        # (ref: server.go:285-304) — BEFORE the scheduler's stale-Result
+        # return, so a miner freed by a stale answer still rescues parked
+        # work. The just-popped (job, idx) is excluded: this very Result
+        # is about to answer it, so a parked speculative copy of it is
+        # garbage — not work to hand back to the miner that just did it.
+        if self.parked and miner.available:
+            parked = self.next_parked(skip_key=(chunk.job_id, chunk.idx))
+            if parked is not None:
+                self.assign_chunk(miner, parked, kind="parked")
+        return miner, chunk
+
+    # --------------------------------------------------------- lease plane
+
+    def start_lease(self, miner: MinerState, chunk: Chunk) -> None:
+        """Start the lease clock: the miner is (about to be) computing this
+        chunk. ``assigned_at`` is re-stamped so both the expiry log and the
+        throughput sample measure actual compute time, not FIFO wait."""
+        now = time.monotonic()
+        if chunk.assigned_at:
+            self._lease_wait.observe(now - chunk.assigned_at)
+        chunk.assigned_at = now
+        chunk.deadline = now + self.lease_for(miner, chunk)
+        chunk.lease_started = True
+
+    def observe_result(self, miner: MinerState, chunk: Chunk) -> None:
+        """Per-pop bookkeeping: throughput sampling, streak reset,
+        quarantine lift. Runs for EVERY pop — stale and cancelled chunks
+        were computed too, so they are valid throughput samples, and an
+        answer is an answer for quarantine purposes ("until it answers
+        again").
+
+        Throughput is sampled over a WALL-CLOCK WINDOW per miner, not per
+        pop: the pipelined miner computes chunk k+1 while k's result is
+        in flight, so k+1's Result arrives milliseconds after its lease
+        re-stamp and a per-pop size/elapsed sample reads as 10^9
+        nonces/s — which then poisons every consumer (stripe plans grow
+        one-giant-chunk, the QoS wholesale gate misclassifies elephants,
+        leases collapse to the floor). Accumulating answered nonces until
+        ``RATE_WINDOW_S`` of wall clock has passed measures the miner's
+        true OUTPUT rate regardless of internal overlap."""
+        alpha = self.lease.ewma_alpha
+        now = time.monotonic()
+        if chunk.assigned_at and not chunk.lease_blown and not chunk.target:
+            # Two exclusions keep the sample set honest (they also RESET
+            # the window below). Blown-lease answers: a wedged miner's
+            # eventual 60s "sample" would inflate its (and the pool's)
+            # lease to minutes and blunt re-wedge detection. Difficulty
+            # chunks: an in-kernel early exit may scan 1% of the range,
+            # so size/elapsed would overestimate throughput ~100x and
+            # starve every later stock chunk's lease.
+            if miner.win_nonces == 0 \
+                    or now - miner.win_t0 > 4 * self.RATE_WINDOW_S:
+                # Fresh (or stale — an idle gap must not deflate the
+                # sample) window, anchored at this chunk's lease start.
+                miner.win_t0 = chunk.assigned_at or now
+                miner.win_nonces = 0
+            miner.win_nonces += chunk.size
+            elapsed = now - miner.win_t0
+            if elapsed >= self.RATE_WINDOW_S:
+                rate = miner.win_nonces / elapsed
+                miner.win_t0, miner.win_nonces = now, 0
+                miner.rate_ewma = rate if miner.rate_ewma is None else \
+                    alpha * rate + (1 - alpha) * miner.rate_ewma
+                self.pool_rate = rate if self.pool_rate is None else \
+                    alpha * rate + (1 - alpha) * self.pool_rate
+                self.metrics.gauge(
+                    "miner_rate_nps",
+                    miner=str(miner.conn_id)).set(miner.rate_ewma)
+                self.metrics.gauge("pool_rate_nps").set(self.pool_rate)
+        else:
+            miner.win_t0, miner.win_nonces = 0.0, 0
+        miner.blown_streak = 0
+        if miner.quarantined:
+            miner.quarantined = False
+            self.update_pool_gauges()
+            self._lease_event("quarantine_lifted", chunk, miner.conn_id)
+            self._dispatch()
+
+    def lease_for(self, miner: MinerState, chunk: Chunk) -> float:
+        """Lease duration for assigning ``chunk`` to ``miner``: headroom
+        over the EWMA-predicted scan time, clamped below; a flat grace when
+        nothing has been observed yet (cold pool)."""
+        if not self.lease.enabled:
+            return float("inf")
+        rate = miner.rate_ewma if miner.rate_ewma is not None \
+            else self.pool_rate
+        if rate is None or rate <= 0:
+            return self.lease.grace_s
+        return max(self.lease.floor_s, chunk.size / rate * self.lease.factor)
+
+    def cancel_job(self, job_id: int) -> None:
+        """Mark a retiring job's still-pending chunks cancelled (the
+        pool frees immediately; late Results pop as stale) and discard
+        its parked chunks."""
+        for m in self.miners:
+            for c in m.pending:
+                if c.job_id == job_id:
+                    c.cancelled = True
+        self.parked = [c for c in self.parked if c.job_id != job_id]
+
+    def clear_lease_gauges(self) -> None:
+        """No live leases remain: clear the remaining-lease gauges so an
+        idle system's snapshot doesn't keep reporting the retired job's
+        last sweep values as work in flight."""
+        for m in self.miners:
+            self.metrics.remove("lease_remaining_s",
+                                miner=str(m.conn_id))
+        self._lease_min_remaining.set(0.0)
+
+    def check_leases(self) -> None:
+        """One lease sweep: blow expired leases (quarantining repeat
+        offenders) and speculatively re-issue each blown chunk to an
+        eligible miner — first Result wins, the loser pops as a duplicate
+        (the scheduler's merge). A blown chunk with no taker stays watched
+        and is re-issued on a later sweep once a miner frees up or joins.
+
+        Every in-flight job is swept: the stock FIFO path has at most one,
+        but the QoS plane (ISSUE 5) runs several concurrently — a wedged
+        miner holding a mouse's chunk must blow even while an elephant's
+        chunks are also live."""
+        if not self._inflight:
+            return
+        now = time.monotonic()
+        # Per-miner MINIMUM remaining lease (a deep budgeted chunk must not
+        # mask the head chunk's imminent expiry), set after the sweep.
+        per_miner_remaining: dict[int, float] = {}
+        for miner in list(self.miners):
+            for chunk in list(miner.pending):
+                if chunk.cancelled:
+                    continue
+                curr = self._inflight.get(chunk.job_id)
+                if curr is None or curr.answered[chunk.idx]:
+                    continue
+                if not chunk.lease_blown:
+                    if now < chunk.deadline:
+                        remaining = chunk.deadline - now
+                        prev = per_miner_remaining.get(miner.conn_id)
+                        if prev is None or remaining < prev:
+                            per_miner_remaining[miner.conn_id] = remaining
+                        continue
+                    chunk.lease_blown = True
+                    self._count("leases_blown")
+                    # With the at-assignment clock (fifo_aware=False) a
+                    # chunk can blow while entries still sit AHEAD of it —
+                    # the miner never even reached it. Counted so the
+                    # position-aware fix has before/after evidence. (With
+                    # fifo_aware, a pre-head blow means the budgeted
+                    # deadline covering the predecessors ALSO ran out —
+                    # the whole pipeline is overdue, not spurious.)
+                    spurious = (not self.lease.fifo_aware
+                                and miner.pending[0] is not chunk)
+                    if spurious:
+                        self._count("leases_blown_spurious")
+                    miner.blown_streak += 1
+                    self._lease_event("blown", chunk, miner.conn_id,
+                                      streak=miner.blown_streak,
+                                      spurious=spurious,
+                                      overdue_s=now - chunk.assigned_at)
+                    if (miner.blown_streak >= self.lease.quarantine_after
+                            and not miner.quarantined):
+                        miner.quarantined = True
+                        self._count("quarantines")
+                        self.update_pool_gauges()
+                        self._lease_event("quarantine", chunk,
+                                          miner.conn_id,
+                                          streak=miner.blown_streak)
+                if chunk.reissued:
+                    continue
+                takeover = next(
+                    (m for m in self.eligible() if m is not miner), None)
+                if takeover is None:
+                    continue   # retry next sweep
+                chunk.reissued = True
+                self._count("reissues")
+                self._lease_event("reissue", chunk, miner.conn_id,
+                                  to_miner=takeover.conn_id)
+                self.assign_chunk(
+                    takeover,
+                    Chunk(chunk.job_id, chunk.data, chunk.lower,
+                          chunk.upper, target=chunk.target, idx=chunk.idx),
+                    kind="reissue")
+        # Miners with no live unexpired lease this sweep (blown, answered,
+        # or idle) lose their series: a stale positive "remaining" on a
+        # blown lease would read as healthy headroom.
+        for m in self.miners:
+            if m.conn_id not in per_miner_remaining:
+                self.metrics.remove("lease_remaining_s",
+                                    miner=str(m.conn_id))
+        for conn_id, remaining in per_miner_remaining.items():
+            self.metrics.gauge("lease_remaining_s",
+                               miner=str(conn_id)).set(remaining)
+        self._lease_min_remaining.set(
+            min(per_miner_remaining.values()) if per_miner_remaining
+            else 0.0)

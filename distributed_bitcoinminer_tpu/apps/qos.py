@@ -131,16 +131,36 @@ class QosPlane:
     #: TenantState, but a pick must terminate even on corrupted state.
     MAX_PASSES = 1024
 
+    #: Every this-many sweeps the share gauges get a FULL refresh; in
+    #: between only tenants granted since the last sweep (the dirty
+    #: set) are re-set — the O(tenants) per-sweep gauge walk was a
+    #: 10k-tenant melt point (ISSUE 11), and an idle tenant's share
+    #: gauge going stale against a grown total for a bounded number of
+    #: sweeps is an accepted observability trade (the dump/alarm paths
+    #: compute shares directly, never from the gauge).
+    FULL_REFRESH_SWEEPS = 32
+
     def __init__(self, metrics: Registry,
                  clock: Callable[[], float] = time.monotonic):
         self.metrics = metrics
         self._clock = clock
         self.tenants: Dict[object, TenantState] = {}
-        self.ring: deque = deque()        # active tenant ids, DRR order
+        # DRR ring: BACKLOGGED tenants only (ISSUE 11). The ring used to
+        # hold every known tenant, so each pick's walk rotated past the
+        # whole idle population — O(tenants) per grant at 10k tenants.
+        # Membership now tracks backlog (sync_backlog + pick's candidate
+        # ensure); the walk among backlogged tenants — and the cycle
+        # top-up sequence they observe — is unchanged, because visiting
+        # an idle tenant was always a no-op rotate.
+        self.ring: deque = deque()        # backlogged tenant ids, DRR order
+        self._in_ring: set = set()
         self.total_granted_nonces = 0
         # Tenants already topped up in the CURRENT ring cycle (classic
         # DRR adds quantum once per round, not once per missed pick).
         self._topped: set = set()
+        # Tenants granted since the last sweep (share-gauge dirty set).
+        self._dirty_shares: set = set()
+        self._sweeps = 0
         self._g_tenants = metrics.gauge("qos_tenants")
 
     # ------------------------------------------------------------- tenants
@@ -155,9 +175,45 @@ class QosPlane:
             st = TenantState(tenant, weight,
                              TokenBucket(rate, burst, self._clock))
             self.tenants[tenant] = st
-            self.ring.append(tenant)
             self._g_tenants.set(len(self.tenants))
         return st
+
+    def _ensure_ring(self, tenant) -> None:
+        if tenant not in self._in_ring:
+            self._in_ring.add(tenant)
+            self.ring.append(tenant)
+
+    def sync_backlog(self, backlogged) -> None:
+        """Reconcile ring membership with the CURRENT backlogged tenant
+        set (the scheduler computes it from its queue + ungranted
+        chunked in-flight requests at pump start). Deficits obey the
+        classic-DRR idle-time-banks-no-credit rule, enforced at BOTH
+        membership edges so it cannot be dodged: a tenant leaving the
+        ring forfeits its deficit, and one (re-)ENTERING starts from
+        zero — the scheduler's pump may legitimately early-exit without
+        syncing while a tenant sits idle (the ISSUE 11 O(1) no-op
+        exits), so exit-time zeroing alone could let credit survive an
+        unobserved idle gap (code review). The old implementation was
+        an O(all tenants) reset loop on every pump; this is O(changes),
+        and departures rebuild the deque in ONE pass rather than one
+        O(ring) ``remove`` per departing tenant."""
+        ordered = list(backlogged)     # caller order = arrival order
+        present = set(ordered)
+        gone = self._in_ring - present
+        if gone:
+            self.ring = deque(t for t in self.ring if t not in gone)
+            self._in_ring -= gone
+            self._topped -= gone
+            for tenant in gone:
+                st = self.tenants.get(tenant)
+                if st is not None:
+                    st.deficit = 0.0
+        for tenant in ordered:         # deterministic join order
+            if tenant not in self._in_ring:
+                st = self.tenants.get(tenant)
+                if st is not None:
+                    st.deficit = 0.0   # idle credit never re-enters
+                self._ensure_ring(tenant)
 
     def set_weight(self, tenant, weight: float) -> None:
         if tenant in self.tenants:
@@ -170,10 +226,13 @@ class QosPlane:
         if self.tenants.pop(tenant, None) is None:
             return
         self._topped.discard(tenant)
-        try:
-            self.ring.remove(tenant)
-        except ValueError:
-            pass
+        self._dirty_shares.discard(tenant)
+        if tenant in self._in_ring:
+            self._in_ring.discard(tenant)
+            try:
+                self.ring.remove(tenant)
+            except ValueError:
+                pass
         self.metrics.remove("qos_grant_share", tenant=str(tenant))
         self.metrics.remove("qos_granted_chunks", tenant=str(tenant))
         self._g_tenants.set(len(self.tenants))
@@ -183,10 +242,12 @@ class QosPlane:
         in-flight work), has nothing granted outstanding, and whose
         admission bucket is full (nothing left to remember). Called from
         the scheduler's sweep so a long server life stays bounded by the
-        live tenant set. Also refreshes every live tenant's grant-share
-        gauge (one O(tenants) pass per sweep tick): :meth:`on_grant`
-        only re-sets the granted tenant's gauge, so the others go stale
-        against the grown total between sweeps."""
+        live tenant set. Also refreshes grant-share gauges via
+        :meth:`_update_shares` — the DIRTY set every sweep, everyone
+        every :attr:`FULL_REFRESH_SWEEPS`-th (:meth:`on_grant` only
+        re-sets the granted tenant's gauge, so idle tenants' gauges go
+        boundedly stale against the grown total between full
+        refreshes)."""
         for tenant in [t for t, st in self.tenants.items()
                        if t not in busy and st.inflight == 0
                        and st.bucket.full]:
@@ -237,7 +298,8 @@ class QosPlane:
         if not candidates:
             return None
         for tenant in candidates:
-            self.tenant(tenant)      # ring membership for late joiners
+            self.tenant(tenant)
+            self._ensure_ring(tenant)   # ring membership for late joiners
         quantum = max(candidates.values()) or 1
         visited = 0
         for _ in range(self.MAX_PASSES * max(1, len(self.ring))):
@@ -273,6 +335,7 @@ class QosPlane:
         st.granted_chunks += 1
         st.granted_nonces += nonces
         self.total_granted_nonces += nonces
+        self._dirty_shares.add(tenant)
         self.metrics.counter("qos_granted_chunks", tenant=str(tenant)).inc()
         self.metrics.gauge("qos_grant_share", tenant=str(tenant)).set(
             st.granted_nonces / self.total_granted_nonces)
@@ -298,8 +361,20 @@ class QosPlane:
         return st.granted_nonces / self.total_granted_nonces
 
     def _update_shares(self) -> None:
+        """Refresh share gauges: the DIRTY set (tenants granted since
+        the last sweep) every sweep, everyone every
+        :attr:`FULL_REFRESH_SWEEPS`-th sweep (bounding how stale an
+        idle tenant's gauge can go against the grown total) — the
+        O(active) replacement for the old every-sweep full walk."""
         if not self.total_granted_nonces:
             return
-        for tenant, st in self.tenants.items():
+        self._sweeps += 1
+        if self._sweeps % self.FULL_REFRESH_SWEEPS == 0:
+            targets = self.tenants.items()
+        else:
+            targets = [(t, self.tenants[t]) for t in self._dirty_shares
+                       if t in self.tenants]
+        for tenant, st in targets:
             self.metrics.gauge("qos_grant_share", tenant=str(tenant)).set(
                 st.granted_nonces / self.total_granted_nonces)
+        self._dirty_shares.clear()

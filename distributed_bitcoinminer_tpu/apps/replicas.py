@@ -1,0 +1,404 @@
+"""Scheduler replica sharding: N schedulers, one socket, hashed tenants.
+
+ISSUE 11's third movement. Everything upstream of the miners used to be
+ONE scheduler draining one LSP socket; the plane split made the
+scheduler a compact request state machine over a tenant plane and a
+miner plane — this module runs N of them as REPLICAS behind one
+transport:
+
+- **Tenant sharding.** Every client conn id is consistent-hashed
+  (:class:`HashRing`, SHA-256 points, ``VNODES`` virtual nodes per
+  replica) onto one live replica. The ring's stability property — on
+  replica add/remove only ~1/N of tenants move — is what makes replica
+  membership changes cheap and is pinned by tests/test_plane_split.py.
+- **Miner-pool slices.** A joining miner is assigned to the live
+  replica with the fewest miners (balanced slices). A replica only ever
+  grants to its own slice, so per-miner FIFO discipline (the k-th
+  Result answers the k-th Request) holds per replica with no cross-
+  replica coordination.
+- **Shared replay tier.** All replicas share ONE
+  :class:`~.scheduler.ResultCache`: a tenant re-hashed to a different
+  replica (takeover, ring change) replays its finished answers in O(1)
+  instead of re-scanning — the cache key is the full request identity,
+  so the replay is sound wherever it lands.
+- **Lease takeover on replica death.** :meth:`ReplicaSet.kill` (driven
+  by the dbmcheck ``replica_takeover`` scenario and by tests) removes a
+  replica: its miners are ADOPTED by surviving replicas — their
+  still-pending chunk records ride along marked cancelled, so the
+  adopted miner's in-flight answers pop in order as stale and the FIFO
+  correspondence survives the ownership change — and its queued +
+  in-flight requests are RE-SERVED through the new ring owner.
+  Exactly-once holds because a dead replica's in-flight request never
+  replied (a replied request is not in flight), and a re-serve of an
+  already-finished retry replays from the shared cache.
+
+Job ids are partitioned per replica (disjoint ``JOB_ID_STRIDE``
+ranges): an adopted miner's late Result carries the dead replica's
+job id, which must resolve to "stale" on the adopter — never collide
+with a live job.
+
+``DBM_REPLICAS=1`` (default) means ``apps/server.py`` runs the plain
+single :class:`~.scheduler.Scheduler` — today's topology, bit-for-bit.
+In-process replicas shard the CONTROL-PLANE work (queues, pumps,
+sweeps, alarms — the 10k-tenant melt the load harness measures); the
+multi-process extension rides the same router unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+from bisect import bisect_right
+from typing import Dict, List, Optional
+
+from ..bitcoin.message import Message, MsgType, new_request
+from ..lsp.errors import LspError
+from ..utils._env import int_env as _int_env
+from ..utils.config import CacheParams
+from .scheduler import ResultCache, Scheduler
+
+logger = logging.getLogger("dbm.replicas")
+
+__all__ = ["HashRing", "ReplicaSet", "replicas_from_env"]
+
+
+def replicas_from_env() -> int:
+    """``DBM_REPLICAS`` (default 1 = the plain single scheduler)."""
+    return max(1, _int_env("DBM_REPLICAS", 1))
+
+
+class HashRing:
+    """Consistent hash ring over replica ids.
+
+    ``VNODES`` virtual points per replica (SHA-256 of ``"r{id}:{v}"``)
+    smooth the partition; a key maps to the first point clockwise.
+    Adding or removing one replica moves only the key ranges adjacent
+    to its points — ~1/N of tenants — and every key not owned by the
+    changed replica keeps its owner (the stability contract the
+    takeover path and the plane-split tests rely on).
+    """
+
+    VNODES = 64
+
+    def __init__(self, replica_ids: List[int]):
+        self.replica_ids = list(replica_ids)
+        points = []
+        for rid in self.replica_ids:
+            for v in range(self.VNODES):
+                points.append((self._point(f"r{rid}:{v}"), rid))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [rid for _, rid in points]
+
+    @staticmethod
+    def _point(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(key.encode()).digest()[:8], "big")
+
+    def owner(self, key) -> int:
+        """The replica id owning ``key`` (any hashable; conn ids here)."""
+        if not self._hashes:
+            raise ValueError("empty ring")
+        h = self._point(f"t:{key}")
+        i = bisect_right(self._hashes, h)
+        if i == len(self._hashes):
+            i = 0
+        return self._owners[i]
+
+
+class ReplicaSet:
+    """N scheduler replicas behind one transport (see module docstring).
+
+    Owns the read loop: classifies each conn (JOIN ⇒ miner, routed to
+    the thinnest slice; anything else ⇒ tenant, routed by the ring) and
+    feeds the owning replica's event handlers directly. Each replica
+    runs its own sweep task at its lease tick.
+    """
+
+    #: Disjoint job-id range per replica (see module docstring).
+    JOB_ID_STRIDE = 1 << 40
+
+    def __init__(self, server, n: Optional[int] = None, *,
+                 lease=None, cache: Optional[CacheParams] = None,
+                 stripe=None, qos=None, coalesce=None, clock=None,
+                 recv_batch: Optional[int] = None,
+                 trace_sample: Optional[float] = None):
+        self.server = server
+        self.n = n if n is not None else replicas_from_env()
+        cache = cache if cache is not None else CacheParams()
+        #: The shared replay tier (None when caching is disabled).
+        self.shared_cache: Optional[ResultCache] = (
+            ResultCache(cache.size) if cache.enabled else None)
+        self.replicas: Dict[int, Scheduler] = {}
+        for rid in range(self.n):
+            sched = Scheduler(
+                server, lease=lease, cache=cache, stripe=stripe, qos=qos,
+                coalesce=coalesce, clock=clock,
+                result_cache=self.shared_cache, recv_batch=recv_batch,
+                trace_sample=trace_sample)
+            sched._next_job_id = rid * self.JOB_ID_STRIDE
+            self.replicas[rid] = sched
+        self.live: List[int] = list(range(self.n))
+        self._miner_owner: Dict[int, int] = {}
+        # Sticky tenant routing (found in a live drive): the hash ring
+        # spans SERVING replicas — live AND holding at least one miner —
+        # so a pool smaller than the replica count cannot strand
+        # tenants on a miner-less replica (their requests would queue
+        # into the age alarm forever while capacity sat idle next
+        # door). The serving set changes as miners join/drop, so a
+        # tenant's owner is PINNED at first request (per-tenant FIFO
+        # must stay on one replica) and re-resolved only when its
+        # replica leaves the live set; the pin map is GC'd against the
+        # owners' active-tenant state so dead conn ids cannot grow it
+        # without bound.
+        self._tenant_owner: Dict[int, int] = {}
+        self._serving: Optional[List[int]] = None
+        self._route_ring: Optional[HashRing] = None
+        self._routes_since_gc = 0
+        self._sweep_tasks: Dict[int, asyncio.Task] = {}
+        self._recv_batch = max(1, recv_batch if recv_batch is not None
+                               else _int_env("DBM_RECV_BATCH", 64))
+        self._read_nowait = getattr(server, "read_nowait", None)
+
+    # ------------------------------------------------------------- routing
+
+    #: Tenant-pin map GC cadence, in REQUEST routes.
+    ROUTE_GC_EVERY = 4096
+
+    @property
+    def ring(self) -> HashRing:
+        """The current routing ring (serving replicas; see
+        :meth:`owner_of`)."""
+        return self._routing_ring()
+
+    def _routing_ring(self) -> HashRing:
+        # No miners ANYWHERE: route every tenant to the FIRST live
+        # replica — the same replica the next JOIN will land on (the
+        # thinnest-slice rule breaks ties by live order), so pre-miner
+        # pins point exactly where capacity will first appear instead
+        # of scattering tenants onto replicas that may stay minerless
+        # (code review: an all-live fallback ring stranded tenants
+        # pinned before the first JOIN).
+        serving = [rid for rid in self.live
+                   if self.replicas[rid].miners] or [self.live[0]]
+        if serving != self._serving:
+            self._serving = serving
+            self._route_ring = HashRing(serving)
+        return self._route_ring
+
+    def owner_of(self, conn_id: int) -> Scheduler:
+        """The replica owning tenant ``conn_id``: its sticky pin, or a
+        fresh consistent-hash over the serving replicas."""
+        rid = self._tenant_owner.get(conn_id)
+        if rid is None or rid not in self.live:
+            rid = self._routing_ring().owner(conn_id)
+            self._tenant_owner[conn_id] = rid
+        return self.replicas[rid]
+
+    def _gc_tenant_pins(self) -> None:
+        """Prune pins whose tenant holds NO state on its owner (not a
+        QoS tenant, nothing queued, nothing in flight): shed conns get
+        no drop event, so without this the pin map would grow one entry
+        per conn over the server's life."""
+        active: Dict[int, set] = {}
+        for rid in self.live:
+            sched = self.replicas[rid]
+            conns = set(sched.qos_plane.tenants)
+            conns.update(r.conn_id for r in sched.tenant_plane.queue)
+            conns.update(r.conn_id for r in sched._inflight.values())
+            active[rid] = conns
+        self._tenant_owner = {
+            conn: rid for conn, rid in self._tenant_owner.items()
+            if rid in active and conn in active[rid]}
+
+    def route(self, conn_id: int, payload) -> None:
+        """Feed one transport item to the owning replica."""
+        if isinstance(payload, Exception):
+            rid = self._miner_owner.pop(conn_id, None)
+            if rid is not None:
+                if rid in self.live:
+                    self.replicas[rid]._on_drop(conn_id)
+            else:
+                self.owner_of(conn_id)._on_drop(conn_id)
+                self._tenant_owner.pop(conn_id, None)
+            return
+        try:
+            msg = Message.from_json(payload)
+        except ValueError:
+            return
+        if msg.type == MsgType.JOIN:
+            # Thinnest live slice takes the new miner.
+            rid = min(self.live,
+                      key=lambda r: len(self.replicas[r].miners))
+            self._miner_owner[conn_id] = rid
+            self.replicas[rid]._on_join(conn_id)
+        elif msg.type == MsgType.RESULT:
+            rid = self._miner_owner.get(conn_id)
+            if rid is not None and rid in self.live:
+                self.replicas[rid]._on_result(conn_id, msg)
+        elif msg.type == MsgType.REQUEST:
+            self.owner_of(conn_id)._on_request(conn_id, msg)
+            self._routes_since_gc += 1
+            if self._routes_since_gc >= self.ROUTE_GC_EVERY:
+                self._routes_since_gc = 0
+                self._gc_tenant_pins()
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def run(self) -> None:
+        """Serve until the transport closes: ONE read loop (batched like
+        the single scheduler's), N replica sweeps."""
+        loop = asyncio.get_running_loop()
+        for rid in self.live:
+            self._sweep_tasks[rid] = loop.create_task(
+                self._sweep_loop(self.replicas[rid]))
+        try:
+            while True:
+                try:
+                    conn_id, payload = await self.server.read()
+                except LspError:
+                    return
+                self.route(conn_id, payload)
+                if self._recv_batch > 1 and self._read_nowait is not None:
+                    for _ in range(self._recv_batch - 1):
+                        item = self._read_nowait()
+                        if item is None:
+                            break
+                        self.route(item[0], item[1])
+        finally:
+            for task in self._sweep_tasks.values():
+                task.cancel()
+
+    async def _sweep_loop(self, sched: Scheduler) -> None:
+        while True:
+            await asyncio.sleep(sched.lease.tick_s)
+            try:
+                sched.sweep()
+            except Exception:   # noqa: BLE001 — a sweep must never die
+                logger.exception("replica sweep failed; continuing")
+
+    def kill(self, rid: int) -> None:
+        """Replica death + lease takeover (tests and the dbmcheck
+        ``replica_takeover`` scenario drive this; a production
+        multi-process tier would trigger it from a health check).
+
+        Order matters: miners are adopted FIRST (the survivors need the
+        capacity), then the dead replica's queued and in-flight requests
+        are re-served through the new ring owners. Exactly-once: the
+        dead replica never replied to a request still in its queue or
+        in-flight set, and a request it DID finish replays from the
+        shared ResultCache wherever its tenant re-hashes."""
+        if rid not in self.live:
+            raise ValueError(f"replica {rid} is not live")
+        dead = self.replicas[rid]
+        self.live.remove(rid)
+        if not self.live:
+            self.live.append(rid)
+            raise ValueError("cannot kill the last live replica")
+        # Invalidate routing state: the serving ring rebuilds lazily,
+        # and the dead replica's tenant pins re-resolve on next use.
+        self._serving = None
+        self._tenant_owner = {c: r for c, r in self._tenant_owner.items()
+                              if r != rid}
+        task = self._sweep_tasks.pop(rid, None)
+        if task is not None:
+            task.cancel()
+        # Adopt the dead replica's miners, thinnest surviving slice
+        # first. Their pending chunk records ride along CANCELLED so
+        # in-flight answers pop in order as stale on the adopter.
+        adopted = 0
+        for conn_id, owner in list(self._miner_owner.items()):
+            if owner != rid:
+                continue
+            target = min(self.live,
+                         key=lambda r: len(self.replicas[r].miners))
+            miner = dead.miner_plane.find_miner(conn_id)
+            self.replicas[target].miner_plane.adopt_miner(
+                conn_id,
+                pending=list(miner.pending) if miner else None,
+                rate_ewma=miner.rate_ewma if miner else None)
+            self._miner_owner[conn_id] = target
+            adopted += 1
+        # Re-serve the dead replica's unanswered requests through the
+        # new ring owners — via reserve_request, which charges NO
+        # admission token and triggers no overload shed (this work was
+        # already admitted once; a failover must not convert it into
+        # sheds). A dispatched request's ``upper`` was already made
+        # exclusive (+1 at load_balance) — undo it for the wire.
+        reserved = 0
+        for req in list(dead._inflight.values()) + dead.queue:
+            upper = req.upper - 1 if req.qos_mode else req.upper
+            target = self.owner_of(req.conn_id)
+            target.reserve_request(req.conn_id, new_request(
+                req.data, req.lower, upper, req.target))
+            reserved += 1
+        logger.warning(
+            "replica %d killed: %d miner(s) adopted, %d request(s) "
+            "re-served across %d survivor(s)", rid, adopted, reserved,
+            len(self.live))
+        # Wake the survivors: adopted capacity may unblock queued work.
+        for r in self.live:
+            self.replicas[r]._maybe_dispatch()
+
+    # ------------------------------------------------ aggregate views
+
+    @property
+    def stats(self) -> dict:
+        """Counter totals over EVERY replica (dead included — their
+        served requests happened)."""
+        out: dict = {}
+        for sched in self.replicas.values():
+            for k, v in sched.stats.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    @property
+    def queue(self) -> list:
+        """Queued requests across live replicas (harness/invariant
+        view)."""
+        return [r for rid in self.live for r in self.replicas[rid].queue]
+
+    @property
+    def _inflight(self) -> dict:
+        return {job: req for rid in self.live
+                for job, req in self.replicas[rid]._inflight.items()}
+
+    @property
+    def qos_plane(self):
+        return _MergedQos([self.replicas[rid] for rid in self.live])
+
+    @property
+    def traces(self):
+        return _MergedTraces([self.replicas[rid] for rid in self.live])
+
+
+class _MergedQos:
+    """Read-only merged view of live replicas' QoS planes (the dbmcheck
+    accounting invariant iterates ``tenants``)."""
+
+    def __init__(self, scheds):
+        self.tenants: dict = {}
+        for sched in scheds:
+            self.tenants.update(sched.qos_plane.tenants)
+
+
+class _MergedTraces:
+    """Read-only merged view of live replicas' trace buffers (the
+    span-closure invariant iterates ``items()``)."""
+
+    def __init__(self, scheds):
+        self._scheds = scheds
+
+    def items(self):
+        out = []
+        for sched in self._scheds:
+            out.extend(sched.traces.items())
+        return out
+
+    def get(self, key):
+        for sched in self._scheds:
+            hit = sched.traces.get(key)
+            if hit is not None:
+                return hit
+        return None

@@ -1,11 +1,33 @@
 """The scheduler: shard nonce ranges over an elastic miner pool, merge argmins.
 
 Faithful state machine of the reference coordinator
-(ref: bitcoin/server/server.go:19-403), as one asyncio actor instead of
-channel-coupled goroutines:
+(ref: bitcoin/server/server.go:19-403). Since ISSUE 11 the one ~1.8k-line
+class is SPLIT into two planes joined by an explicit internal interface,
+with this module keeping only the REQUEST STATE MACHINE — arrival,
+dispatch decisions, the merge rules and barriers, retirement — plus the
+compatibility surface every earlier PR's tests and tools drive:
 
-- FIFO request queue, ONE request in flight at a time (deliberate reference
-  simplification — no pipeline parallelism).
+- :mod:`.tenant_plane` — conn lifecycle, admission/shedding, the
+  indexed request queue, QoS/DRR state, trace buffers + sampling, and
+  the queue-age alarms;
+- :mod:`.miner_plane` — the pool roster and per-miner pending FIFOs,
+  leases (EWMA sizing, speculative re-issue, quarantine, the
+  position-aware FIFO clock), the stripe planner, parked-chunk
+  recovery, throughput windows + pool EWMA, and coalescing-window
+  slots;
+- the interface between them: **grant** (``MinerPlane.assign_chunk``),
+  **complete** (``MinerPlane.pop_result`` returning the popped
+  ``(miner, chunk)`` for this module to merge), and **lease-event**
+  (the ``blown``/``reissue``/``quarantine``/``quarantine_lifted``/
+  ``park`` callback this module turns into trace/flight/log fanout).
+  ``apps/replicas.py`` instantiates N of these schedulers as replicas,
+  each owning a miner-pool slice.
+
+Behavioral contract (unchanged through the split — dbmcheck's scenario
+pack re-proves it on every run):
+
+- FIFO request queue, ONE request in flight at a time on the stock path
+  (deliberate reference simplification — no pipeline parallelism).
 - ``load_balance``: bounds become exclusive (``upper += 1``); even split
   ``total // num_miners`` with the remainder given to the FIRST miner; when
   there are more miners than nonces, only ``total`` miners get 1-nonce chunks
@@ -14,183 +36,92 @@ channel-coupled goroutines:
   bounds but the miner treats ``Upper`` as inclusive (ref: miner.go:51-52),
   so each chunk scans one extra nonce and the system as a whole scans
   ``[0, maxNonce+1]``.
-- Request striping (ISSUE 4, ``DBM_STRIPE``; no reference analog): each
-  miner's even-split share may be subdivided into up to
-  ``StripeParams.depth`` contiguous chunks sized at
-  ``StripeParams.chunk_s`` seconds of work from its throughput EWMA, so
-  the miner's pending FIFO is deep enough for its dispatch pipeline
-  (``DBM_PIPELINE``, apps/miner.py) to overlap chunk k+1's device work
-  with chunk k's result fetch/serialize — and a blown lease or dead miner
-  forfeits one stripe chunk, not the whole share. Chunk indices still
-  ascend with nonce range globally and boundaries stay contiguous, so the
-  merge rules below (strict-less arg-min, difficulty prefix release) are
-  untouched; a cold pool (no EWMA yet) or ``DBM_STRIPE=0`` reproduces the
-  reference one-chunk-per-miner split bit-for-bit.
+- Request striping (ISSUE 4, ``DBM_STRIPE``): each miner's even-split
+  share may be subdivided into up to ``StripeParams.depth`` contiguous
+  chunks sized at ``StripeParams.chunk_s`` seconds of work from its
+  throughput EWMA; chunk indices still ascend globally, so the merge
+  rules below are untouched; a cold pool or ``DBM_STRIPE=0`` reproduces
+  the reference one-chunk-per-miner split bit-for-bit.
 - Result merge: strict ``<`` on the uint64 hash; barrier releases the Result
   to the client when every chunk of the request has been answered
   (ref: server.go:257-325).
-- Difficulty extension (no reference analog; BASELINE config 5): a Request
-  carrying ``Target`` fans out with the target on every chunk, miners
-  early-exit at their chunk's first ``hash < target`` nonce, and the merge
-  answers the lowest-nonce qualifying response — the globally first
-  qualifying nonce when every miner speaks the extension (chunks ascend
-  and each reports its chunk-first hit; a stock Target-dropping miner
-  reports a chunk arg-min instead, weakening its chunk to "a qualifying
-  nonce" — detected via the Result's target echo and surfaced in logs,
-  see ``Request.weak``). No hit anywhere degrades to the exact arg-min,
-  and stock Requests (``Target`` absent = 0) take the reference path
-  byte-for-byte.
-- Difficulty prefix release (VERDICT r4): chunks cover ascending disjoint
-  ranges, so once some chunk ``c`` reports a qualifying hit and every chunk
-  ``< c`` has answered without one, no later answer can beat it — the
-  Result is released IMMEDIATELY, without waiting for the full barrier.
-  The released job's remaining chunks are cancelled exactly like a
-  client-drop (miners free, their late Results pop as stale via the
-  job_id/FIFO machinery), so a tight target's time-to-first-hit is the
-  winning chunk's scan, not the slowest full scan. Stock arg-min requests
-  keep the reference's full barrier untouched (ref: server.go:309-324).
+- Difficulty extension + prefix release (VERDICT r4): a Request carrying
+  ``Target`` fans out with the target on every chunk; the lowest-index
+  qualifying chunk is final once every earlier chunk answered clean and
+  is released IMMEDIATELY; a stock Target-dropping miner weakens the
+  merge to "a qualifying nonce" (``Request.weak``); no hit anywhere
+  degrades to the exact arg-min.
 - Miner drop: reassign its unanswered chunks to available miners, else park
   them; parked chunks are re-issued when a miner joins or frees up
   (ref: server.go:326-376, 222-244, 285-304).
 - Client drop: the in-flight request is cancelled immediately — miners are
   freed, parked chunks cleared, the next queued request starts.
-- Robustness plane (no reference analog; PNPCoin-style lease discipline,
-  PAPERS.md arxiv 2208.12628): every assigned chunk carries a LEASE whose
-  deadline derives from its nonce-range size and an EWMA of the assigned
-  miner's observed per-chunk throughput (pool-wide EWMA, then a flat grace,
-  when unobserved). The reference's only fault trigger is the LSP
-  epoch-limit drop; a miner whose transport still heartbeats but whose
-  compute is wedged (hung device dispatch, stalled worker thread) passes
-  that check forever. On lease expiry the chunk is speculatively RE-ISSUED
-  to an available miner — first Result wins; the loser's late Result pops
-  from its FIFO as answered/stale and is dropped by the existing
-  ``job_id``/``answered[idx]`` machinery. A miner that blows
-  ``quarantine_after`` consecutive leases is QUARANTINED: excluded from new
-  assignments until it answers again (any Result pop lifts it). Leases and
-  quarantine change scheduling latency under faults only — never the
-  answer: re-issued chunks scan the same range, so the merge is idempotent.
-- Position-aware leases (ISSUE 3, closes the ROADMAP "lease-aware FIFO
-  depth" item): a miner computes its pending FIFO strictly in order, so a
-  chunk assigned BEHIND other entries (e.g. behind the cancelled chunk of
-  a dropped client that the miner is still grinding) cannot start until
-  they pop. Its initial deadline therefore BUDGETS the work ahead — the
-  latest predecessor expiry plus its own lease — and is re-stamped to the
-  tight single-chunk lease when the chunk actually reaches the FIFO head
-  (which also re-stamps ``assigned_at``, keeping the throughput EWMA
-  honest). A deep-but-healthy FIFO no longer blows leases spuriously,
-  while a FIFO wedged at its head still expires once the budget runs out
-  (never deferring forever — the flaw a pure start-at-head clock has).
-  ``LeaseParams.fifo_aware=False`` restores the at-assignment clock; with
-  it off, a lease that blows while entries sit ahead of the chunk is
-  counted in ``leases_blown_spurious`` (the before/after evidence).
-- Desperation dispatch (ISSUE 3, closes the ROADMAP open item): when the
-  ENTIRE pool is quarantined, waiting for an answer that may never come
-  serves nobody — a queued request is dispatched to the least-bad
-  available quarantined miner (lowest blown-lease streak, then highest
-  observed throughput) as a last resort, counted in
-  ``desperation_dispatch`` and logged as a structured warning. Gated by
-  ``LeaseParams.desperation``; any non-quarantined miner disables it.
+- Robustness plane (PNPCoin-style lease discipline, arXiv 2208.12628):
+  every assigned chunk carries a LEASE; expiry speculatively RE-ISSUES
+  the chunk (first Result wins, the loser pops as a stale duplicate);
+  ``quarantine_after`` consecutive blown leases QUARANTINE a miner until
+  it answers again; desperation dispatch serves a fully-quarantined pool
+  as a last resort. Leases change scheduling latency under faults only —
+  never the answer.
+- Fair-share QoS dispatch plane (ISSUE 5, ``DBM_QOS``): tenants (client
+  conn ids) are admitted through token buckets, large requests are
+  CHUNKED and granted incrementally by deficit-round-robin (grant share
+  converges to the configured weights), overload sheds the OLDEST queued
+  request by closing its conn, and the coalescing grant window
+  (ISSUE 9, ``DBM_COALESCE``) stacks small cross-request grants onto one
+  miner for a shared device launch. ``DBM_QOS=0`` reproduces stock FIFO
+  dispatch bit-for-bit.
+- Observability (ISSUE 3/10): every counter lives in a per-scheduler
+  metrics Registry mounted under ``sched.``; each SAMPLED request
+  (``DBM_TRACE_SAMPLE``, default 1.0 = every request) records a trace
+  stitched with miner-side spans, dumped on age alarms and exportable
+  as Perfetto JSON.
 
-Fair-share QoS dispatch plane (ISSUE 5, ``DBM_QOS``; no reference
-analog): the reference's one-request-in-flight FIFO lets a 2^40-range
-elephant park every later request until its last chunk merges, and
-nothing bounds intake. With QoS on, every request is keyed to a TENANT
-(its client conn id — no wire change) and dispatch runs through
-``apps/qos.py``:
-
-- Requests whose estimated scan exceeds ``QosParams.wholesale_s`` are
-  CHUNKED: split into pool-EWMA-sized chunks (``chunk_s`` seconds each,
-  at most ``max_chunks``) held centrally and granted to miners
-  incrementally — each miner's live FIFO capped at ``QosParams.depth``
-  so the rest of the pool stays grantable. Multiple requests are then in
-  flight CONCURRENTLY, their chunks interleaved across the miner pool by
-  deficit-round-robin over tenants (grant share converges to the
-  configured weights; DRR's quantum guarantee means no tenant starves).
-  Chunk indices still ascend with nonce range per request and every
-  merge rule — strict-less arg-min barrier, difficulty prefix release,
-  speculative re-issue dedup — is per-request and untouched, so answers
-  are bit-identical to the FIFO scheduler's.
-- Smaller requests (and any request on a COLD pool) dispatch WHOLESALE
-  through the stock path below, and a wholesale request in flight blocks
-  later starts exactly like the reference — so single-tenant traffic,
-  the conformance/parity shape, and everything with ``DBM_QOS=0``
-  reproduce today's FIFO dispatch order bit-for-bit.
-- Admission + shedding: a per-tenant token bucket (``rate``/``burst``)
-  sheds at arrival when drained; a total ``max_queued`` bound sheds the
-  OLDEST queued request (cancelled through the trace/cancel path, conn
-  closed) so ``submit_with_retry`` clients back off and resubmit instead
-  of hanging into their wire deadline. ResultCache replays are answered
-  BEFORE admission and are never shed — a retry storm of already-
-  answered requests burns no quota.
-- Coalescing grant hint (ISSUE 9, ``DBM_COALESCE``): within one QoS
-  pump pass, once a SMALL chunk (argmin mode, <=
-  ``CoalesceParams.max_nonces``) is granted to a miner, further small
-  grants — typically other tenants' mice, per DRR — may target the
-  same miner's COALESCING WINDOW, up to ``lanes`` chunks sharing one
-  ``coalesce_id``. Windowed chunks count as ONE live chunk against the
-  per-miner ``QosParams.depth`` cap (they will share one device
-  launch on the miner: apps/miner.py's coalescer drains them from its
-  local queue into a single batched dispatch), while per-tenant DRR
-  deficits, admission debits, in-flight accounting, leases, and every
-  merge rule stay per chunk, unchanged. The hint is what actually
-  lands N small chunks in one miner's queue at once — without it the
-  depth cap trickles mice out one-per-free-slot and the miner-side
-  coalescer has nothing to batch. ``DBM_COALESCE=0`` never opens a
-  window: grants and live accounting are bit-identical to stock.
-
-Observability plane (ISSUE 3): every counter that used to live in the
-ad-hoc ``stats`` dict is now a series in a per-scheduler metrics
-:class:`~..utils.metrics.Registry`, mounted into the process registry under
-``sched.`` so the periodic emitter and ``bench.py`` snapshots include it;
-``Scheduler.stats`` remains as a read-only dict view for tests/operators.
-Queue depth, queue-age and lease-wait histograms, per-miner throughput
-EWMA gauges, lease-remaining gauges, and the cache hit ratio ride the same
-registry. Each request additionally records a TRACE — an ordered span of
-enqueue -> dispatch -> assign/result/merge -> reply events keyed by its
-``job_id`` (no wire-format change) — retrievable via
-:meth:`Scheduler.trace` and dumped wholesale when a queue-age or in-flight
-age alarm fires, so a stalled request names the miner that wedged it and
-the re-issue that rescued it.
+Hot-path scaling (ISSUE 11, measured by ``bench.py detail.load``): the
+recv loop drains up to ``DBM_RECV_BATCH`` already-delivered messages per
+awaited read; the queue is indexed per tenant (O(1) pops/purges, O(active)
+pump scans); the DRR ring holds backlogged tenants only; the QoS pump
+early-exits without touching heads when the pool has no capacity; and
+unsampled requests skip trace allocation entirely.
 
 Bookkeeping divergence from the reference (deliberate): the reference tracks
 one recorded chunk per miner plus a positional ``responsibleMiners`` list,
-which deadlocks or double-counts in several reachable states — a parked chunk
-whose client drops stalls every later request (server.go:377-400 never
-releases the barrier); a freed miner re-assigned before flushing its previous
-Result leaks that stale Result into the new request; an idle miner dropping
-reassigns a stale chunk from an older request (server.go:339-370). Here every
+which deadlocks or double-counts in several reachable states. Here every
 Request written to a miner pushes a full chunk record onto that miner's
 pending FIFO; since miners answer sequentially over in-order exactly-once
-LSP, each arriving Result pops exactly the chunk it answers, so stale Results
-are identified precisely, and a dead miner's unanswered chunks are recovered
-individually. The observable contract (assignment order, chunk boundaries,
-merge rule, one-in-flight FIFO scheduling) is unchanged.
+LSP, each arriving Result pops exactly the chunk it answers, so stale
+Results are identified precisely, and a dead miner's unanswered chunks are
+recovered individually. The observable contract (assignment order, chunk
+boundaries, merge rule, one-in-flight FIFO scheduling) is unchanged.
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
 import logging
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..bitcoin.hash import MAX_U64
-from ..bitcoin.message import Message, MsgType, new_request, new_result
+from ..bitcoin.message import Message, MsgType, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
 from ..utils import sanitize as _sanitize
 from ..utils import trace as _tracing
+from ..utils._env import int_env as _int_env
 from ..utils.config import CacheParams, CoalesceParams, LeaseParams, \
     QosParams, StripeParams, coalesce_from_env, qos_from_env, \
     stripe_from_env
-from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS, Registry,
-                             RequestTrace, TraceBuffer, ensure_emitter,
+from ..utils.metrics import (Registry, RequestTrace, ensure_emitter,
                              registry as process_registry)
-from .qos import QosPlane
+from .miner_plane import Chunk, MinerPlane, MinerState
+from .tenant_plane import TenantPlane
 
 logger = logging.getLogger("dbm.scheduler")
+
+__all__ = ["Chunk", "MinerState", "Request", "ResultCache", "Scheduler",
+           "STAT_COUNTERS"]
 
 #: Every monotonic counter the scheduler keeps (the old ``stats`` dict keys
 #: plus the ISSUE 3 additions). ``Scheduler.stats`` is a dict view of these.
@@ -215,6 +146,10 @@ class ResultCache:
     deterministic. The one non-deterministic case — a WEAK difficulty
     merge, where a stock Target-dropping miner answered a chunk — is
     never stored (see Scheduler._finish).
+
+    Replica sharding (ISSUE 11) passes ONE instance to every replica as
+    the shared replay tier: a tenant re-hashed to a different replica
+    after a takeover replays its lost answers without re-scanning.
     """
 
     def __init__(self, size: int):
@@ -238,74 +173,6 @@ class ResultCache:
 
 
 @dataclass
-class Chunk:
-    job_id: int
-    data: str
-    lower: int
-    upper: int              # exclusive end, as sent on the wire
-    target: int = 0         # difficulty target; rides every (re)assignment
-    idx: int = 0            # position in the request's ascending chunk order
-    # Set when the requesting client drops: the chunk stays in the miner's
-    # pending FIFO (its Result must still pop in order) but no longer
-    # counts against the miner's availability.
-    cancelled: bool = False
-    # Lease plane. Each FIFO entry is one ASSIGNMENT: a speculative
-    # re-issue pushes a fresh Chunk object (same job/idx/range) onto the
-    # takeover miner's FIFO with its own lease, while the blown original
-    # stays in its miner's FIFO awaiting the in-order pop.
-    assigned_at: float = 0.0   # monotonic stamp; reset when the lease starts
-    deadline: float = 0.0      # lease expiry (monotonic)
-    # Position-aware lease clock (fifo_aware): False until the chunk
-    # reaches the head of its miner's FIFO. Until then the deadline is a
-    # BUDGET covering the predecessors too; at the head it is re-stamped
-    # to the tight single-chunk lease.
-    lease_started: bool = False
-    lease_blown: bool = False  # expiry observed (counted once per entry)
-    reissued: bool = False     # a speculative copy is already in flight
-    # Coalescing grant hint (ISSUE 9): chunks sharing a coalesce_id were
-    # granted into one miner's coalescing window — they may share a
-    # device launch, and they count as ONE live chunk against the QoS
-    # depth cap (_miner_live). None = stock accounting. A speculative
-    # re-issue copy never inherits the id (fresh Chunk): the takeover
-    # miner runs it solo.
-    coalesce_id: Optional[int] = None
-
-    @property
-    def size(self) -> int:
-        """Nonce count the miner actually scans (``Upper`` read inclusive —
-        the reference bound quirk, see module docstring)."""
-        return self.upper - self.lower + 1
-
-
-@dataclass
-class MinerState:
-    conn_id: int
-    # Every Request written to this miner, in write order (see module doc).
-    pending: list = field(default_factory=list)
-    # Lease plane: observed per-chunk throughput (nonces/sec EWMA; None
-    # until the first Result), consecutive blown leases, and the
-    # quarantine latch (set at quarantine_after blown leases, cleared by
-    # any Result pop from this miner).
-    rate_ewma: Optional[float] = None
-    blown_streak: int = 0
-    quarantined: bool = False
-    # Windowed throughput sampling (ISSUE 5; see _observe_result): the
-    # wall-clock window currently accumulating answered nonces. Per-pop
-    # size/elapsed sampling is a lie under the pipelined miner — a
-    # prefetched chunk's Result lands ~1ms after its lease re-stamp and
-    # reads as 10^9 nonces/s.
-    win_t0: float = 0.0
-    win_nonces: int = 0
-
-    @property
-    def available(self) -> bool:
-        """Derived, not stored (ADVICE r2): a miner is available iff it has
-        no LIVE pending chunk. Cancelled chunks still occupy the FIFO (their
-        stale Results pop in order) without blocking new assignments."""
-        return not any(not c.cancelled for c in self.pending)
-
-
-@dataclass
 class Request:
     conn_id: int
     data: str
@@ -322,26 +189,23 @@ class Request:
     # the lowest-INDEX qualifying chunk holds the globally first
     # qualifying nonce — final as soon as every earlier chunk has
     # answered without a hit, regardless of chunks still in flight.
-    # (A stock Target-dropping miner reports its chunk ARG-MIN, which may
-    # qualify later than its chunk's first hit, weakening the answer to
-    # "a qualifying nonce" — see client.submit_until docstring.)
     answered: list = field(default_factory=list)   # bool per chunk idx
     chunk_q: dict = field(default_factory=dict)    # idx -> (nonce, hash)
     # True once any responder answered a target chunk without echoing the
     # target (stock miner in the pool): the merged answer is then only
-    # guaranteed qualifying, not guaranteed globally first (ADVICE r4 —
-    # surfaced in logs, invisible on the reference-shaped wire).
+    # guaranteed qualifying, not guaranteed globally first (ADVICE r4).
     weak: bool = False
     started: float = 0.0           # set at dispatch (load_balance)
     # Memoization / observability plane.
-    cache_key: Optional[tuple] = None  # (data, lower, upper, target) as received
+    cache_key: Optional[tuple] = None  # (data, lower, upper, target)
     queued_at: float = 0.0         # monotonic stamp set at _on_request
+    qkey: int = 0                  # tenant-plane queue index stamp
     last_alarm: float = 0.0        # last queue-age warning for this request
     # Separate stamp for the in-flight age alarm: a request that alarmed
     # while QUEUED must not have its first in-flight alarm suppressed for
     # a full extra bound after dispatch.
     last_inflight_alarm: float = 0.0
-    trace: object = None           # RequestTrace (utils/metrics.py)
+    trace: object = None           # RequestTrace (or NULL_TRACE, unsampled)
     # QoS dispatch plane (ISSUE 5). ``qos_mode`` is "" until dispatch,
     # then "wholesale" (stock path: every chunk assigned at dispatch) or
     # "chunked" (chunk plan held centrally, granted incrementally).
@@ -354,6 +218,7 @@ class Request:
         # Every Request carries a trace from birth, even when constructed
         # directly (tests, programmatic drivers) rather than via
         # _on_request — the scheduler records events unconditionally.
+        # _on_request passes the tenant plane's (possibly sampled) trace.
         if self.trace is None:
             self.trace = RequestTrace(data=self.data, lower=self.lower,
                                       upper=self.upper, target=self.target,
@@ -361,7 +226,12 @@ class Request:
 
 
 class Scheduler:
-    """Single-actor scheduler over an :class:`AsyncServer`."""
+    """Single-actor scheduler over an :class:`AsyncServer` — the
+    request state machine over the tenant/miner plane pair."""
+
+    #: Compat re-export: the throughput-window span now lives on the
+    #: miner plane (tests and embedded drivers read it here).
+    RATE_WINDOW_S = MinerPlane.RATE_WINDOW_S
 
     def __init__(self, server: AsyncServer,
                  lease: Optional[LeaseParams] = None,
@@ -369,35 +239,46 @@ class Scheduler:
                  stripe: Optional[StripeParams] = None,
                  qos: Optional[QosParams] = None,
                  coalesce: Optional[CoalesceParams] = None,
-                 clock=None):
+                 clock=None,
+                 result_cache: Optional[ResultCache] = None,
+                 recv_batch: Optional[int] = None,
+                 trace_sample: Optional[float] = None):
         self.server = server
-        self.lease = lease if lease is not None else LeaseParams()
+        lease = lease if lease is not None else LeaseParams()
         self.cache = cache if cache is not None else CacheParams()
         # Env-defaulted (unlike lease/cache) so the tier-1 knob-off matrix
         # leg (DBM_STRIPE=0) exercises the Go-parity split through every
         # existing harness without threading a parameter into each test.
-        self.stripe = stripe if stripe is not None else stripe_from_env()
+        stripe = stripe if stripe is not None else stripe_from_env()
         # Env-defaulted like stripe: DBM_QOS=0 pins the stock FIFO path
         # through every existing harness (the tier-1 matrix leg).
-        self.qos = qos if qos is not None else qos_from_env()
+        qos = qos if qos is not None else qos_from_env()
         # Env-defaulted like stripe/qos: DBM_COALESCE=0 pins stock grant
         # accounting (no windows, no shared live slots) bit-for-bit.
-        self.coalesce = (coalesce if coalesce is not None
-                         else coalesce_from_env())
-        self._next_coalesce_id = 0
+        coalesce = (coalesce if coalesce is not None
+                    else coalesce_from_env())
+        # ``result_cache`` overrides with a SHARED instance (the replica
+        # tier's replay plane); otherwise each scheduler owns one.
         self.results: Optional[ResultCache] = (
-            ResultCache(self.cache.size) if self.cache.enabled else None)
-        self.miners: list[MinerState] = []      # join order, like minersArray
-        self.parked: list[Chunk] = []           # chunks of dropped miners
-        self.queue: list[Request] = []
+            result_cache if result_cache is not None
+            else (ResultCache(self.cache.size) if self.cache.enabled
+                  else None))
+        # Batched recv drain (ISSUE 11): after each awaited read, up to
+        # this many already-delivered messages are handled without a
+        # loop round-trip. 1 = stock one-message-per-await.
+        self._recv_batch = max(1, recv_batch if recv_batch is not None
+                               else _int_env("DBM_RECV_BATCH", 64))
+        self._read_nowait = getattr(server, "read_nowait", None)
         # In-flight requests by job_id, oldest first (dict preserves
         # insertion order). The stock FIFO path keeps AT MOST ONE entry
         # — the reference's one-request-in-flight invariant — while the
         # QoS plane runs several concurrently; ``current`` (below) stays
-        # the single-request view every existing caller reads.
+        # the single-request view every existing caller reads. The dict
+        # object is shared BY REFERENCE with the miner plane (its sweep
+        # and recovery consult it) and must never be reassigned.
         self._inflight: dict[int, Request] = {}
         self._next_job_id = 0
-        self._pool_rate: Optional[float] = None   # pool-wide throughput EWMA
+        self._chunked_inflight = 0                # count of chunked mode
         self._dispatching = False                 # _maybe_dispatch guard
         self._starved = False                     # no-eligible-miner latch
         # Observability plane (ISSUE 3): a per-scheduler registry (so unit
@@ -406,9 +287,10 @@ class Scheduler:
         # The prefix is FIXED and latest-wins by design: production runs
         # one scheduler per process, and a stable key set is what keeps
         # emitter lines and BENCH snapshots diffable across restarts. A
-        # process deliberately embedding several live schedulers should
-        # read each instance's own `.metrics`/`.stats` — only the newest
-        # is visible through the process snapshot. Never drives behavior.
+        # process deliberately embedding several live schedulers (e.g.
+        # the in-process replica tier) should read each instance's own
+        # `.metrics`/`.stats` — only the newest is visible through the
+        # process snapshot. Never drives behavior.
         self.metrics = Registry()
         process_registry().mount("sched", self.metrics)
         ensure_emitter()
@@ -421,44 +303,64 @@ class Scheduler:
             "Scheduler hot state (miners/queue/_inflight)")
             if _sanitize.ensure_sanitizer() else None)
         self._counters = {n: self.metrics.counter(n) for n in STAT_COUNTERS}
-        self._queue_depth = self.metrics.gauge("queue_depth")
-        self._pool_size = self.metrics.gauge("pool_size")
-        self._pool_quarantined = self.metrics.gauge("pool_quarantined")
         self._cache_hit_ratio = self.metrics.gauge("cache_hit_ratio")
-        self._lease_min_remaining = self.metrics.gauge(
-            "lease_min_remaining_s")
-        self._queue_wait = self.metrics.histogram("queue_wait_s",
-                                                  LATENCY_BUCKETS_S)
-        self._lease_wait = self.metrics.histogram("lease_wait_s",
-                                                  LATENCY_BUCKETS_S)
-        # Striping plane (dispatch pipeline): chunks per miner share.
-        self._stripe_depth = self.metrics.histogram("stripe_chunks_per_share",
-                                                    OCCUPANCY_BUCKETS)
-        self.traces = TraceBuffer()
-        self._cache_trace_seq = 0
-        # Cross-process tracing plane (ISSUE 10, DBM_TRACE=1 default):
-        # miner-side chunk spans arriving on the Result's Span extension
-        # are stitched into the request's trace, and the Perfetto export
-        # draws one track per miner/tenant. Track identity lives in a
-        # TrackSet under the same cardinality discipline as labeled
-        # metric series — registered on first sight, RETIRED on miner
-        # drop / tenant GC so conn churn cannot grow the export without
-        # bound. DBM_TRACE=0 turns every hook into one boolean check.
+        # Cross-process tracing plane (ISSUE 10, DBM_TRACE=1 default).
         self._trace_on = _tracing.ensure_tracer()
-        self._tracks = _tracing.TrackSet()
-        # Fair-share QoS plane (ISSUE 5): always constructed (tenant
-        # accounting is a few dicts), consulted only when qos.enabled.
+        # The two planes (ISSUE 11 split; see module docstring).
         # ``clock`` (ISSUE 8) feeds the admission token buckets: the
         # deterministic-schedule explorer (analysis/schedcheck) injects
         # its virtual clock here so bucket refills are a function of the
-        # explored schedule, not of wall time. Note the scheduler's own
+        # explored schedule, not of wall time. The scheduler's own
         # lease/trace stamps read ``time.monotonic`` directly — the
         # explorer patches that; this parameter exists because the
         # bucket CAPTURES its clock at construction.
-        self.qos_plane = QosPlane(
-            self.metrics, clock=clock if clock is not None
-            else time.monotonic)
-        self._tenant_weights: dict = {}    # programmatic overrides
+        self.tenant_plane = TenantPlane(
+            self.metrics, self._count, qos, lease,
+            clock=clock, close_conn=getattr(server, "close_conn", None),
+            trace_on=self._trace_on, trace_sample=trace_sample)
+        self.miner_plane = MinerPlane(
+            self.metrics, self._count, lease, stripe, coalesce,
+            write=self._write, inflight=self._inflight,
+            trace_get=self.tenant_plane.traces.get,
+            lease_event=self._on_lease_event,
+            dispatch=self._maybe_dispatch, trace_on=self._trace_on)
+
+    # Param blocks live on the planes (single source of truth); these
+    # properties keep the pre-split read/WRITE surface — tests and
+    # embedded drivers reconfigure a live scheduler by assignment.
+
+    @property
+    def lease(self) -> LeaseParams:
+        return self.miner_plane.lease
+
+    @lease.setter
+    def lease(self, value: LeaseParams) -> None:
+        self.miner_plane.lease = value
+        self.tenant_plane.lease = value
+
+    @property
+    def stripe(self) -> StripeParams:
+        return self.miner_plane.stripe
+
+    @stripe.setter
+    def stripe(self, value: StripeParams) -> None:
+        self.miner_plane.stripe = value
+
+    @property
+    def coalesce(self) -> CoalesceParams:
+        return self.miner_plane.coalesce
+
+    @coalesce.setter
+    def coalesce(self, value: CoalesceParams) -> None:
+        self.miner_plane.coalesce = value
+
+    @property
+    def qos(self) -> QosParams:
+        return self.tenant_plane.qos
+
+    @qos.setter
+    def qos(self, value: QosParams) -> None:
+        self.tenant_plane.qos = value
 
     # ---------------------------------------------------------- public view
 
@@ -475,6 +377,45 @@ class Scheduler:
         """Read-only view of every in-flight request by job id."""
         return dict(self._inflight)
 
+    # Plane-state views: the pre-split attribute surface, now owned by
+    # the planes (tests, bench probes, and the dbmcheck harness read
+    # these; the planes hold the live objects).
+
+    @property
+    def miners(self) -> list:
+        return self.miner_plane.miners
+
+    @property
+    def parked(self) -> list:
+        return self.miner_plane.parked
+
+    @property
+    def queue(self) -> list:
+        """Arrival-ordered COPY of the queued requests (read-only in
+        effect — appends to it are discarded; inject via
+        ``tenant_plane.enqueue``)."""
+        return self.tenant_plane.queue
+
+    @property
+    def qos_plane(self):
+        return self.tenant_plane.qos_plane
+
+    @property
+    def traces(self):
+        return self.tenant_plane.traces
+
+    @property
+    def _tracks(self):
+        return self.tenant_plane.tracks
+
+    @property
+    def _pool_rate(self):
+        return self.miner_plane.pool_rate
+
+    @_pool_rate.setter
+    def _pool_rate(self, rate) -> None:
+        self.miner_plane.pool_rate = rate
+
     # ------------------------------------------------------- stats / metrics
 
     @property
@@ -485,11 +426,6 @@ class Scheduler:
 
     def _count(self, name: str, n: int = 1) -> None:
         self._counters[name].inc(n)
-
-    def _update_pool_gauges(self) -> None:
-        self._pool_size.set(len(self.miners))
-        self._pool_quarantined.set(
-            sum(1 for m in self.miners if m.quarantined))
 
     def _cache_lookup(self, key, count_miss: bool = True):
         """ResultCache get + hit/miss/ratio accounting in one place.
@@ -509,17 +445,12 @@ class Scheduler:
 
     def trace(self, request_id: int):
         """The recorded :class:`RequestTrace` for a job id (or a
-        ``cache:N`` replay key); None when unknown or evicted."""
-        return self.traces.get(request_id)
+        ``cache:N`` replay key); None when unknown, evicted, or the
+        request was unsampled (``DBM_TRACE_SAMPLE``)."""
+        return self.tenant_plane.traces.get(request_id)
 
     def _dump_trace(self, why: str, trace) -> None:
-        """Structured single-line JSON dump of one request trace — the
-        queue-age alarm's "a stalled request explains itself" payload."""
-        if trace is None:
-            return
-        logger.warning("trace dump (%s): %s", why,
-                       json.dumps(trace.to_dict(), sort_keys=True,
-                                  default=str))
+        self.tenant_plane.dump_trace(why, trace)
 
     def _fold_span(self, trace, conn_id: int, chunk: Chunk,
                    span: Optional[dict]) -> None:
@@ -529,8 +460,10 @@ class Scheduler:
         cannot inject arbitrary keys into dumps), the DOMINANT phase is
         named inline so a stalled request's dump reads "force stalled on
         miner 7" without arithmetic, and the owning miner's export track
-        is registered (retired again on miner drop)."""
-        if span is None or trace is None or not self._trace_on:
+        is registered (retired again on miner drop). Unsampled requests
+        (NULL trace) skip the fold entirely."""
+        if span is None or trace is None or trace.null \
+                or not self._trace_on:
             return
         clean = {}
         for key in _tracing.SPAN_PHASES + _tracing.SPAN_EXTRAS:
@@ -539,7 +472,7 @@ class Scheduler:
                 clean[key] = v
         if not clean:
             return
-        self._tracks.track("trace_track", miner=str(conn_id))
+        self.tenant_plane.track_miner(conn_id)
         slow = _tracing.slow_phase(clean)
         if slow is not None:
             clean["slow"] = slow
@@ -551,13 +484,14 @@ class Scheduler:
         miner, request slices + instant fault events + the stitched
         miner-side phase spans (``scripts/dbmtrace.py`` is the CLI
         wrapper). Returns the document; ``path`` also writes it."""
+        import json as _json
         dicts = []
-        for _key, t in self.traces.items():
+        for _key, t in self.tenant_plane.traces.items():
             d = t.to_dict()
             d["t0"] = t.t0
             dicts.append(d)
         tenant_tracks, miner_tracks = {}, {}
-        for labels, tid in self._tracks.items("trace_track"):
+        for labels, tid in self.tenant_plane.tracks.items("trace_track"):
             labels = dict(labels)
             if "tenant" in labels:
                 tenant_tracks[labels["tenant"]] = tid
@@ -567,12 +501,11 @@ class Scheduler:
                                        miner_tracks=miner_tracks)
         if path:
             with open(path, "w", encoding="utf-8") as fh:
-                json.dump(doc, fh, sort_keys=True)
+                _json.dump(doc, fh, sort_keys=True)
         return doc
 
     def _track_tenant(self, conn_id: int) -> None:
-        if self._trace_on:
-            self._tracks.track("trace_track", tenant=str(conn_id))
+        self.tenant_plane.track_tenant(conn_id)
 
     # ------------------------------------------------------------- main loop
 
@@ -588,22 +521,40 @@ class Scheduler:
                     conn_id, payload = await self.server.read()
                 except LspError:
                     return
-                if isinstance(payload, Exception):
-                    self._on_drop(conn_id)
-                    continue
-                try:
-                    msg = Message.from_json(payload)
-                except ValueError:
-                    continue
-                if msg.type == MsgType.JOIN:
-                    self._on_join(conn_id)
-                elif msg.type == MsgType.REQUEST:
-                    self._on_request(conn_id, msg)
-                elif msg.type == MsgType.RESULT:
-                    self._on_result(conn_id, msg)
+                self.handle(conn_id, payload)
+                # Batched recv (ISSUE 11): drain what is already
+                # delivered without a loop round-trip per message — at
+                # 10k conns the per-await wakeups dominate the recv
+                # path. Handlers run in identical order either way;
+                # DBM_RECV_BATCH=1 restores one-message-per-await.
+                if self._recv_batch > 1 and self._read_nowait is not None:
+                    for _ in range(self._recv_batch - 1):
+                        item = self._read_nowait()
+                        if item is None:
+                            break
+                        self.handle(item[0], item[1])
         finally:
             if lease_task is not None:
                 lease_task.cancel()
+
+    def handle(self, conn_id: int, payload) -> None:
+        """Handle ONE transport item — a payload or a conn-death
+        exception. Public so embedding drivers (the replica router,
+        apps/replicas.py) can feed a scheduler without owning its read
+        loop."""
+        if isinstance(payload, Exception):
+            self._on_drop(conn_id)
+            return
+        try:
+            msg = Message.from_json(payload)
+        except ValueError:
+            return
+        if msg.type == MsgType.JOIN:
+            self._on_join(conn_id)
+        elif msg.type == MsgType.REQUEST:
+            self._on_request(conn_id, msg)
+        elif msg.type == MsgType.RESULT:
+            self._on_result(conn_id, msg)
 
     async def _lease_loop(self) -> None:
         """Periodic sweep; the only timer the scheduler owns. Checks
@@ -611,32 +562,66 @@ class Scheduler:
         while True:
             await asyncio.sleep(self.lease.tick_s)
             try:
-                if self.lease.enabled:
-                    self._check_leases()
-                self._check_queue_age()
-                if self.qos.enabled:
-                    # Idle-tenant GC: a tenant with no queued or in-flight
-                    # work, nothing granted outstanding, and a full
-                    # admission bucket carries no state worth keeping —
-                    # dropping it frees its metric series so conn churn
-                    # stays bounded over a long server life. Tenants the
-                    # GC forgets also lose their export track (ISSUE 10):
-                    # the track registry obeys the same churn rule.
-                    before = set(self.qos_plane.tenants)
-                    self.qos_plane.gc(
-                        {r.conn_id for r in self.queue}
-                        | {r.conn_id for r in self._inflight.values()})
-                    for tenant in before - set(self.qos_plane.tenants):
-                        self._tracks.retire("trace_track",
-                                            tenant=str(tenant))
+                self.sweep()
             except Exception:   # noqa: BLE001 — the sweep must never die
                 logger.exception("lease sweep failed; continuing")
+
+    def sweep(self) -> None:
+        """One sweep tick: lease checks, age alarms, tenant GC. Public
+        so the replica tier can drive each replica's sweep."""
+        if self.lease.enabled:
+            self._check_leases()
+        self._check_queue_age()
+        if self.qos.enabled:
+            # backlog_tenants is exactly the queued conn-id set, read
+            # from the per-tenant index — no O(queued-requests) list
+            # materialization per tick (code review).
+            busy = (set(self.tenant_plane.backlog_tenants())
+                    | {r.conn_id for r in self._inflight.values()})
+            self.tenant_plane.gc(busy)
 
     # ---------------------------------------------------------------- events
 
     def _on_request(self, conn_id: int, msg: Message) -> None:
         if self._owner is not None:
             self._owner.assert_here()
+        request = self._build_request(conn_id, msg)
+        if request is None:
+            return       # answered from the ResultCache at arrival
+        if self.qos.enabled:
+            # Admission (cache replays above never reach here — an
+            # already-answered retry must not burn quota, ISSUE 5
+            # satellite). A drained bucket sheds the NEW request;
+            # overload sheds the OLDEST queued one (their client is
+            # nearest its own deadline; shedding it now gives its
+            # backed-off resubmission the best chance of landing in a
+            # drained queue).
+            if not self.tenant_plane.admit(conn_id):
+                self._shed(request, "admission")
+                return
+        self._intake(request, bound_queue=True)
+
+    def reserve_request(self, conn_id: int, msg: Message) -> None:
+        """Takeover re-serve (apps/replicas.kill): exactly
+        :meth:`_on_request` EXCEPT that neither the admission bucket
+        nor the overload shed is consulted — this work was already
+        admitted once by the dead replica, and a failover must not
+        convert admitted requests into sheds (code review). The
+        ``max_queued`` bound re-asserts on the next ordinary arrival
+        (its overload trim runs whenever the queue exceeds the
+        bound)."""
+        if self._owner is not None:
+            self._owner.assert_here()
+        request = self._build_request(conn_id, msg)
+        if request is None:
+            return       # replayed from the SHARED ResultCache
+        if self.qos.enabled:
+            self._tenant(conn_id)     # tenant state, no bucket charge
+        self._intake(request, bound_queue=False)
+
+    def _build_request(self, conn_id: int, msg: Message):
+        """Arrival common path: cache replay (None = answered), else a
+        fresh Request with its (possibly sampled) trace."""
         key = (msg.data, msg.lower, msg.upper, msg.target)
         if self.results is not None:
             hit = self._cache_lookup(key)
@@ -647,92 +632,45 @@ class Scheduler:
                 h, nonce = hit
                 self._write(conn_id, new_result(h, nonce))
                 self._count("results_sent")
-                self._trace_cache_replay(conn_id, key, h, nonce)
+                self.tenant_plane.cache_replay_trace(conn_id, key, h, nonce)
                 logger.info("request %r [%d, %d] target=%d answered from "
                             "the result cache", msg.data, msg.lower,
                             msg.upper, msg.target)
-                return
-        request = Request(conn_id=conn_id, data=msg.data,
-                          lower=msg.lower, upper=msg.upper,
-                          target=msg.target, cache_key=key,
-                          queued_at=time.monotonic())
-        if self.qos.enabled:
-            # Admission (cache replays above never reach here — an
-            # already-answered retry must not burn quota, ISSUE 5
-            # satellite). A drained bucket sheds the NEW request;
-            # overload sheds the OLDEST queued one (their client is
-            # nearest its own deadline; shedding it now gives its
-            # backed-off resubmission the best chance of landing in a
-            # drained queue).
-            self.qos_plane.tenant(conn_id, self._weight_for(conn_id),
-                                  self.qos.rate, self.qos.burst)
-            if not self.qos_plane.admit(conn_id):
-                self._shed(request, "admission")
-                return
-        request.trace.event("enqueue", queue_depth=len(self.queue))
-        self.queue.append(request)
-        self._queue_depth.set(len(self.queue))
-        if self.qos.enabled and self.qos.max_queued > 0:
-            while len(self.queue) > self.qos.max_queued:
-                self._shed(self.queue.pop(0), "overload")
-            self._queue_depth.set(len(self.queue))
-        self._maybe_dispatch()
+                return None
+        return Request(conn_id=conn_id, data=msg.data,
+                       lower=msg.lower, upper=msg.upper,
+                       target=msg.target, cache_key=key,
+                       queued_at=time.monotonic(),
+                       trace=self.tenant_plane.new_trace(
+                           data=msg.data, lower=msg.lower,
+                           upper=msg.upper, target=msg.target,
+                           client=conn_id))
 
-    def _trace_cache_replay(self, conn_id: int, key, h: int,
-                            nonce: int) -> None:
-        """An at-enqueue memo replay never builds a Request (and never
-        gets a job id): trace it under a synthetic ``cache:N`` key so
-        trace completeness still holds. (A replay at DISPATCH time reuses
-        the queued Request's own trace instead — its enqueue stamp and
-        queue wait are real history that must not be discarded.)"""
-        self._cache_trace_seq += 1
-        trace = self.traces.new(data=key[0], lower=key[1], upper=key[2],
-                                target=key[3], client=conn_id)
-        trace.event("enqueue", queue_depth=len(self.queue))
-        trace.event("cache_hit", at="request")
-        trace.event("reply", hash=h, nonce=nonce, cached=True)
-        self.traces.register(f"cache:{self._cache_trace_seq}", trace)
-        self._track_tenant(conn_id)
+    def _intake(self, request: Request, bound_queue: bool) -> None:
+        request.trace.event("enqueue",
+                            queue_depth=self.tenant_plane.queue_len())
+        self.tenant_plane.enqueue(request)
+        if bound_queue and self.qos.enabled and self.qos.max_queued > 0:
+            while self.tenant_plane.queue_len() > self.qos.max_queued:
+                self._shed(self.tenant_plane.pop_head(), "overload")
+        self._maybe_dispatch()
 
     def _on_join(self, conn_id: int) -> None:
         if self._owner is not None:
             self._owner.assert_here()
-        miner = MinerState(conn_id=conn_id)
-        # A joining miner immediately absorbs one parked chunk, if any
-        # (ref: server.go:222-244).
-        chunk = self._next_parked()
-        if chunk is not None:
-            self._assign_chunk(miner, chunk, kind="parked")
-        self.miners.append(miner)
-        self._update_pool_gauges()
+        self.miner_plane.on_join(conn_id)
         self._maybe_dispatch()
 
     def _on_result(self, conn_id: int, msg: Message) -> None:
         if self._owner is not None:
             self._owner.assert_here()
-        miner = self._find_miner(conn_id)
-        if miner is None or not miner.pending:
+        popped = self.miner_plane.pop_result(conn_id)
+        if popped is None:
             return
-        chunk = miner.pending.pop(0)   # the Result answers the oldest Request
-        self._observe_result(miner, chunk)
-        # Position-aware leases: the next FIFO entry is what the miner
-        # computes now — start its clock (no-op when already started, i.e.
-        # fifo_aware off or it was assigned to an empty FIFO).
-        if miner.pending and not miner.pending[0].lease_started:
-            self._start_lease(miner, miner.pending[0])
-        # A freed miner immediately absorbs one parked chunk
-        # (ref: server.go:285-304) — BEFORE the stale-Result return, so a
-        # miner freed by a stale answer still rescues parked work. The
-        # just-popped (job, idx) is excluded: this very Result is about to
-        # answer it, so a parked speculative copy of it is garbage — not
-        # work to hand back to the miner that just did it.
-        if self.parked and miner.available:
-            parked = self._next_parked(skip_key=(chunk.job_id, chunk.idx))
-            if parked is not None:
-                self._assign_chunk(miner, parked, kind="parked")
+        miner, chunk = popped
         curr = self._inflight.get(chunk.job_id)
         if curr is None:
-            stale = self.traces.get(chunk.job_id)
+            stale = self.tenant_plane.traces.get(chunk.job_id)
             if stale is not None:
                 stale.event("stale_result", miner=conn_id, idx=chunk.idx)
                 # A wedged/slow miner's span arrives LATE by definition
@@ -801,54 +739,28 @@ class Scheduler:
     def _on_drop(self, conn_id: int) -> None:
         if self._owner is not None:
             self._owner.assert_here()
-        miner = self._find_miner(conn_id)
+        miner = self.miner_plane.find_miner(conn_id)
         if miner is not None:
             logger.info("miner %d dropped", conn_id)
-            self.miners.remove(miner)
-            self._update_pool_gauges()
-            # Retire the dead conn-id's labeled series: stale values must
-            # not linger in snapshots, and reconnect churn (every rejoin
-            # is a fresh conn id) must not exhaust the family cardinality
-            # bound over a long server life.
-            self.metrics.remove("miner_rate_nps", miner=str(conn_id))
-            self.metrics.remove("lease_remaining_s", miner=str(conn_id))
+            self.miner_plane.drop_miner(conn_id)
             # Export-track retirement (ISSUE 10): same churn rule as the
-            # labeled series above — a dead conn id's track must free
-            # its slot under the cardinality bound.
-            self._tracks.retire("trace_track", miner=str(conn_id))
+            # labeled series — a dead conn id's track must free its slot
+            # under the cardinality bound.
+            self.tenant_plane.retire_miner_track(conn_id)
             _tracing.flight("miner_drop", miner=conn_id)
             if not self._inflight:
                 return
             for req in self._inflight.values():
                 req.trace.event("miner_drop", miner=conn_id)
-            # Recover every unanswered chunk of each in-flight request
-            # (ref: server.go:326-376, single-chunk version; the stock
-            # FIFO path has exactly one). Chunks whose idx already merged
-            # (speculation winner landed first) and chunks with a live
-            # speculative copy in another FIFO need no recovery — the
-            # copy is tracked independently.
-            for chunk in miner.pending:
-                req = self._inflight.get(chunk.job_id)
-                if req is None or chunk.cancelled:
-                    continue
-                if req.answered[chunk.idx] or chunk.reissued:
-                    continue
-                takeover = next((m for m in self._eligible()), None)
-                if takeover is not None:
-                    self._assign_chunk(takeover, chunk, kind="recovered")
-                else:
-                    self.parked.append(chunk)
-                    req.trace.event("park", idx=chunk.idx)
+            self.miner_plane.recover(miner)
         else:
             logger.info("client %d dropped", conn_id)
-            # Purge the dead client's queued requests FIRST so cancelling its
-            # in-flight request can't promote another of its own requests.
-            for req in self.queue:
-                if req.conn_id == conn_id:
-                    req.trace.event("cancel", reason="client_drop")
-            self.queue = [r for r in self.queue if r.conn_id != conn_id]
-            self._queue_depth.set(len(self.queue))
-            self._tracks.retire("trace_track", tenant=str(conn_id))
+            # Purge the dead client's queued requests FIRST so cancelling
+            # its in-flight request can't promote another of its own
+            # requests.
+            for req in self.tenant_plane.purge_tenant(conn_id):
+                req.trace.event("cancel", reason="client_drop")
+            self.tenant_plane.retire_tenant_track(conn_id)
             if self.qos.enabled:
                 self.qos_plane.forget(conn_id)
             for req in [r for r in self._inflight.values()
@@ -856,6 +768,57 @@ class Scheduler:
                 # Cancel immediately (divergence, see module docstring).
                 req.trace.event("cancel", reason="client_drop")
                 self._retire(req)
+
+    def _on_lease_event(self, kind: str, chunk: Chunk, miner_conn: int,
+                        **info) -> None:
+        """Lease-event edge of the internal interface: the miner plane
+        reports every lease state transition here, and this side does
+        the trace/flight/log fanout against the owning request."""
+        curr = self._inflight.get(chunk.job_id)
+        if kind == "blown":
+            spurious = info.get("spurious", False)
+            if curr is not None:
+                curr.trace.event("lease_blown", miner=miner_conn,
+                                 idx=chunk.idx, streak=info["streak"],
+                                 spurious=spurious)
+            if self._trace_on:
+                _tracing.flight("lease_blown", job=chunk.job_id,
+                                idx=chunk.idx, miner=miner_conn,
+                                streak=info["streak"])
+            logger.warning(
+                "miner %d blew the lease on job %d chunk %d "
+                "[%d, %d) after %.2fs (streak %d)%s",
+                miner_conn, chunk.job_id, chunk.idx,
+                chunk.lower, chunk.upper, info.get("overdue_s", 0.0),
+                info["streak"],
+                " [spurious: miner had not reached this chunk]"
+                if spurious else "")
+        elif kind == "quarantine":
+            if curr is not None:
+                curr.trace.event("quarantine", miner=miner_conn)
+            logger.warning(
+                "miner %d quarantined after %d consecutive "
+                "blown leases; no new assignments until it "
+                "answers", miner_conn, info["streak"])
+        elif kind == "reissue":
+            if curr is not None:
+                curr.trace.event("reissue", idx=chunk.idx,
+                                 from_miner=miner_conn,
+                                 to_miner=info["to_miner"])
+            if self._trace_on:
+                _tracing.flight("reissue", job=chunk.job_id,
+                                idx=chunk.idx, from_miner=miner_conn,
+                                to_miner=info["to_miner"])
+            logger.warning(
+                "speculatively re-issuing job %d chunk %d [%d, %d) "
+                "from miner %d to miner %d",
+                chunk.job_id, chunk.idx, chunk.lower, chunk.upper,
+                miner_conn, info["to_miner"])
+        elif kind == "quarantine_lifted":
+            logger.info("miner %d answered; quarantine lifted", miner_conn)
+        elif kind == "park":
+            if curr is not None:
+                curr.trace.event("park", idx=chunk.idx)
 
     # -------------------------------------------------------------- internal
 
@@ -897,69 +860,16 @@ class Scheduler:
         in-flight slots for granted-but-unanswered chunks are released
         and any UNGRANTED chunks simply evaporate (a difficulty prefix
         release on a chunked elephant skips their scans entirely)."""
-        for m in self.miners:
-            for c in m.pending:
-                if c.job_id == curr.job_id:
-                    c.cancelled = True
-        self.parked = [c for c in self.parked if c.job_id != curr.job_id]
-        self._inflight.pop(curr.job_id, None)
+        self.miner_plane.cancel_job(curr.job_id)
+        if self._inflight.pop(curr.job_id, None) is not None \
+                and curr.qos_mode == "chunked":
+            self._chunked_inflight -= 1
         if self.qos.enabled:
             self.qos_plane.release(
                 curr.conn_id, curr.granted_chunks - sum(curr.answered))
         if not self._inflight:
-            # No live leases remain: clear the remaining-lease gauges so
-            # an idle system's snapshot doesn't keep reporting the
-            # retired job's last sweep values as work in flight.
-            for m in self.miners:
-                self.metrics.remove("lease_remaining_s",
-                                    miner=str(m.conn_id))
-            self._lease_min_remaining.set(0.0)
+            self.miner_plane.clear_lease_gauges()
         self._maybe_dispatch()
-
-    def _find_miner(self, conn_id: int) -> Optional[MinerState]:
-        for m in self.miners:
-            if m.conn_id == conn_id:
-                return m
-        return None
-
-    def _next_parked(self, skip_key=None) -> Optional[Chunk]:
-        """Pop the next parked chunk that still NEEDS executing, discarding
-        stale ones: a parked chunk whose idx was meanwhile answered by a
-        speculation winner (its copy blew a lease, was re-issued, and the
-        re-issue landed first) — or whose ``(job_id, idx)`` matches
-        ``skip_key``, the assignment the caller is answering right now —
-        would only burn a full scan to pop as a duplicate."""
-        while self.parked:
-            chunk = self.parked.pop(0)
-            req = self._inflight.get(chunk.job_id)
-            if req is None or req.answered[chunk.idx]:
-                continue
-            if skip_key is not None and \
-                    (chunk.job_id, chunk.idx) == skip_key:
-                continue
-            return chunk
-        return None
-
-    def _eligible(self) -> list[MinerState]:
-        """Miners that may take new work: available and not quarantined."""
-        return [m for m in self.miners
-                if m.available and not m.quarantined]
-
-    def _desperation_pool(self) -> list[MinerState]:
-        """Last-resort pool when the WHOLE pool is quarantined: the
-        least-bad available quarantined miner (lowest blown streak, then
-        highest observed throughput), or nothing. Any non-quarantined
-        miner — even a busy one that will free up — disables desperation:
-        waiting for a healthy miner beats feeding a known-bad one."""
-        if not self.lease.desperation or not self.miners:
-            return []
-        if not all(m.quarantined for m in self.miners):
-            return []
-        avail = [m for m in self.miners if m.available]
-        if not avail:
-            return []
-        return [min(avail, key=lambda m: (m.blown_streak,
-                                          -(m.rate_ewma or 0.0)))]
 
     def _maybe_dispatch(self) -> None:
         """Start queued work when the pool can take it: the stock FIFO
@@ -983,7 +893,8 @@ class Scheduler:
                 self._fifo_pump()
         finally:
             self._dispatching = False
-        if not self._inflight and self.queue and not self._eligible():
+        if not self._inflight and self.tenant_plane.queue_len() \
+                and not self.miner_plane.eligible():
             # A dispatch pass found work but no taker: latch so the
             # condition logs once per starvation episode (every later
             # event re-enters here until a miner joins/frees/answers),
@@ -991,30 +902,30 @@ class Scheduler:
             if not self._starved:
                 self._starved = True
                 self._count("no_eligible_miner")
-                quarantined = sum(1 for m in self.miners if m.quarantined)
+                miners = self.miner_plane.miners
+                quarantined = sum(1 for m in miners if m.quarantined)
                 logger.warning(
                     "no eligible miner for %d queued request(s): pool=%d "
                     "quarantined=%d busy=%d — queue is stalled until a "
                     "miner joins, frees, or answers",
-                    len(self.queue), len(self.miners), quarantined,
-                    sum(1 for m in self.miners
+                    self.tenant_plane.queue_len(), len(miners), quarantined,
+                    sum(1 for m in miners
                         if not m.available and not m.quarantined))
-        elif not self.queue:
+        elif not self.tenant_plane.queue_len():
             self._starved = False
 
     def _fifo_pump(self) -> None:
         """The stock dispatch loop: pop the queue head whenever nothing
         is in flight — the reference's FIFO order, bit-for-bit."""
-        while not self._inflight and self.queue:
-            pool = self._eligible()
+        while not self._inflight and self.tenant_plane.queue_len():
+            pool = self.miner_plane.eligible()
             desperate = False
             if not pool:
-                pool = self._desperation_pool()
+                pool = self.miner_plane.desperation_pool()
                 if not pool:
                     break
                 desperate = True
-            req = self.queue.pop(0)
-            self._queue_depth.set(len(self.queue))
+            req = self.tenant_plane.pop_head()
             if self._replay_at_dispatch(req):
                 continue
             self._load_balance(req, pool, desperate=desperate)
@@ -1034,12 +945,11 @@ class Scheduler:
             return False
         self._write(req.conn_id, new_result(*hit))
         self._count("results_sent")
-        self._queue_wait.observe(time.monotonic() - req.queued_at)
+        self.tenant_plane.observe_queue_wait(
+            time.monotonic() - req.queued_at)
         req.trace.event("cache_hit", at="dispatch")
         req.trace.event("reply", hash=hit[0], nonce=hit[1], cached=True)
-        self._cache_trace_seq += 1
-        self.traces.register(f"cache:{self._cache_trace_seq}", req.trace)
-        self._track_tenant(req.conn_id)
+        self.tenant_plane.register_replay(req)
         logger.info(
             "queued request %r [%d, %d] answered from "
             "the result cache at dispatch", req.data,
@@ -1049,71 +959,51 @@ class Scheduler:
     # ------------------------------------------------------------ QoS plane
 
     def _tenant(self, conn_id):
-        """The QoS tenant state for a conn, created with the configured
-        weight and admission bucket on first sight."""
-        return self.qos_plane.tenant(conn_id, self._weight_for(conn_id),
-                                     self.qos.rate, self.qos.burst)
+        return self.tenant_plane.tenant(conn_id)
 
     def _weight_for(self, tenant) -> float:
-        w = self._tenant_weights.get(tenant)
-        return w if w is not None else self.qos.weight_for(tenant)
+        return self.tenant_plane.weight_for(tenant)
 
     def set_tenant_weight(self, tenant, weight: float) -> None:
         """Programmatic per-tenant DRR weight override (tests and
         embedded drivers; the env path is ``DBM_QOS_WEIGHTS``)."""
-        self._tenant_weights[tenant] = max(weight, 1e-3)
-        self.qos_plane.set_weight(tenant, weight)
+        self.tenant_plane.set_weight(tenant, weight)
 
-    def _miner_live(self, miner: MinerState) -> int:
-        """Live (non-cancelled) chunks in a miner's pending FIFO, with
-        a coalescing window's chunks counting as ONE (they share one
-        device launch on the miner — ISSUE 9): the QoS depth cap bounds
-        launches in flight, not rows per launch."""
-        n = 0
-        groups = set()
-        for c in miner.pending:
-            if c.cancelled:
-                continue
-            if c.coalesce_id is None:
-                n += 1
-            else:
-                groups.add(c.coalesce_id)
-        return n + len(groups)
-
-    def _qos_capacity_pool(self) -> list[MinerState]:
-        """Miners that may take an incremental QoS chunk: not
-        quarantined, below the per-miner live-FIFO cap, and not sitting
-        on a blown-lease chunk (a wedged miner's blown original stays
-        live in its FIFO awaiting the in-order pop — the stock path's
-        ``available`` never feeds such a miner either, and a mouse
-        granted behind it would stall a full lease period), least-loaded
-        first (ties keep join order — the reference's assignment
-        order)."""
-        depth = self.qos.depth
-        pool = [m for m in self.miners
-                if not m.quarantined and self._miner_live(m) < depth
-                and not any(c.lease_blown and not c.cancelled
-                            for c in m.pending)]
-        pool.sort(key=self._miner_live)
-        return pool
-
-    def _qos_est_s(self, req: Request) -> Optional[float]:
-        """Estimated pool-seconds to scan ``req``; None on a cold pool."""
-        total = req.upper - req.lower + 1    # still inclusive pre-dispatch
-        if total <= 0:
-            return 0.0
-        if self._pool_rate is None or self._pool_rate <= 0:
-            return None
-        n = max(1, len(self._eligible()) or len(self.miners) or 1)
-        return total / (self._pool_rate * n)
+    @staticmethod
+    def _qos_is_small(total: int, cold: bool, bound: float) -> bool:
+        """THE wholesale-smallness predicate: empty/inverted ranges and
+        cold pools are small; otherwise one comparison against the
+        hoisted bound. One definition shared by head pricing, pump
+        candidacy, and the dispatch decision — the three MUST agree, or
+        a head priced as a chunked start could dispatch wholesale and
+        debit a whole request against a one-chunk deficit (code
+        review)."""
+        return total <= 0 or cold or total <= bound
 
     def _qos_small(self, req: Request) -> bool:
         """Small enough for the stock wholesale dispatch: the estimated
         scan fits ``wholesale_s``, or the pool is cold (no throughput
         observed — wholesale preserves reference parity for first
         requests, exactly like the striping plane's cold fallback)."""
-        est = self._qos_est_s(req)
-        return est is None or est <= self.qos.wholesale_s
+        cold, bound = self._qos_small_bound()
+        return self._qos_is_small(req.upper - req.lower + 1, cold, bound)
+
+    def _qos_small_bound(self):
+        """Hoisted smallness test state: ``(cold, bound_nonces)``.
+
+        ``est <= wholesale_s`` with ``est = total / (rate * n)`` is
+        ``total <= wholesale_s * rate * n`` — computing the right-hand
+        side ONCE per heads pass turns the per-tenant test into one
+        comparison. The old per-head ``_qos_small`` walked the eligible
+        pool (O(miners × pending)) for EVERY backlogged tenant on every
+        pump — the single hottest line of the 10k-tenant storm profile
+        (ISSUE 11)."""
+        rate = self.miner_plane.pool_rate
+        if rate is None or rate <= 0:
+            return True, 0.0
+        n = max(1, len(self.miner_plane.eligible())
+                or len(self.miner_plane.miners) or 1)
+        return False, self.qos.wholesale_s * rate * n
 
     def _qos_chunk_plan(self, total: int, pool_n: int) -> tuple[int, int]:
         """``(n_chunks, first_chunk_size)`` for a chunked activation of
@@ -1124,7 +1014,7 @@ class Scheduler:
         actual plan) and the DRR head cost (what one grant will debit) —
         the two MUST agree, or a chunked start banks the whole request's
         cost as unearned deficit and starves every other tenant."""
-        rate = self._pool_rate if self._pool_rate else 0.0
+        rate = self.miner_plane.pool_rate or 0.0
         if rate > 0:
             n = -(-total // max(1, int(rate * self.qos.chunk_s)))
         else:
@@ -1145,12 +1035,14 @@ class Scheduler:
           single-tenant and small-request traffic bit-identical to the
           FIFO scheduler — but flow freely alongside chunked requests.
 
-        Tenants at their ``max_inflight`` cap are skipped.
+        Tenants at their ``max_inflight`` cap are skipped. The queued
+        scan rides the tenant plane's per-tenant FIFO index — O(tenants
+        with backlog), not O(queued requests) (ISSUE 11).
         """
         heads: dict = {}
         cap = self.qos.max_inflight
-        any_chunked = any(r.qos_mode == "chunked"
-                          for r in self._inflight.values())
+        tenants_map = self.qos_plane.tenants
+        any_chunked = self._chunked_inflight > 0
         for req in self._inflight.values():     # oldest first
             if req.qos_mode != "chunked" or \
                     req.next_chunk >= req.num_chunks:
@@ -1162,15 +1054,22 @@ class Scheduler:
                 continue
             lo, up = req.chunk_bounds[req.next_chunk]
             heads[t] = ("chunk", req, up - lo)
+        if self._inflight and not any_chunked:
+            return heads        # wholesale in flight: stock FIFO wait
+        cold, small_bound = self._qos_small_bound()
+        none_inflight = not self._inflight
+        pool_n = len(self.miner_plane.miners) or 1
         busy = {r.conn_id for r in self._inflight.values()}
-        for req in self.queue:
-            if self._inflight and not any_chunked:
-                break               # wholesale in flight: stock FIFO wait
-            t = req.conn_id
+        for t, req in self.tenant_plane.tenant_heads():
             if t in heads or t in busy:
                 continue
-            if cap > 0 and self._tenant(t).inflight >= cap:
-                continue
+            if cap > 0:
+                # Existing-state read only (the hot path must not pay a
+                # create-with-weight per head): admission already
+                # created the tenant; an unknown tenant has 0 in flight.
+                st = tenants_map.get(t)
+                if st is not None and st.inflight >= cap:
+                    continue
             # The head COST is what granting it will actually DEBIT —
             # the same branch the pump executes: the whole range for a
             # start that will dispatch wholesale (nothing in flight and
@@ -1180,56 +1079,23 @@ class Scheduler:
             # the difference as unearned deficit, and quantum (the max
             # candidate cost) balloons with it — one mispriced start
             # then outbids every tenant for the rest of its life.
-            total = max(1, req.upper - req.lower + 1)
-            if not self._inflight and self._qos_small(req):
-                cost = total
+            total = req.upper - req.lower + 1
+            if none_inflight and self._qos_is_small(total, cold,
+                                                    small_bound):
+                cost = max(1, total)
             else:
-                _, cost = self._qos_chunk_plan(
-                    total, len(self.miners) or 1)
+                _, cost = self._qos_chunk_plan(max(1, total), pool_n)
             heads[t] = ("start", req, cost)
         return heads
 
     def _coalescible_cost(self, req: Request, cost: int) -> bool:
-        """May a grant of ``cost`` nonces for ``req`` enter a coalescing
-        window? Argmin mode only, and SMALL twice over: an absolute
-        nonce bound (``max_nonces``) and an estimated-seconds bound at
-        the pool rate (``small_s``) — only a chunk whose scan is
-        launch-overhead-scale belongs in a shared launch; an absolute
-        bound alone would misclassify a slow pool's rate-scaled
-        elephant chunks as mice and serialize the elephant onto one
-        miner's window."""
-        if not self.coalesce.enabled or req.target \
-                or cost > self.coalesce.max_nonces:
-            return False
-        rate = self._pool_rate
-        if rate is not None and rate > 0:
-            return cost <= rate * self.coalesce.small_s
-        return True
+        return self.miner_plane.coalescible_cost(req.target, cost)
 
     def _window_slot(self, window: dict, job_id: int):
-        """The first open coalescing-window slot that can take a chunk
-        of ``job_id``: a free lane, NOT already holding this job
-        (windows batch across requests; stacking one request's own
-        chunks would just re-merge what the chunk planner split), on a
-        live non-quarantined miner. Returns ``(miner, slot)`` or
-        ``(None, None)``. ONE definition shared by pump candidacy
-        (:meth:`_window_room`) and the grant itself (:meth:`_qos_grant`)
-        — if the two drifted, the pump could admit a candidate the
-        grant cannot place and spin (code review)."""
-        for conn_id, slot in window.items():
-            if slot[1] >= self.coalesce.lanes or job_id in slot[2]:
-                continue
-            m = self._find_miner(conn_id)
-            if m is not None and not m.quarantined:
-                return m, slot
-        return None, None
+        return self.miner_plane.window_slot(window, job_id)
 
     def _window_room(self, window: dict, job_id: int = 0) -> bool:
-        """Any joinable window for ``job_id``? (See
-        :meth:`_window_slot`.)"""
-        if not window:
-            return False
-        return self._window_slot(window, job_id)[0] is not None
+        return self.miner_plane.window_room(window, job_id)
 
     def _qos_pump(self) -> None:
         """The QoS grant loop: while grantable work and pool capacity
@@ -1244,37 +1110,64 @@ class Scheduler:
         holds), which is what batches N mice onto one miner within a
         single pump pass. Windows live for ONE pass only — the next
         pump starts fresh, so a window can never span a lease sweep or
-        quarantine event."""
+        quarantine event.
+
+        Hot-path discipline (ISSUE 11): the DRR ring is synced to the
+        backlogged tenant set (idle tenants leave it, forfeiting their
+        deficit — the classic rule the old O(all tenants) reset loop
+        applied), and the pass EARLY-EXITS before any head scan when
+        the pool has no grant capacity and no wholesale/desperation
+        start is possible — an arrival storm on a saturated pool costs
+        O(miners) per event, not O(tenants)."""
         plane = self.qos_plane
-        # Classic DRR: a tenant whose backlog empties forfeits its
-        # accumulated deficit — idle time must not bank credit. Backlog =
-        # a queued request or an in-flight chunked request with ungranted
-        # chunks (NOT merely capacity-blocked tenants, which keep theirs).
-        backlogged = {r.conn_id for r in self.queue} | {
-            r.conn_id for r in self._inflight.values()
-            if r.qos_mode == "chunked" and r.next_chunk < r.num_chunks}
-        for t, st in plane.tenants.items():
-            if t not in backlogged:
-                st.deficit = 0.0
+        mp = self.miner_plane
+        tp = self.tenant_plane
+        # O(1) no-op exits FIRST (ISSUE 11): during a wholesale request
+        # with nothing chunked, no start may flow (stock one-at-a-time
+        # order) and no chunk head exists — the 10k-storm profile showed
+        # every chunk Result paying a full backlog walk here for
+        # nothing. Likewise an empty backlog.
+        if self._inflight and not self._chunked_inflight:
+            return
+        if not tp.queue_len() and not self._chunked_inflight:
+            return
+        backlogged = list(dict.fromkeys(
+            tp.backlog_tenants()
+            + [r.conn_id for r in self._inflight.values()
+               if r.qos_mode == "chunked"
+               and r.next_chunk < r.num_chunks]))
+        plane.sync_backlog(backlogged)
+        if not backlogged:
+            return
+        if not mp.capacity_pool(self.qos.depth) and \
+                (self._inflight or not (mp.eligible()
+                                        or mp.desperation_pool())):
+            return     # saturated: nothing grantable this event
         window: dict = {}
         while True:
             heads = self._qos_heads()
             if not heads:
                 break
-            eligible = self._eligible()
-            cap_pool = self._qos_capacity_pool()
+            eligible = mp.eligible()
+            cap_pool = mp.capacity_pool(self.qos.depth)
+            cold, small_bound = self._qos_small_bound()
+            none_inflight = not self._inflight
+            can_start = bool(eligible) or bool(mp.desperation_pool())
             candidates = {}
             for t, (kind, req, cost) in heads.items():
-                joinable = (self._coalescible_cost(req, cost)
-                            and self._window_room(window, req.job_id))
+                # window_room first: an empty window map (the common
+                # case) short-circuits the whole joinability test.
+                joinable = (mp.window_room(window, req.job_id)
+                            and self._coalescible_cost(req, cost))
                 if kind == "chunk":
                     if cap_pool or joinable:
                         candidates[t] = cost
-                elif not self._inflight and self._qos_small(req):
+                elif none_inflight and self._qos_is_small(
+                        req.upper - req.lower + 1, cold, small_bound):
                     # Wholesale start: needs the stock eligibility (or
                     # the desperation fallback), exactly like the FIFO
                     # pump.
-                    if eligible or self._desperation_pool():
+                    if can_start:
                         candidates[t] = cost
                 elif cap_pool or joinable:
                     candidates[t] = cost
@@ -1285,14 +1178,16 @@ class Scheduler:
             if kind == "chunk":
                 self._qos_grant(req, cap_pool, window)
                 continue
-            self.queue.remove(req)
-            self._queue_depth.set(len(self.queue))
+            self.tenant_plane.dequeue(req)
             if self._replay_at_dispatch(req):
                 continue
-            if not self._inflight and self._qos_small(req):
-                pool, desperate = self._eligible(), False
+            # Same (cold, bound) pair as candidacy above: pricing,
+            # candidacy, and the dispatch decision share ONE predicate.
+            if not self._inflight and self._qos_is_small(
+                    req.upper - req.lower + 1, cold, small_bound):
+                pool, desperate = mp.eligible(), False
                 if not pool:
-                    pool, desperate = self._desperation_pool(), True
+                    pool, desperate = mp.desperation_pool(), True
                 self._load_balance(req, pool, desperate=desperate)
             else:
                 self._qos_activate(req, cap_pool, window)
@@ -1308,10 +1203,12 @@ class Scheduler:
         self._next_job_id += 1
         req.job_id = self._next_job_id
         req.qos_mode = "chunked"
+        self._chunked_inflight += 1
         req.started = time.monotonic()
-        self._queue_wait.observe(req.started - req.queued_at)
-        self.traces.register(req.job_id, req.trace)
-        self._track_tenant(req.conn_id)
+        self.tenant_plane.observe_queue_wait(req.started - req.queued_at)
+        self.tenant_plane.traces.register(req.job_id, req.trace)
+        if not req.trace.null:
+            self.tenant_plane.track_tenant(req.conn_id)
         self._inflight[req.job_id] = req
         req.upper += 1  # inclusive -> exclusive
         total = req.upper - req.lower
@@ -1328,8 +1225,9 @@ class Scheduler:
         # DRR head pricing in _qos_heads — the activation may now run
         # with an EMPTY capacity pool (the window-joinable path), and
         # len(pool)=0 on a cold rate would plan ONE whole-request chunk
-        # that diverges from the priced head cost (code review).
-        n, _ = self._qos_chunk_plan(total, len(self.miners) or 1)
+        # that diverges from the priced head cost (code review, PR 8).
+        n, _ = self._qos_chunk_plan(total,
+                                    len(self.miner_plane.miners) or 1)
         bounds = []
         base = req.lower
         size, rem = divmod(total, n)
@@ -1355,13 +1253,14 @@ class Scheduler:
         OPENS a window there for later grants of this pump pass. Large
         or difficulty chunks never touch windows. Accounting (DRR
         debit, tenant in-flight, lease) is identical either way."""
+        mp = self.miner_plane
         idx = req.next_chunk
         lo, up = req.chunk_bounds[idx]
         miner = None
         cid = None
-        small = self._coalescible_cost(req, up - lo)
+        small = mp.coalescible_cost(req.target, up - lo)
         if small and window:
-            miner, slot = self._window_slot(window, req.job_id)
+            miner, slot = mp.window_slot(window, req.job_id)
             if miner is not None:
                 cid = slot[0]
                 slot[1] += 1
@@ -1373,54 +1272,18 @@ class Scheduler:
             miner = pool[0]
             if small and window is not None \
                     and miner.conn_id not in window:
-                self._next_coalesce_id += 1
-                cid = self._next_coalesce_id
-                window[miner.conn_id] = [cid, 1, {req.job_id}]
+                cid = mp.open_window(window, miner, req.job_id)
         req.next_chunk += 1
         req.granted_chunks += 1
         self._count("qos_grants")
         self.qos_plane.on_grant(req.conn_id, up - lo)
-        self._assign_chunk(
+        mp.assign_chunk(
             miner, Chunk(req.job_id, req.data, lo, up,
                          target=req.target, idx=idx, coalesce_id=cid),
             kind="qos")
 
     def _shed(self, req: Request, reason: str) -> None:
-        """Shed one request under admission/overload pressure: cancel it
-        through the trace/cancel path and CLOSE its conn. Classic LSP has
-        no reject message, so the conn close is the signal — the client's
-        transport declares the conn dead within its epoch window and
-        ``submit_with_retry`` backs off and resubmits, instead of hanging
-        into its wire deadline. The tenant's other QUEUED requests ride
-        the same dying conn and are purged with it (in-flight work
-        finishes; its reply write fails harmlessly)."""
-        victims = [req] + [r for r in self.queue
-                           if r.conn_id == req.conn_id and r is not req]
-        self.queue = [r for r in self.queue if r.conn_id != req.conn_id]
-        self._queue_depth.set(len(self.queue))
-        for i, victim in enumerate(victims):
-            self._count("qos_shed")
-            self.qos_plane.on_shed(victim.conn_id,
-                                   reason if i == 0 else "conn")
-            victim.trace.event("cancel", reason="shed", shed_reason=reason)
-            self._cache_trace_seq += 1
-            self.traces.register(f"shed:{self._cache_trace_seq}",
-                                 victim.trace)
-            self._track_tenant(victim.conn_id)
-            if self._trace_on:
-                _tracing.flight("shed", tenant=victim.conn_id,
-                                reason=reason)
-        logger.warning(
-            "QoS shed (%s): request %r [%d, %d] from tenant %d "
-            "(+%d queued sibling(s)); closing its conn so the client "
-            "backs off and resubmits", reason, req.data, req.lower,
-            req.upper, req.conn_id, len(victims) - 1)
-        close = getattr(self.server, "close_conn", None)
-        if close is not None:
-            try:
-                close(req.conn_id)
-            except Exception:  # noqa: BLE001 — conn may already be gone
-                logger.info("shed: conn %d already closed", req.conn_id)
+        self.tenant_plane.shed(req, reason)
 
     def _load_balance(self, request: Request, pool: list[MinerState],
                       desperate: bool = False) -> None:
@@ -1431,14 +1294,17 @@ class Scheduler:
         request in flight, so every miner is free at dispatch); quarantined
         or still-busy miners (wedged compute holding a live lease-blown
         chunk) are excluded."""
+        mp = self.miner_plane
         self._next_job_id += 1
         request.job_id = self._next_job_id
         request.qos_mode = "wholesale"
         self._inflight[request.job_id] = request
         request.started = time.monotonic()
-        self._queue_wait.observe(request.started - request.queued_at)
-        self.traces.register(request.job_id, request.trace)
-        self._track_tenant(request.conn_id)
+        self.tenant_plane.observe_queue_wait(
+            request.started - request.queued_at)
+        self.tenant_plane.traces.register(request.job_id, request.trace)
+        if not request.trace.null:
+            self.tenant_plane.track_tenant(request.conn_id)
         request.trace.event("dispatch", job=request.job_id,
                             miners=[m.conn_id for m in pool],
                             desperate=desperate)
@@ -1452,7 +1318,7 @@ class Scheduler:
                 "DESPERATION dispatch: entire pool (%d miner(s)) is "
                 "quarantined; assigning request %r [%d, %d] to least-bad "
                 "miner %d (blown streak %d, rate %s) as a last resort",
-                len(self.miners), request.data, request.lower,
+                len(mp.miners), request.data, request.lower,
                 request.upper, m.conn_id, m.blown_streak,
                 f"{m.rate_ewma:.0f}/s" if m.rate_ewma else "unknown")
         num = len(pool)
@@ -1479,8 +1345,8 @@ class Scheduler:
         for i in range(num):
             end = start + individual + (leftover if i == 0 else 0)
             share = end - start
-            n_i = self._stripe_chunks(pool[i], share)
-            self._stripe_depth.observe(n_i)
+            n_i = mp.stripe_chunks(pool[i], share)
+            mp.observe_stripe(n_i)
             base = start
             for j in range(n_i):
                 size = share // n_i + (1 if j < share % n_i else 0)
@@ -1501,340 +1367,64 @@ class Scheduler:
             for _, lo, up in plan:
                 self.qos_plane.on_grant(request.conn_id, up - lo)
         for idx, (miner, lo, up) in enumerate(plan):
-            self._assign_chunk(
+            mp.assign_chunk(
                 miner,
                 Chunk(request.job_id, request.data, lo, up,
                       target=request.target, idx=idx))
 
-    def _stripe_chunks(self, miner: MinerState, share: int) -> int:
-        """Chunk count for one miner's share: ``ceil(share / (rate *
-        chunk_s))`` capped at ``stripe.depth``. 1 (the stock even split)
-        when striping is off, the share is trivial, or no throughput has
-        been observed yet — a cold pool's first request is always
-        bit-identical to the reference split, so the parity/conformance
-        shape needs no knob to reproduce."""
-        if not self.stripe.enabled or share <= 1:
-            return 1
-        rate = miner.rate_ewma if miner.rate_ewma is not None \
-            else self._pool_rate
-        if rate is None or rate <= 0:
-            return 1
-        target = max(1, int(rate * self.stripe.chunk_s))
-        return max(1, min(self.stripe.depth, -(-share // target)))
+    # ---------------------------------------- plane shims (compat surface)
+
+    # The pre-split private surface, delegated: tests, the dbmcheck
+    # harness, and the bench probes drive these; new code should call
+    # the planes directly.
+
+    def _find_miner(self, conn_id: int) -> Optional[MinerState]:
+        return self.miner_plane.find_miner(conn_id)
+
+    def _eligible(self) -> list[MinerState]:
+        return self.miner_plane.eligible()
+
+    def _desperation_pool(self) -> list[MinerState]:
+        return self.miner_plane.desperation_pool()
+
+    def _next_parked(self, skip_key=None) -> Optional[Chunk]:
+        return self.miner_plane.next_parked(skip_key=skip_key)
 
     def _assign_chunk(self, miner: MinerState, chunk: Chunk,
                       kind: str = "initial") -> None:
-        chunk.assigned_at = time.monotonic()
-        chunk.lease_blown = False
-        chunk.reissued = False
-        chunk.lease_started = False
-        chunk.deadline = 0.0
-        miner.pending.append(chunk)
-        # Position-aware lease clock (see module docstring): a chunk at
-        # the FIFO head starts its tight lease now; one assigned behind
-        # other entries gets a BUDGET deadline (latest predecessor expiry
-        # + its own lease) that is tightened when it reaches the head
-        # (_on_result) — so a deep healthy FIFO never blows spuriously,
-        # but a FIFO wedged at its head still expires. fifo_aware=False
-        # restores the at-assignment clock unconditionally.
-        if not self.lease.fifo_aware or len(miner.pending) == 1:
-            self._start_lease(miner, chunk)
-        else:
-            now = chunk.assigned_at
-            ahead = max((c.deadline for c in miner.pending[:-1]),
-                        default=now)
-            chunk.deadline = max(now, ahead) + self._lease_for(miner, chunk)
-        trace = self.traces.get(chunk.job_id)
-        if trace is not None:
-            trace.event("assign", miner=miner.conn_id, idx=chunk.idx,
-                        lower=chunk.lower, upper=chunk.upper, kind=kind,
-                        fifo_pos=len(miner.pending) - 1,
-                        lease_started=chunk.lease_started)
-        if self._trace_on:
-            _tracing.flight("assign", job=chunk.job_id, idx=chunk.idx,
-                            miner=miner.conn_id, kind=kind)
-        self._write(miner.conn_id,
-                    new_request(chunk.data, chunk.lower, chunk.upper,
-                                chunk.target))
-
-    # ---------------------------------------------------------- lease plane
+        self.miner_plane.assign_chunk(miner, chunk, kind=kind)
 
     def _start_lease(self, miner: MinerState, chunk: Chunk) -> None:
-        """Start the lease clock: the miner is (about to be) computing this
-        chunk. ``assigned_at`` is re-stamped so both the expiry log and the
-        throughput sample measure actual compute time, not FIFO wait."""
-        now = time.monotonic()
-        if chunk.assigned_at:
-            self._lease_wait.observe(now - chunk.assigned_at)
-        chunk.assigned_at = now
-        chunk.deadline = now + self._lease_for(miner, chunk)
-        chunk.lease_started = True
-
-    #: Wall-clock span one throughput sample must cover (window-union
-    #: accounting, the scheduler-side analog of the miner's
-    #: _ThroughputWindow from ISSUE 4).
-    RATE_WINDOW_S = 0.5
+        self.miner_plane.start_lease(miner, chunk)
 
     def _observe_result(self, miner: MinerState, chunk: Chunk) -> None:
-        """Per-pop bookkeeping: throughput sampling, streak reset,
-        quarantine lift. Runs for EVERY pop — stale and cancelled chunks
-        were computed too, so they are valid throughput samples, and an
-        answer is an answer for quarantine purposes ("until it answers
-        again").
-
-        Throughput is sampled over a WALL-CLOCK WINDOW per miner, not per
-        pop: the pipelined miner computes chunk k+1 while k's result is
-        in flight, so k+1's Result arrives milliseconds after its lease
-        re-stamp and a per-pop size/elapsed sample reads as 10^9
-        nonces/s — which then poisons every consumer (stripe plans grow
-        one-giant-chunk, the QoS wholesale gate misclassifies elephants,
-        leases collapse to the floor). Accumulating answered nonces until
-        ``RATE_WINDOW_S`` of wall clock has passed measures the miner's
-        true OUTPUT rate regardless of internal overlap."""
-        alpha = self.lease.ewma_alpha
-        now = time.monotonic()
-        if chunk.assigned_at and not chunk.lease_blown and not chunk.target:
-            # Two exclusions keep the sample set honest (they also RESET
-            # the window below). Blown-lease answers: a wedged miner's
-            # eventual 60s "sample" would inflate its (and the pool's)
-            # lease to minutes and blunt re-wedge detection. Difficulty
-            # chunks: an in-kernel early exit may scan 1% of the range,
-            # so size/elapsed would overestimate throughput ~100x and
-            # starve every later stock chunk's lease.
-            if miner.win_nonces == 0 \
-                    or now - miner.win_t0 > 4 * self.RATE_WINDOW_S:
-                # Fresh (or stale — an idle gap must not deflate the
-                # sample) window, anchored at this chunk's lease start.
-                miner.win_t0 = chunk.assigned_at or now
-                miner.win_nonces = 0
-            miner.win_nonces += chunk.size
-            elapsed = now - miner.win_t0
-            if elapsed >= self.RATE_WINDOW_S:
-                rate = miner.win_nonces / elapsed
-                miner.win_t0, miner.win_nonces = now, 0
-                miner.rate_ewma = rate if miner.rate_ewma is None else \
-                    alpha * rate + (1 - alpha) * miner.rate_ewma
-                self._pool_rate = rate if self._pool_rate is None else \
-                    alpha * rate + (1 - alpha) * self._pool_rate
-                self.metrics.gauge(
-                    "miner_rate_nps",
-                    miner=str(miner.conn_id)).set(miner.rate_ewma)
-                self.metrics.gauge("pool_rate_nps").set(self._pool_rate)
-        else:
-            miner.win_t0, miner.win_nonces = 0.0, 0
-        miner.blown_streak = 0
-        if miner.quarantined:
-            miner.quarantined = False
-            self._update_pool_gauges()
-            logger.info("miner %d answered; quarantine lifted",
-                        miner.conn_id)
-            self._maybe_dispatch()
+        self.miner_plane.observe_result(miner, chunk)
 
     def _lease_for(self, miner: MinerState, chunk: Chunk) -> float:
-        """Lease duration for assigning ``chunk`` to ``miner``: headroom
-        over the EWMA-predicted scan time, clamped below; a flat grace when
-        nothing has been observed yet (cold pool)."""
-        if not self.lease.enabled:
-            return float("inf")
-        rate = miner.rate_ewma if miner.rate_ewma is not None \
-            else self._pool_rate
-        if rate is None or rate <= 0:
-            return self.lease.grace_s
-        return max(self.lease.floor_s, chunk.size / rate * self.lease.factor)
+        return self.miner_plane.lease_for(miner, chunk)
 
-    def _check_queue_age(self) -> None:
-        """Age alarms (ROADMAP open item + ISSUE 3; per-tenant since
-        ISSUE 5): the OLDEST queued request of each TENANT past
-        ``lease.queue_alarm_s`` — and any request still IN FLIGHT past the
-        same bound — emits a structured warning, once per bound interval
-        per request, plus a full trace dump so the stall explains itself
-        (a queued request's stall is usually an in-flight request's wedged
-        miner, so the oldest in-flight trace is dumped alongside).
+    def _stripe_chunks(self, miner: MinerState, share: int) -> int:
+        return self.miner_plane.stripe_chunks(miner, share)
 
-        The alarm and its dump carry the tenant's cumulative GRANT SHARE,
-        so a starved mouse (near-zero share despite backlog) is
-        distinguishable from a busy elephant (large share, long queue by
-        its own volume). Observability only: never changes scheduling."""
-        bound = self.lease.queue_alarm_s
-        if bound <= 0:
-            return
-        now = time.monotonic()
-        curr = self.current
-        queue_alarmed = False
-        # Oldest queued request per tenant (queue is FIFO: first seen
-        # wins). Under the stock FIFO path every tenant still alarms on
-        # its own oldest request — the pre-ISSUE-5 behavior alarmed on
-        # every over-age request; per-tenant-oldest is strictly the more
-        # readable subset (later same-tenant requests are queued behind
-        # the alarmed one by definition).
-        oldest: dict = {}
-        for req in self.queue:
-            oldest.setdefault(req.conn_id, req)
-        for req in oldest.values():
-            age = now - req.queued_at
-            if age < bound or now - req.last_alarm < bound:
-                continue
-            req.last_alarm = now
-            queue_alarmed = True
-            share = self.qos_plane.grant_share(req.conn_id)
-            self._count("queue_alarms")
-            logger.warning(
-                "tenant %d: oldest request %r [%d, %d] queued for %.1fs "
-                "(bound %.1fs): grant_share=%.3f pool=%d eligible=%d "
-                "in_flight=%d",
-                req.conn_id, req.data, req.lower, req.upper, age, bound,
-                share, len(self.miners), len(self._eligible()),
-                len(self._inflight))
-            req.trace.event("queue_alarm", age_s=round(age, 3),
-                            tenant=req.conn_id,
-                            grant_share=round(share, 4))
-            self._dump_trace("queue-age alarm: stalled request", req.trace)
-        inflight_due = [
-            r for r in self._inflight.values()
-            if now - r.started >= bound
-            and now - r.last_inflight_alarm >= bound]
-        if queue_alarmed and curr is not None and curr not in inflight_due:
-            # An in-flight request is the usual culprit; the oldest one's
-            # trace is the same document for every stalled request, so
-            # dump it once per sweep — and not at all when the in-flight
-            # alarm below dumps the identical document anyway.
-            self._dump_trace("queue-age alarm: request in flight "
-                             "ahead of the stalled one", curr.trace)
-        for req in inflight_due:
-            age = now - req.started
-            req.last_inflight_alarm = now
-            share = self.qos_plane.grant_share(req.conn_id)
-            self._count("inflight_alarms")
-            logger.warning(
-                "request %d (tenant %d) in flight for %.1fs (bound %.1fs): "
-                "%d/%d chunks answered, %d granted, grant_share=%.3f",
-                req.job_id, req.conn_id, age, bound, sum(req.answered),
-                req.num_chunks, req.granted_chunks, share)
-            req.trace.event("inflight_alarm", age_s=round(age, 3),
-                            tenant=req.conn_id,
-                            grant_share=round(share, 4))
-            self._dump_trace("in-flight age alarm", req.trace)
-        if self._trace_on and (queue_alarmed or inflight_due):
-            # Flight-recorder post-mortem (ISSUE 10): the alarm's trace
-            # dump explains ONE request; the ring shows what the whole
-            # control plane did around the stall. Once per sweep even
-            # when both alarm kinds fired — the ring is one document.
-            _tracing.flight_dump("queue-age / in-flight alarm")
+    def _miner_live(self, miner: MinerState) -> int:
+        return self.miner_plane.miner_live(miner)
+
+    def _qos_capacity_pool(self) -> list[MinerState]:
+        return self.miner_plane.capacity_pool(self.qos.depth)
+
+    def _update_pool_gauges(self) -> None:
+        self.miner_plane.update_pool_gauges()
 
     def _check_leases(self) -> None:
-        """One lease sweep: blow expired leases (quarantining repeat
-        offenders) and speculatively re-issue each blown chunk to an
-        eligible miner — first Result wins, the loser pops as a duplicate
-        (``_on_result``). A blown chunk with no taker stays watched and is
-        re-issued on a later sweep once a miner frees up or joins.
-
-        Every in-flight job is swept: the stock FIFO path has at most one,
-        but the QoS plane (ISSUE 5) runs several concurrently — a wedged
-        miner holding a mouse's chunk must blow even while an elephant's
-        chunks are also live."""
         if self._owner is not None:
             self._owner.assert_here()
-        if not self._inflight:
-            return
-        now = time.monotonic()
-        # Per-miner MINIMUM remaining lease (a deep budgeted chunk must not
-        # mask the head chunk's imminent expiry), set after the sweep.
-        per_miner_remaining: dict[int, float] = {}
-        for miner in list(self.miners):
-            for chunk in list(miner.pending):
-                if chunk.cancelled:
-                    continue
-                curr = self._inflight.get(chunk.job_id)
-                if curr is None or curr.answered[chunk.idx]:
-                    continue
-                if not chunk.lease_blown:
-                    if now < chunk.deadline:
-                        remaining = chunk.deadline - now
-                        prev = per_miner_remaining.get(miner.conn_id)
-                        if prev is None or remaining < prev:
-                            per_miner_remaining[miner.conn_id] = remaining
-                        continue
-                    chunk.lease_blown = True
-                    self._count("leases_blown")
-                    # With the at-assignment clock (fifo_aware=False) a
-                    # chunk can blow while entries still sit AHEAD of it —
-                    # the miner never even reached it. Counted so the
-                    # position-aware fix has before/after evidence. (With
-                    # fifo_aware, a pre-head blow means the budgeted
-                    # deadline covering the predecessors ALSO ran out —
-                    # the whole pipeline is overdue, not spurious.)
-                    spurious = (not self.lease.fifo_aware
-                                and miner.pending[0] is not chunk)
-                    if spurious:
-                        self._count("leases_blown_spurious")
-                    miner.blown_streak += 1
-                    curr.trace.event("lease_blown", miner=miner.conn_id,
-                                     idx=chunk.idx,
-                                     streak=miner.blown_streak,
-                                     spurious=spurious)
-                    if self._trace_on:
-                        _tracing.flight("lease_blown", job=chunk.job_id,
-                                        idx=chunk.idx,
-                                        miner=miner.conn_id,
-                                        streak=miner.blown_streak)
-                    logger.warning(
-                        "miner %d blew the lease on job %d chunk %d "
-                        "[%d, %d) after %.2fs (streak %d)%s",
-                        miner.conn_id, chunk.job_id, chunk.idx,
-                        chunk.lower, chunk.upper, now - chunk.assigned_at,
-                        miner.blown_streak,
-                        " [spurious: miner had not reached this chunk]"
-                        if spurious else "")
-                    if (miner.blown_streak >= self.lease.quarantine_after
-                            and not miner.quarantined):
-                        miner.quarantined = True
-                        self._count("quarantines")
-                        self._update_pool_gauges()
-                        curr.trace.event("quarantine",
-                                         miner=miner.conn_id)
-                        logger.warning(
-                            "miner %d quarantined after %d consecutive "
-                            "blown leases; no new assignments until it "
-                            "answers", miner.conn_id, miner.blown_streak)
-                if chunk.reissued:
-                    continue
-                takeover = next(
-                    (m for m in self._eligible() if m is not miner), None)
-                if takeover is None:
-                    continue   # retry next sweep
-                chunk.reissued = True
-                self._count("reissues")
-                curr.trace.event("reissue", idx=chunk.idx,
-                                 from_miner=miner.conn_id,
-                                 to_miner=takeover.conn_id)
-                if self._trace_on:
-                    _tracing.flight("reissue", job=chunk.job_id,
-                                    idx=chunk.idx,
-                                    from_miner=miner.conn_id,
-                                    to_miner=takeover.conn_id)
-                logger.warning(
-                    "speculatively re-issuing job %d chunk %d [%d, %d) "
-                    "from miner %d to miner %d",
-                    chunk.job_id, chunk.idx, chunk.lower, chunk.upper,
-                    miner.conn_id, takeover.conn_id)
-                self._assign_chunk(
-                    takeover,
-                    Chunk(chunk.job_id, chunk.data, chunk.lower,
-                          chunk.upper, target=chunk.target, idx=chunk.idx),
-                    kind="reissue")
-        # Miners with no live unexpired lease this sweep (blown, answered,
-        # or idle) lose their series: a stale positive "remaining" on a
-        # blown lease would read as healthy headroom.
-        for m in self.miners:
-            if m.conn_id not in per_miner_remaining:
-                self.metrics.remove("lease_remaining_s",
-                                    miner=str(m.conn_id))
-        for conn_id, remaining in per_miner_remaining.items():
-            self.metrics.gauge("lease_remaining_s",
-                               miner=str(conn_id)).set(remaining)
-        self._lease_min_remaining.set(
-            min(per_miner_remaining.values()) if per_miner_remaining
-            else 0.0)
+        self.miner_plane.check_leases()
+
+    def _check_queue_age(self) -> None:
+        self.tenant_plane.check_queue_age(
+            self._inflight, self.current,
+            len(self.miner_plane.miners),
+            len(self.miner_plane.eligible()))
 
     def _write(self, conn_id: int, msg: Message) -> None:
         try:

@@ -20,13 +20,25 @@ async def serve(port: int, params: Params | None = None,
                 lease: LeaseParams | None = None,
                 cache: CacheParams | None = None,
                 stripe: StripeParams | None = None,
-                qos: QosParams | None = None) -> None:
+                qos: QosParams | None = None,
+                replicas: int | None = None) -> None:
     server = await new_async_server(port, params or Params())
     print("Server listening on port", server.port, flush=True)
-    scheduler = Scheduler(server, lease=lease, cache=cache, stripe=stripe,
-                          qos=qos)
+    # Replica tier (ISSUE 11): DBM_REPLICAS>1 shards tenants by
+    # consistent hash across N in-process scheduler replicas, each
+    # owning a miner-pool slice, with one shared ResultCache replay
+    # tier. The default (1) is the plain single scheduler — today's
+    # topology bit-for-bit.
+    from .replicas import ReplicaSet, replicas_from_env
+    n = replicas if replicas is not None else replicas_from_env()
+    if n > 1:
+        coordinator = ReplicaSet(server, n, lease=lease, cache=cache,
+                                 stripe=stripe, qos=qos)
+    else:
+        coordinator = Scheduler(server, lease=lease, cache=cache,
+                                stripe=stripe, qos=qos)
     try:
-        await scheduler.run()
+        await coordinator.run()
     finally:
         await server.close()
 

@@ -1,0 +1,372 @@
+"""Tenant plane: conn lifecycle, admission, queue, QoS/DRR, trace/alarm.
+
+The other half of the ISSUE 11 plane split (see ``apps/miner_plane.py``
+for the interface overview). This module owns everything
+TENANT-FACING:
+
+- the request QUEUE — stored as an insertion-ordered map plus a
+  per-tenant FIFO index, so every hot operation is O(1)-amortized
+  (enqueue, head pop, targeted dequeue, a tenant's purge) and the
+  QoS pump's per-tenant head scan is O(backlogged tenants), not
+  O(queued requests): the old list-scan shape was an O(n²) melt under
+  a 10k-tenant arrival storm (ISSUE 11). ``Scheduler.queue`` remains
+  a list view for tests/operators.
+- ADMISSION and SHEDDING — per-tenant token buckets, the oldest-first
+  overload shed, and the conn-close signalling (classic LSP has no
+  reject message);
+- the :class:`~..apps.qos.QosPlane` (deficit-round-robin state) and the
+  per-tenant weights;
+- TRACE bookkeeping — the TraceBuffer, export TrackSet, the
+  ``DBM_TRACE_SAMPLE`` sampling decision (unsampled requests carry the
+  shared :data:`~..utils.metrics.NULL_TRACE` and never register), and
+  the queue-age / in-flight age ALARMS with their trace dumps.
+
+The scheduler keeps the request state machine (merge, barriers,
+in-flight set) and drives this plane through plain method calls; the
+miner plane never touches tenant state.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..utils import trace as _tracing
+from ..utils.config import LeaseParams, QosParams
+from ..utils.metrics import (LATENCY_BUCKETS_S, NULL_TRACE, Registry,
+                             RequestTrace, TraceBuffer)
+from .qos import QosPlane
+
+logger = logging.getLogger("dbm.scheduler")
+
+__all__ = ["TenantPlane"]
+
+
+class TenantPlane:
+    """The tenant-facing half of the scheduler (see module docstring).
+
+    Injected pieces: the shared metrics ``Registry``, the scheduler's
+    counter bump (``count``), the QoS/lease param blocks, the admission
+    ``clock`` (virtual under dbmcheck), and ``close_conn`` — the
+    transport close used by the shed path.
+    """
+
+    def __init__(self, metrics: Registry, count: Callable[..., None],
+                 qos: QosParams, lease: LeaseParams, *,
+                 clock=None, close_conn: Optional[Callable] = None,
+                 trace_on: bool = False,
+                 trace_sample: Optional[float] = None):
+        self.metrics = metrics
+        self._count = count
+        self.qos = qos
+        self.lease = lease
+        self._close_conn = close_conn
+        self._trace_on = trace_on
+        # Trace sampling (ISSUE 11, DBM_TRACE_SAMPLE): 1.0 = stock
+        # (every request allocates a real RequestTrace), read once at
+        # construction like every other scheduler param.
+        self.trace_sample = (trace_sample if trace_sample is not None
+                             else _tracing.sample_rate())
+        self._arrival_seq = 0
+        self.qos_plane = QosPlane(
+            metrics, clock=clock if clock is not None else time.monotonic)
+        self._tenant_weights: dict = {}    # programmatic overrides
+        # Queue: insertion-ordered map (arrival order) + per-tenant FIFO
+        # index. ``qkey`` stamps live on the Request.
+        self._queue: Dict[int, object] = {}
+        self._by_tenant: Dict[object, deque] = {}
+        self._next_qkey = 0
+        self.traces = TraceBuffer()
+        self.tracks = _tracing.TrackSet()
+        self._cache_trace_seq = 0
+        self._queue_depth = metrics.gauge("queue_depth")
+        self._queue_wait = metrics.histogram("queue_wait_s",
+                                             LATENCY_BUCKETS_S)
+
+    # ------------------------------------------------------------ tenants
+
+    def weight_for(self, tenant) -> float:
+        w = self._tenant_weights.get(tenant)
+        return w if w is not None else self.qos.weight_for(tenant)
+
+    def set_weight(self, tenant, weight: float) -> None:
+        self._tenant_weights[tenant] = max(weight, 1e-3)
+        self.qos_plane.set_weight(tenant, weight)
+
+    def tenant(self, conn_id):
+        """The QoS tenant state for a conn, created with the configured
+        weight and admission bucket on first sight."""
+        return self.qos_plane.tenant(conn_id, self.weight_for(conn_id),
+                                     self.qos.rate, self.qos.burst)
+
+    def admit(self, conn_id) -> bool:
+        """Create-on-first-sight + spend one admission token; False =
+        shed at admission (the caller never queues the request)."""
+        self.tenant(conn_id)
+        return self.qos_plane.admit(conn_id)
+
+    # -------------------------------------------------------------- queue
+
+    @property
+    def queue(self) -> list:
+        """Arrival-ordered list VIEW of the queued requests (the
+        pre-split ``Scheduler.queue`` surface; built per access — hot
+        paths use the indexed operations below).
+
+        READ-ONLY in effect: the returned list is a fresh copy, so
+        mutating it (``sched.queue.append(...)``) silently changes
+        nothing — unlike the ``miners``/``parked`` views, which hand
+        out the planes' live lists. Drivers that inject requests
+        directly call :meth:`enqueue` instead."""
+        return list(self._queue.values())
+
+    def queue_len(self) -> int:
+        return len(self._queue)
+
+    def enqueue(self, req) -> None:
+        self._next_qkey += 1
+        req.qkey = self._next_qkey
+        self._queue[req.qkey] = req
+        self._by_tenant.setdefault(req.conn_id, deque()).append(req)
+        self._queue_depth.set(len(self._queue))
+
+    def dequeue(self, req) -> None:
+        """Remove one specific queued request (a pump grant)."""
+        if self._queue.pop(req.qkey, None) is None:
+            return
+        dq = self._by_tenant.get(req.conn_id)
+        if dq:
+            if dq[0] is req:
+                dq.popleft()
+            else:
+                try:
+                    dq.remove(req)
+                except ValueError:
+                    pass
+            if not dq:
+                del self._by_tenant[req.conn_id]
+        self._queue_depth.set(len(self._queue))
+
+    def pop_head(self):
+        """Pop the globally oldest queued request, or None."""
+        if not self._queue:
+            return None
+        req = next(iter(self._queue.values()))
+        self.dequeue(req)
+        return req
+
+    def head(self):
+        """The globally oldest queued request without popping."""
+        return next(iter(self._queue.values()), None)
+
+    def purge_tenant(self, conn_id) -> list:
+        """Remove (and return, in arrival order) every queued request
+        of one tenant — client drop and shed both use this; O(own
+        requests), never a full-queue scan."""
+        dq = self._by_tenant.pop(conn_id, None)
+        if not dq:
+            return []
+        out = list(dq)
+        for req in out:
+            self._queue.pop(req.qkey, None)
+        self._queue_depth.set(len(self._queue))
+        return out
+
+    def tenant_heads(self):
+        """``(tenant, oldest queued request)`` pairs, in the order
+        tenants first queued work — the QoS pump's start-candidate scan,
+        O(backlogged tenants)."""
+        return [(t, dq[0]) for t, dq in self._by_tenant.items() if dq]
+
+    def backlog_tenants(self) -> list:
+        """Tenants with queued work, first-queued order (ring sync)."""
+        return [t for t, dq in self._by_tenant.items() if dq]
+
+    def observe_queue_wait(self, waited_s: float) -> None:
+        self._queue_wait.observe(waited_s)
+
+    # ------------------------------------------------------------- traces
+
+    def new_trace(self, **meta):
+        """A request's trace: a real :class:`RequestTrace`, or the
+        shared :data:`NULL_TRACE` when the deterministic sampler says
+        this request is unsampled (``DBM_TRACE_SAMPLE`` < 1)."""
+        self._arrival_seq += 1
+        if _tracing.sample_hit(self._arrival_seq, self.trace_sample):
+            return RequestTrace(**meta)
+        return NULL_TRACE
+
+    def track_tenant(self, conn_id) -> None:
+        if self._trace_on:
+            self.tracks.track("trace_track", tenant=str(conn_id))
+
+    def track_miner(self, conn_id) -> None:
+        if self._trace_on:
+            self.tracks.track("trace_track", miner=str(conn_id))
+
+    def retire_tenant_track(self, conn_id) -> None:
+        self.tracks.retire("trace_track", tenant=str(conn_id))
+
+    def retire_miner_track(self, conn_id) -> None:
+        self.tracks.retire("trace_track", miner=str(conn_id))
+
+    def dump_trace(self, why: str, trace) -> None:
+        """Structured single-line JSON dump of one request trace — the
+        queue-age alarm's "a stalled request explains itself" payload."""
+        if trace is None or trace.null:
+            return
+        logger.warning("trace dump (%s): %s", why,
+                       json.dumps(trace.to_dict(), sort_keys=True,
+                                  default=str))
+
+    def cache_replay_trace(self, conn_id, key, h: int, nonce: int) -> None:
+        """An at-enqueue memo replay never builds a Request (and never
+        gets a job id): trace it under a synthetic ``cache:N`` key so
+        trace completeness still holds. (A replay at DISPATCH time reuses
+        the queued Request's own trace instead — its enqueue stamp and
+        queue wait are real history that must not be discarded.)"""
+        self._cache_trace_seq += 1
+        trace = self.new_trace(data=key[0], lower=key[1], upper=key[2],
+                               target=key[3], client=conn_id)
+        if trace.null:
+            return
+        trace.event("enqueue", queue_depth=len(self._queue))
+        trace.event("cache_hit", at="request")
+        trace.event("reply", hash=h, nonce=nonce, cached=True)
+        self.traces.register(f"cache:{self._cache_trace_seq}", trace)
+        self.track_tenant(conn_id)
+
+    def register_replay(self, req) -> None:
+        """Register a dispatch-time cache replay's trace under a
+        synthetic key (it never gets a job id)."""
+        self._cache_trace_seq += 1
+        self.traces.register(f"cache:{self._cache_trace_seq}", req.trace)
+        if not req.trace.null:
+            self.track_tenant(req.conn_id)
+
+    # ----------------------------------------------------------- shedding
+
+    def shed(self, req, reason: str) -> None:
+        """Shed one request under admission/overload pressure: cancel it
+        through the trace/cancel path and CLOSE its conn. Classic LSP has
+        no reject message, so the conn close is the signal — the client's
+        transport declares the conn dead within its epoch window and
+        ``submit_with_retry`` backs off and resubmits, instead of hanging
+        into its wire deadline. The tenant's other QUEUED requests ride
+        the same dying conn and are purged with it (in-flight work
+        finishes; its reply write fails harmlessly)."""
+        others = self.purge_tenant(req.conn_id)
+        victims = [req] + [r for r in others if r is not req]
+        for i, victim in enumerate(victims):
+            self._count("qos_shed")
+            self.qos_plane.on_shed(victim.conn_id,
+                                   reason if i == 0 else "conn")
+            victim.trace.event("cancel", reason="shed", shed_reason=reason)
+            self._cache_trace_seq += 1
+            self.traces.register(f"shed:{self._cache_trace_seq}",
+                                 victim.trace)
+            if not victim.trace.null:
+                self.track_tenant(victim.conn_id)
+            if self._trace_on:
+                _tracing.flight("shed", tenant=victim.conn_id,
+                                reason=reason)
+        logger.warning(
+            "QoS shed (%s): request %r [%d, %d] from tenant %d "
+            "(+%d queued sibling(s)); closing its conn so the client "
+            "backs off and resubmits", reason, req.data, req.lower,
+            req.upper, req.conn_id, len(victims) - 1)
+        if self._close_conn is not None:
+            try:
+                self._close_conn(req.conn_id)
+            except Exception:  # noqa: BLE001 — conn may already be gone
+                logger.info("shed: conn %d already closed", req.conn_id)
+
+    # ------------------------------------------------------------- alarms
+
+    def check_queue_age(self, inflight: dict, current,
+                        miners_n: int, eligible_n: int) -> None:
+        """Age alarms (ROADMAP open item + ISSUE 3; per-tenant since
+        ISSUE 5): the OLDEST queued request of each TENANT past
+        ``lease.queue_alarm_s`` — and any request still IN FLIGHT past the
+        same bound — emits a structured warning, once per bound interval
+        per request, plus a full trace dump so the stall explains itself
+        (a queued request's stall is usually an in-flight request's wedged
+        miner, so the oldest in-flight trace is dumped alongside).
+
+        The alarm and its dump carry the tenant's cumulative GRANT SHARE,
+        so a starved mouse (near-zero share despite backlog) is
+        distinguishable from a busy elephant (large share, long queue by
+        its own volume). Observability only: never changes scheduling.
+        The per-tenant-oldest scan rides the FIFO index — O(backlogged
+        tenants) per sweep, not O(queued requests) (ISSUE 11)."""
+        bound = self.lease.queue_alarm_s
+        if bound <= 0:
+            return
+        now = time.monotonic()
+        queue_alarmed = False
+        for _tenant, req in self.tenant_heads():
+            age = now - req.queued_at
+            if age < bound or now - req.last_alarm < bound:
+                continue
+            req.last_alarm = now
+            queue_alarmed = True
+            share = self.qos_plane.grant_share(req.conn_id)
+            self._count("queue_alarms")
+            logger.warning(
+                "tenant %d: oldest request %r [%d, %d] queued for %.1fs "
+                "(bound %.1fs): grant_share=%.3f pool=%d eligible=%d "
+                "in_flight=%d",
+                req.conn_id, req.data, req.lower, req.upper, age, bound,
+                share, miners_n, eligible_n, len(inflight))
+            req.trace.event("queue_alarm", age_s=round(age, 3),
+                            tenant=req.conn_id,
+                            grant_share=round(share, 4))
+            self.dump_trace("queue-age alarm: stalled request", req.trace)
+        inflight_due = [
+            r for r in inflight.values()
+            if now - r.started >= bound
+            and now - r.last_inflight_alarm >= bound]
+        if queue_alarmed and current is not None \
+                and current not in inflight_due:
+            # An in-flight request is the usual culprit; the oldest one's
+            # trace is the same document for every stalled request, so
+            # dump it once per sweep — and not at all when the in-flight
+            # alarm below dumps the identical document anyway.
+            self.dump_trace("queue-age alarm: request in flight "
+                            "ahead of the stalled one", current.trace)
+        for req in inflight_due:
+            age = now - req.started
+            req.last_inflight_alarm = now
+            share = self.qos_plane.grant_share(req.conn_id)
+            self._count("inflight_alarms")
+            logger.warning(
+                "request %d (tenant %d) in flight for %.1fs (bound %.1fs): "
+                "%d/%d chunks answered, %d granted, grant_share=%.3f",
+                req.job_id, req.conn_id, age, bound, sum(req.answered),
+                req.num_chunks, req.granted_chunks, share)
+            req.trace.event("inflight_alarm", age_s=round(age, 3),
+                            tenant=req.conn_id,
+                            grant_share=round(share, 4))
+            self.dump_trace("in-flight age alarm", req.trace)
+        if self._trace_on and (queue_alarmed or inflight_due):
+            # Flight-recorder post-mortem (ISSUE 10): the alarm's trace
+            # dump explains ONE request; the ring shows what the whole
+            # control plane did around the stall. Once per sweep even
+            # when both alarm kinds fired — the ring is one document.
+            _tracing.flight_dump("queue-age / in-flight alarm")
+
+    def gc(self, busy: set) -> None:
+        """Idle-tenant GC (rides the scheduler sweep): a tenant with no
+        queued or in-flight work, nothing granted outstanding, and a
+        full admission bucket carries no state worth keeping — dropping
+        it frees its metric series so conn churn stays bounded over a
+        long server life. Tenants the GC forgets also lose their export
+        track (ISSUE 10): the track registry obeys the same churn
+        rule."""
+        before = set(self.qos_plane.tenants)
+        self.qos_plane.gc(busy)
+        for tenant in before - set(self.qos_plane.tenants):
+            self.retire_tenant_track(tenant)

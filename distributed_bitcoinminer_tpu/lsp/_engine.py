@@ -31,6 +31,7 @@ from .checksum import make_checksum
 from .errors import ConnectionClosed, ConnectionLost, ConnectTimeout
 from .message import Message, MsgType, new_ack, new_data
 from .params import Params
+from .timerwheel import wheel_enabled, wheel_for
 from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS,
                              registry as _registry)
 
@@ -149,7 +150,22 @@ class Conn:
         self._got_payload_traffic = False
 
         self.closed_event = asyncio.Event()
-        self._epoch_task = asyncio.get_running_loop().create_task(self._epoch_loop())
+        # Epoch timer: the shared per-loop timer wheel by default (one
+        # sleeping task services every conn on this loop — 10k conns is
+        # 10k heap entries, not 10k tasks; ISSUE 11), or the stock
+        # per-conn task under DBM_TIMER_WHEEL=0. Tick schedule and
+        # semantics are identical either way (first tick at +epoch,
+        # next relative to when this one ran).
+        self._epoch_task: Optional[asyncio.Task] = None
+        self._wheel = None
+        self._wheel_handle = None
+        if wheel_enabled():
+            self._wheel = wheel_for(asyncio.get_running_loop())
+            self._wheel_handle = self._wheel.add(
+                self.params.epoch_millis / 1000.0, self._tick)
+        else:
+            self._epoch_task = asyncio.get_running_loop().create_task(
+                self._epoch_loop())
 
     # ------------------------------------------------------------- send path
 
@@ -386,6 +402,9 @@ class Conn:
         if task is not None and task is not asyncio.current_task():
             task.cancel()
         self._epoch_task = None
+        if self._wheel is not None and self._wheel_handle is not None:
+            self._wheel.cancel(self._wheel_handle)
+            self._wheel_handle = None
 
 
 def integrity_check(msg: Message) -> bool:

@@ -142,6 +142,30 @@ class AsyncServer:
                 conn.resume_delivery()
         return item
 
+    def read_nowait(self) -> Optional[ReadItem]:
+        """The next already-delivered item without awaiting, or None.
+
+        The scheduler's batched recv drain (ISSUE 11): one awaited
+        :meth:`read` per batch, then ``read_nowait`` until the queue is
+        momentarily dry — each asyncio queue ``get`` await costs a loop
+        round-trip, and at 10k active conns those round-trips dominate
+        the recv path. Semantics match :meth:`read` exactly (including
+        the back-pressure wake and the server-closed sentinel, which is
+        left in place for the next awaited read to raise).
+        """
+        was_full = self._read_queue.qsize() >= READ_QUEUE_CAP
+        try:
+            item = self._read_queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if isinstance(item, Exception):
+            self._read_queue.put_nowait(item)
+            return None
+        if was_full:
+            for conn in list(self._conns.values()):
+                conn.resume_delivery()
+        return item
+
     def write(self, conn_id: int, payload: bytes) -> None:
         conn = self._conns.get(conn_id)
         if conn is None or conn.state not in (ConnState.UP,):

@@ -15,6 +15,9 @@ Semantics preserved from the real stack (the scheduler depends on each):
 - ``read()`` yields ``(conn_id, payload)`` in delivery order, and
   ``(conn_id, exc)`` exactly once when a peer's endpoint closes — the
   drop event ``Scheduler._on_drop`` consumes.
+- ``read_nowait()`` (ISSUE 11) mirrors ``AsyncServer.read_nowait``:
+  the next already-delivered item without an event-loop hop, or None —
+  the scheduler's batched recv drain uses it.
 - ``write(conn_id, ...)`` raises :class:`~..lsp.errors.ConnectionClosed`
   on a closed/unknown conn (``Scheduler._write`` catches ``LspError``).
 - ``close_conn(conn_id)`` (the QoS shed path) kills the peer endpoint:
@@ -22,6 +25,17 @@ Semantics preserved from the real stack (the scheduler depends on each):
   server read stream gets NO drop event for a close it initiated
   (matching ``AsyncServer.close_conn``'s reaper, which removes the conn
   without posting one; the peer's own ``close()`` is what posts drops).
+
+Scale notes (ISSUE 11): any number of DetServers can share one loop —
+no module or loop-global state exists; conn ids are per-server (a
+channel is bound to its server, so overlapping ids across servers are
+fine), which is what the replica scenarios rely on. Every per-message
+operation is O(1) per conn (dict lookups, queue puts) — nothing scans
+the conn table per delivery or per tick, so a 10k-conn storm costs
+10k× one message, not 10k× the table. The ``writes``/``_read_log``
+capture lists the scenario FIFO checks read are O(messages) MEMORY,
+so the load harness constructs ``DetServer(record=False)`` to shed
+them; scenarios keep the default recording.
 """
 
 from __future__ import annotations
@@ -48,7 +62,8 @@ class DetChannel:
         self.conn_id = conn_id
         self._inbox: asyncio.Queue = asyncio.Queue()
         self.closed = False
-        #: Every payload this endpoint wrote, in order (scenario checks).
+        #: Every payload this endpoint wrote, in order (scenario checks;
+        #: empty when the owning server was built ``record=False``).
         self.sent: list = []
 
     async def read(self) -> bytes:
@@ -64,7 +79,8 @@ class DetChannel:
     def write(self, payload: bytes) -> None:
         if self.closed:
             raise ConnectionClosed(f"conn {self.conn_id} closed")
-        self.sent.append(payload)
+        if self._server._record:
+            self.sent.append(payload)
         self._server._deliver(self.conn_id, payload)
 
     async def close(self) -> None:
@@ -81,12 +97,18 @@ class DetChannel:
 
 class DetServer:
     """Deterministic AsyncServer stand-in: same read/write/close_conn
-    surface, backed by per-conn :class:`DetChannel` endpoints."""
+    surface, backed by per-conn :class:`DetChannel` endpoints.
 
-    def __init__(self) -> None:
+    ``record=False`` drops the ``writes``/``_read_log``/``sent``
+    capture (O(messages) memory the invariant checks consume) for the
+    10k-conn load harness; delivery semantics are identical.
+    """
+
+    def __init__(self, record: bool = True) -> None:
         self._read_queue: asyncio.Queue = asyncio.Queue()
         self._chans: Dict[int, DetChannel] = {}
         self._next_conn_id = 1
+        self._record = record
         #: (conn_id, payload) of every server-side write, in order.
         self.writes: list = []
         #: (conn_id, payload) of every peer write, in DELIVERY order —
@@ -103,7 +125,8 @@ class DetServer:
         return chan
 
     def _deliver(self, conn_id: int, payload: bytes) -> None:
-        self._read_log.append((conn_id, payload))
+        if self._record:
+            self._read_log.append((conn_id, payload))
         self._read_queue.put_nowait((conn_id, payload))
 
     def _on_peer_closed(self, conn_id: int) -> None:
@@ -116,12 +139,22 @@ class DetServer:
     async def read(self) -> ReadItem:
         return await self._read_queue.get()
 
+    def read_nowait(self) -> Optional[ReadItem]:
+        """The next already-delivered item, or None — no loop hop.
+        Mirrors ``AsyncServer.read_nowait`` for the scheduler's batched
+        recv drain (ISSUE 11)."""
+        try:
+            return self._read_queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
     def write(self, conn_id: int, payload: bytes) -> None:
         chan = self._chans.get(conn_id)
         if chan is None or chan.closed:
             raise ConnectionClosed(
                 f"conn {conn_id} does not exist or is closed")
-        self.writes.append((conn_id, payload))
+        if self._record:
+            self.writes.append((conn_id, payload))
         chan._inbox.put_nowait(payload)
 
     def close_conn(self, conn_id: int) -> None:
@@ -132,5 +165,6 @@ class DetServer:
             chan._kill()
 
     def sent_to(self, conn_id: int) -> list:
-        """Payloads written to one conn, in order (scenario checks)."""
+        """Payloads written to one conn, in order (scenario checks;
+        O(total writes) — a capture reader, never a hot path)."""
         return [p for c, p in self.writes if c == conn_id]

@@ -252,6 +252,48 @@ Environment variables:
   DBMCHECK_DISTINCT total (default 500; 0 disables) — a starved box
   whose budget expired after a handful of schedules must fail the
   gate, not pass green having checked nothing.
+- ``DBM_REPLICAS`` (default 1): scheduler replica count
+  (apps/replicas.ReplicaSet). With N>1 the server runs N in-process
+  scheduler replicas behind one LSP socket: tenants consistent-hashed
+  across replicas, miners sliced to the thinnest replica at join, one
+  SHARED ResultCache replay tier, and lease takeover on replica death
+  (a dead replica's miners are adopted — pending chunks popping in
+  order as stale — and its unanswered requests re-served exactly-once
+  through the new ring owners). 1 = the plain single scheduler,
+  today's topology bit-for-bit.
+- ``DBM_RECV_BATCH`` (default 64): scheduler/replica-router recv batch
+  — after each awaited transport read, up to this many
+  already-delivered messages are handled without an event-loop
+  round-trip (at 10k conns the per-await wakeups dominate the recv
+  path). Handlers run in identical order either way; 1 restores the
+  stock one-message-per-await loop (tier-1 matrix leg).
+- ``DBM_TIMER_WHEEL`` (default 1): collapse every LSP conn's epoch
+  timer onto ONE shared per-loop timer task (lsp/timerwheel.py) — 10k
+  conns become 10k heap entries instead of 10k sleeping tasks. Tick
+  schedule and semantics are unchanged (first tick at +epoch, next
+  relative to when this one ran); 0 restores the per-conn epoch task
+  (tier-1 matrix leg).
+- ``DBM_TRACE_SAMPLE`` (default 1.0): fraction of requests that
+  allocate a real RequestTrace (utils/trace.sample_hit — a
+  deterministic hash of the arrival sequence, so the same storm
+  samples the same requests every run). Unsampled requests carry a
+  shared no-op trace and never register in the trace buffer or export
+  tracks; sampled ones record complete end-to-end. 1.0 is bit-for-bit
+  today's allocate-every-trace behavior (tier-1 matrix leg pin); the
+  10k-tenant load harness runs at ~0.01 so tracing stays on without
+  being the bottleneck.
+- ``DBM_TIER1_LOAD`` (0 disables): scripts/tier1.sh's mini-load leg —
+  a bounded ~500-tenant storm through the split scheduler on detnet
+  (scripts/loadharness.py) gating completion, a generous reply-p99
+  ceiling, and bounded metric-series growth.
+- ``DBM_BENCH_LOAD`` (0 disables) / ``DBM_BENCH_LOAD_TENANTS`` /
+  ``DBM_BENCH_LOAD_ROUNDS``: the bench's control-plane load curve
+  (``bench.py detail.load``): tenants vs p50/p99/shed-rate for 1 vs 4
+  scheduler replicas on detnet with instant miners, interleaved
+  order-swapped rounds (default 2), median-aggregated.
+  ``DBM_BENCH_LOAD_TENANTS`` is the comma-separated tenant-count
+  sweep (default "500,2000"; the checked-in BENCH_r06 artifact used
+  "500,2000,10000").
 """
 
 from __future__ import annotations

@@ -438,6 +438,9 @@ class RequestTrace:
 
     MAX_EVENTS = 512
 
+    #: Real traces are sampled-in; :class:`NullRequestTrace` overrides.
+    null = False
+
     __slots__ = ("key", "meta", "events", "dropped", "_t0", "_lock")
 
     def __init__(self, **meta):
@@ -486,6 +489,41 @@ class RequestTrace:
         return out
 
 
+class NullRequestTrace:
+    """Shared no-op stand-in for an UNSAMPLED request's trace
+    (``DBM_TRACE_SAMPLE``, ISSUE 11).
+
+    At 10k tenants the per-request :class:`RequestTrace` allocation —
+    object + lock + an event dict per lifecycle edge — is itself a
+    control-plane melt point. An unsampled request carries this
+    singleton instead: every ``event()`` is one no-op method call, it
+    never registers in a :class:`TraceBuffer` (``register`` drops it),
+    and it reports ``closed`` so span-completeness checks skip it.
+    ``DBM_TRACE_SAMPLE=1.0`` (the default) never constructs it — today's
+    behavior bit-for-bit.
+    """
+
+    __slots__ = ()
+
+    null = True
+    key = None
+    meta: dict = {}
+    events: tuple = ()
+    dropped = 0
+    closed = True
+    t0 = 0.0
+
+    def event(self, name: str, **detail) -> None:
+        pass
+
+    def to_dict(self) -> dict:
+        return {"key": None, "meta": {}, "events": [], "sampled": False}
+
+
+#: The one shared unsampled-trace instance (it is stateless).
+NULL_TRACE = NullRequestTrace()
+
+
 class TraceBuffer:
     """Bounded LRU store of traces, keyed by request id.
 
@@ -508,6 +546,8 @@ class TraceBuffer:
         return RequestTrace(**meta)
 
     def register(self, key, trace: RequestTrace) -> None:
+        if trace.null:
+            return     # unsampled (DBM_TRACE_SAMPLE): nothing to retain
         trace.key = key
         with self._lock:
             self._d.pop(key, None)

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""CLI for the 10k-tenant control-plane load harness (ISSUE 11).
+
+One storm leg (or a curve) on the socket-free detnet transport with
+instant miners — the control plane is the only thing measured. Prints
+one JSON line per leg.
+
+Tier-1 mini-load leg (``scripts/tier1.sh``, ``DBM_TIER1_LOAD``):
+
+    python scripts/loadharness.py --tenants 500 --assert-p99 30 \
+        --assert-series 256
+
+``--assert-*`` turns the run into a gate: every non-shed request must
+complete, reply p99 must stay under the ceiling, and the process
+metrics registry must not have grown an unbounded number of series
+(per-tenant labels must collapse under the cardinality bound, not
+explode) — exit 1 on any violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _series_count() -> int:
+    from distributed_bitcoinminer_tpu.utils.metrics import registry
+    snap = registry().snapshot()
+    n = 0
+    for family in ("counters", "gauges", "histograms", "ewmas"):
+        n += len(snap.get(family, {}))
+    return n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tenants", type=int, default=1000)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--miners", type=int, default=4)
+    ap.add_argument("--requests-per-tenant", type=int, default=1)
+    ap.add_argument("--nonces", type=int, default=256)
+    ap.add_argument("--max-queued", type=int, default=4096)
+    ap.add_argument("--recv-batch", type=int, default=None)
+    ap.add_argument("--trace-sample", type=float, default=None)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--assert-p99", type=float, default=None,
+                    help="gate: reply p99 ceiling in seconds")
+    ap.add_argument("--assert-series", type=int, default=None,
+                    help="gate: max process metric series after the run")
+    args = ap.parse_args(argv)
+
+    from distributed_bitcoinminer_tpu.apps.loadharness import run_load
+    before = _series_count()
+    leg = run_load(
+        tenants=args.tenants, replicas=args.replicas, miners=args.miners,
+        requests_per_tenant=args.requests_per_tenant,
+        req_nonces=args.nonces, max_queued=args.max_queued,
+        recv_batch=args.recv_batch, trace_sample=args.trace_sample,
+        timeout_s=args.timeout)
+    after = _series_count()
+    leg["metric_series"] = {"before": before, "after": after}
+    print(json.dumps(leg, sort_keys=True), flush=True)
+
+    rc = 0
+    expected = leg["requests"] \
+        - leg["shed_tenants"] * args.requests_per_tenant
+    if leg.get("timed_out"):
+        print("LOAD_GATE: storm timed out", file=sys.stderr)
+        rc = 1
+    if leg["completed"] < expected:
+        print(f"LOAD_GATE: only {leg['completed']}/{expected} non-shed "
+              f"requests completed", file=sys.stderr)
+        rc = 1
+    if args.assert_p99 is not None and leg["p99_s"] is not None \
+            and leg["p99_s"] > args.assert_p99:
+        print(f"LOAD_GATE: p99 {leg['p99_s']}s over the "
+              f"{args.assert_p99}s ceiling", file=sys.stderr)
+        rc = 1
+    if args.assert_series is not None and after > args.assert_series:
+        print(f"LOAD_GATE: {after} metric series after the run "
+              f"(bound {args.assert_series}) — unbounded label growth",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

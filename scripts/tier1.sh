@@ -72,6 +72,21 @@ if [ "${DBM_CHECK:-1}" != "0" ]; then
     echo "DBMCHECK_LEG_RC=$check_rc"
 fi
 
+# Mini-load leg (ISSUE 11): a bounded ~500-tenant storm through the
+# split scheduler on the socket-free detnet transport with instant
+# miners — no JAX import, seconds of wall. Gates on completion (every
+# non-shed request answered), a generous reply-p99 ceiling (the box may
+# be loaded; the ceiling catches a MELT, not jitter), and bounded
+# metric-series growth (per-tenant labels must collapse under the
+# cardinality bound, not explode). DBM_TIER1_LOAD=0 skips.
+load_rc=0
+if [ "${DBM_TIER1_LOAD:-1}" != "0" ]; then
+    timeout -k 5 180 python scripts/loadharness.py --tenants 500 \
+        --replicas 2 --assert-p99 60 --assert-series 512
+    load_rc=$?
+    echo "LOAD_LEG_RC=$load_rc"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
@@ -99,13 +114,20 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 # when clean. Skipped when the main leg already blew the budget.
 # DBM_TIER1_MATRIX=0 opts out.
 if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
-    timeout -k 10 420 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
+    # ISSUE 11 additions to the knob-off matrix: DBM_RECV_BATCH=1
+    # (stock one-message-per-await recv), DBM_TIMER_WHEEL=0 (per-conn
+    # epoch tasks), DBM_TRACE_SAMPLE=1.0 (every request allocates its
+    # trace — stock), DBM_REPLICAS=1 (single-scheduler topology), and
+    # the plane-split suite joins the module list.
+    timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
+        DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
+        DBM_REPLICAS=1 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
         tests/test_apps.py tests/test_qos.py tests/test_batch.py \
-        tests/test_trace.py \
+        tests/test_trace.py tests/test_plane_split.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}
@@ -114,4 +136,5 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
 fi
 [ "$lint_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$lint_rc
 [ "$check_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$check_rc
+[ "$load_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$load_rc
 exit $rc

@@ -231,8 +231,10 @@ def test_empty_range_burst_drains_iteratively():
     join(sched, MINER_A)
     bad = Message(type=MsgType.REQUEST, data="void", lower=5, upper=3)
     for _ in range(2000):
-        sched.queue.append(Request(conn_id=CLIENT_X, data="void",
-                                   lower=5, upper=3))
+        # Queue ownership moved to the tenant plane (ISSUE 11 split);
+        # enqueue() is the supported direct-injection surface.
+        sched.tenant_plane.enqueue(Request(conn_id=CLIENT_X, data="void",
+                                           lower=5, upper=3))
     sched._on_request(CLIENT_X, bad)   # triggers the drain
     replies = server.sent_to(CLIENT_X, MsgType.RESULT)
     assert len(replies) == 2001
