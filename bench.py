@@ -777,8 +777,8 @@ def _load_probe() -> dict:
     BENCH_r06 artifact was generated at "500,2000,10000" — and
     ``DBM_BENCH_LOAD_ROUNDS`` (default 2) the rounds per point.
     """
-    from distributed_bitcoinminer_tpu.apps.loadharness import (load_curve,
-                                                               run_load)
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        load_curve, run_load, run_load_procs)
 
     points = []
     for part in _str_env("DBM_BENCH_LOAD_TENANTS", "500,2000").split(","):
@@ -794,6 +794,28 @@ def _load_probe() -> dict:
                           trace_sample=1.0, max_queued=4 * top)
     tuned = run_load(tenants=top, replicas=1, recv_batch=64,
                      trace_sample=0.01, max_queued=4 * top)
+    # Lazy-DRR A/B (ISSUE 12, DBM_QOS_LAZY): the stock candidate walk
+    # vs the lazy ring walk at the top tenant count, single replica —
+    # the per-completion heads scan is the N=1 melt being closed.
+    lazy_off = run_load(tenants=top, replicas=1, qos_lazy=False,
+                        max_queued=4 * top)
+    lazy_on = run_load(tenants=top, replicas=1, qos_lazy=True,
+                       max_queued=4 * top)
+    # In-process vs MULTI-PROCESS replicas at equal tenant count
+    # (ISSUE 12; real sockets + real processes put a floor on this leg,
+    # so it runs at a bounded tenant count). DBM_BENCH_LOAD_PROCS=0
+    # skips it.
+    procs_cmp = None
+    if _str_env("DBM_BENCH_LOAD_PROCS", "1") != "0":
+        pt = min(500, top)
+        inproc = run_load(tenants=pt, replicas=2, miners=4,
+                          max_queued=4 * pt)
+        procs = run_load_procs(tenants=pt, replicas=2, miners=4)
+        keys = ("makespan_s", "admitted_per_s", "p50_s", "p99_s",
+                "cpu_s_per_request", "shed_rate")
+        procs_cmp = {"tenants": pt,
+                     "inprocess_r2": {k: inproc[k] for k in keys},
+                     "procs_r2": {k: procs[k] for k in keys}}
     return {
         "points": curve["points"],
         "rounds": rounds,
@@ -805,7 +827,14 @@ def _load_probe() -> dict:
             "tuned": {k: tuned[k] for k in
                       ("makespan_s", "p50_s", "p99_s",
                        "cpu_s_per_request")},
+            "lazy_off": {k: lazy_off[k] for k in
+                         ("makespan_s", "p50_s", "p99_s",
+                          "cpu_s_per_request")},
+            "lazy_on": {k: lazy_on[k] for k in
+                        ("makespan_s", "p50_s", "p99_s",
+                         "cpu_s_per_request")},
         },
+        "procs": procs_cmp,
         "samples": [
             {k: leg.get(k) for k in
              ("tenants", "replicas", "makespan_s", "admitted_per_s",

@@ -29,7 +29,9 @@ import random
 
 from ...apps.scheduler import Scheduler
 from ...bitcoin.hash import hash_op
-from ...bitcoin.message import Message, MsgType, new_join
+from ...bitcoin.message import (Message, MsgType, new_join, new_request,
+                                new_result)
+from ...lsp.errors import LspError
 from ...utils.config import (CacheParams, CoalesceParams, LeaseParams,
                              QosParams, StripeParams)
 from .scenario import Ctx, Req, Scenario, oracle_min
@@ -591,6 +593,263 @@ class ReplicaTakeover(Scenario):
         return out
 
 
+# -------------------------------------------------------- health_takeover
+
+class _ProcView:
+    """Merged invariant view over the model's replica schedulers (the
+    harness reads ``ctx.sched._inflight/queue/qos_plane/traces``)."""
+
+    def __init__(self, scheds):
+        self._scheds = scheds
+
+    @property
+    def _inflight(self):
+        out = {}
+        for s in self._scheds:
+            out.update(s._inflight)
+        return out
+
+    @property
+    def queue(self):
+        return [r for s in self._scheds for r in s.queue]
+
+    @property
+    def qos_plane(self):
+        from ...apps.replicas import _MergedQos
+        return _MergedQos(self._scheds)
+
+    @property
+    def traces(self):
+        from ...apps.replicas import _MergedTraces
+        return _MergedTraces(self._scheds)
+
+
+class HealthTakeover(Scenario):
+    """ISSUE 12: the multi-process failure model run IN-PROCESS on the
+    virtual clock — the same :mod:`...apps.health` detection/fencing
+    code the real router executes, against two REAL schedulers on two
+    DetServers (one socket per replica, like one socket per process).
+
+    One replica is PARTITIONED at a seed-drawn virtual time: its beat
+    seq freezes at the router (missed-beat detection fires — no kill
+    hook anywhere) while it KEEPS SERVING its existing conns — the
+    gray-failure/fencing case. The router declares it dead, bumps the
+    fencing epoch, and re-rings; ring-aware model clients re-resolve on
+    conn death/timeout and resubmit to the survivor; the rejoining
+    model miner re-attaches like the process miner agent. When the
+    partition heals, the victim observes its own fence and simulates
+    process exit (every conn of its server drops). Invariants: every
+    client gets EXACTLY ONE oracle-exact reply however the schedule
+    interleaves detection, late victim Results, and resubmission;
+    accounting and spans drain to zero on BOTH replicas."""
+
+    name = "health_takeover"
+
+    def build(self, ctx: Ctx) -> None:
+        from ...apps.health import BeatMonitor, RouterState, router_tick
+        from ...apps.health import Beat
+        from ...lspnet.detnet import DetServer
+        from ...utils.config import CacheParams
+        rng = ctx.rng
+        beat_s = 0.2
+        lease = LeaseParams(grace_s=5.0, factor=4.0, floor_s=2.0,
+                            tick_s=0.1, queue_alarm_s=30.0)
+        qos = QosParams(enabled=True, chunk_s=0.3, max_chunks=8,
+                        depth=2, wholesale_s=0.5)
+        # One DetServer per replica — one socket per process.
+        servers = [ctx.server, DetServer()]
+        scheds = []
+        for rid in range(2):
+            sched = Scheduler(
+                servers[rid], lease=lease, cache=CacheParams(),
+                stripe=StripeParams(enabled=False), qos=qos,
+                coalesce=CoalesceParams(enabled=False),
+                clock=ctx.loop.time)
+            scheds.append(sched)
+            ctx.spawn(sched.run())
+
+            async def sweeps(s=sched):
+                while True:
+                    await asyncio.sleep(s.lease.tick_s)
+                    s.sweep()
+            ctx.spawn(sweeps())
+        ctx.sched = _ProcView(scheds)
+        self.scheds = scheds
+
+        # ---- model health plane on the virtual clock ----
+        state = RouterState(BeatMonitor(beat_s, 2))
+        membership = state.membership
+        self.membership = membership
+        bus: dict = {}                  # rid -> latest Beat
+        seqs = [0, 0]
+        self.victim = victim = rng.choice((0, 1))
+        part_at = rng.uniform(0.4, 1.6)
+        heal_at = part_at + rng.uniform(1.2, 2.5)
+        self.partitioned = False
+        self.exited = [False, False]
+
+        def simulate_exit(rid: int) -> None:
+            # Process death: every conn of this replica's server drops
+            # (clients resubmit elsewhere, the miner rejoins), queued
+            # and in-flight state cancels through the normal drop path.
+            if self.exited[rid]:
+                return
+            self.exited[rid] = True
+            for conn_id in list(servers[rid]._chans):
+                servers[rid].close_conn(conn_id)
+                scheds[rid]._on_drop(conn_id)
+
+        async def replica_beats(rid: int) -> None:
+            inc = f"r{rid}"
+            while True:
+                cut = (rid == victim and self.partitioned)
+                if not cut:
+                    if membership.is_fenced(rid, inc):
+                        simulate_exit(rid)
+                        return
+                    seqs[rid] += 1
+                    bus[rid] = Beat(
+                        rid=rid, incarnation=inc, seq=seqs[rid],
+                        port=rid, serving=True,
+                        miners=len(scheds[rid].miners),
+                        queue_depth=len(scheds[rid].queue),
+                        epoch_seen=membership.epoch)
+                await asyncio.sleep(beat_s)
+
+        async def router() -> None:
+            while True:
+                router_tick(state, list(bus.values()), ctx.loop.time())
+                await asyncio.sleep(beat_s / 2)
+
+        async def partition_timer() -> None:
+            await asyncio.sleep(part_at)
+            self.partitioned = True
+            await asyncio.sleep(max(0.05, heal_at - part_at))
+            self.partitioned = False
+
+        for rid in range(2):
+            ctx.spawn(replica_beats(rid))
+        ctx.spawn(router())
+        ctx.spawn(partition_timer())
+
+        # ---- rejoining miners (the process miner agent, modeled) ----
+        mrngs = [_fork(rng) for _ in range(2)]
+
+        async def miner_agent(idx: int) -> None:
+            mrng = mrngs[idx]
+            while True:
+                live = sorted(membership.live)
+                if not live:
+                    await asyncio.sleep(0.1)
+                    continue
+                rid = live[idx % len(live)]
+                chan = servers[rid].connect()
+                chan.write(new_join().to_json())
+                try:
+                    while True:
+                        payload = await chan.read()
+                        msg = Message.from_json(payload)
+                        if msg.type != MsgType.REQUEST:
+                            continue
+                        await asyncio.sleep(
+                            (msg.upper - msg.lower + 1) / 1000.0
+                            * mrng.uniform(0.8, 1.2))
+                        from .scenario import oracle_min
+                        h, n = oracle_min(msg.data, msg.lower, msg.upper)
+                        chan.write(new_result(h, n).to_json())
+                except Exception:   # noqa: BLE001 — conn died: rejoin
+                    await asyncio.sleep(0.1)
+
+        for i in range(2):
+            ctx.spawn(miner_agent(i))
+
+        async def warm() -> None:
+            while any(not s.miners for s in scheds):
+                await asyncio.sleep(0.05)
+            for s in scheds:
+                for m in s.miners:
+                    m.rate_ewma = 1000.0
+                s._pool_rate = 1000.0
+        ctx.spawn(warm())
+
+        # ---- ring-aware clients (the replica-aware retry plane) ----
+        from ...apps.replicas import HashRing
+
+        class RingClient:
+            def __init__(self, name, requests):
+                self.name = name
+                self.requests = requests
+                self.replies: list = []
+                self.shed = False
+                self.dropped = False
+
+            @staticmethod
+            async def _read_or_none(chan):
+                # A coroutine handed to wait_for becomes its own task;
+                # it must finish with a VALUE (the drain-phase audit
+                # flags any task finishing with an exception, even a
+                # consumed one).
+                try:
+                    return await chan.read()
+                except LspError:
+                    return None
+
+            async def run(self) -> None:
+                for req in self.requests:
+                    if req.pre_delay > 0:
+                        await asyncio.sleep(req.pre_delay)
+                    while True:
+                        live = sorted(membership.live)
+                        if not live:
+                            await asyncio.sleep(0.2)
+                            continue
+                        rid = HashRing(live).owner(self.name)
+                        chan = servers[rid].connect()
+                        payload = None
+                        try:
+                            chan.write(new_request(
+                                req.data, req.lower, req.upper,
+                                req.target).to_json())
+                            payload = await asyncio.wait_for(
+                                self._read_or_none(chan), 4.0)
+                        except (LspError, asyncio.TimeoutError):
+                            payload = None
+                        if payload is not None:
+                            msg = Message.from_json(payload)
+                            if msg.type == MsgType.RESULT:
+                                self.replies.append(msg)
+                                await chan.close()
+                                break
+                        # Abandon THIS conn before any resubmission —
+                        # the exactly-once contract of the retry plane.
+                        await chan.close()
+                        await asyncio.sleep(0.2)
+
+        n_mice = rng.choice((2, 3))
+        specs = [("elephant", Req(rng.choice(_DATA), 0,
+                                  rng.choice((1499, 1999)),
+                                  pre_delay=0.4))]
+        for j in range(n_mice):
+            specs.append((f"mouse{j}",
+                          Req(f"{rng.choice(_DATA)}#{j}", 0,
+                              rng.choice((99, 199)),
+                              pre_delay=0.3 + rng.uniform(0.0, 1.8))))
+        for name, req in specs:
+            c = RingClient(name, [req])
+            ctx.clients.append(c)
+            ctx.spawn(c.run(), client=True)
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        # Detection really fired off missed beats in schedules where the
+        # partition window outlived the monitor window before heal.
+        m = self.membership
+        if self.exited[self.victim] and self.victim not in m.fenced:
+            out.append("victim simulated exit without being fenced")
+        return out
+
+
 # ------------------------------------------------------- known-bad fixtures
 
 class FixtureLostUpdate(Scenario):
@@ -655,6 +914,7 @@ SCENARIOS = {
     "difficulty_prefix": DifficultyPrefix,
     "plane_split": PlaneSplit,
     "replica_takeover": ReplicaTakeover,
+    "health_takeover": HealthTakeover,
 }
 
 FIXTURES = {

@@ -128,6 +128,7 @@ async def submit_with_retry(hostport: str, message: str, max_nonce: int,
                             target: int = 0,
                             params: Optional[Params] = None,
                             retry: Optional[RetryParams] = None,
+                            tenant_key=None,
                             ) -> Optional[Tuple[int, int, bool]]:
     """Idempotent submit with timeout + exponential backoff + reconnect.
 
@@ -147,19 +148,48 @@ async def submit_with_retry(hostport: str, message: str, max_nonce: int,
     most one Result reaches the caller because every attempt but the
     returning one has its connection closed before the next begins.
 
+    **Replica-aware ring mode (ISSUE 12).** ``hostport`` may name the
+    multi-process replica tier's state directory as ``ring:<statedir>``
+    (apps/procs.py). Each attempt then RE-RESOLVES the target replica:
+    the tenant key (``tenant_key``, default the message itself — any
+    stable value; the server-side tenant identity is the conn id, the
+    hash only picks a replica stably) is consistent-hashed over the
+    ADVERTISED live ring from ``membership.json``. A replica killed or
+    fenced mid-request surfaces as a dead conn / expired attempt; the
+    next attempt re-reads the membership — by then the router's
+    missed-beat detection has re-ringed — and reconnects to the NEW
+    owner, where the request either replays from the replicated cache
+    tier or recomputes. While no membership is readable (router
+    restarting) the attempt burns its backoff and retries: the client
+    backs off THROUGH router restarts rather than failing.
+
     Returns ``(hash, nonce, found)`` like :func:`submit_until`, or None
     once every attempt is exhausted.
     """
     retry = retry if retry is not None else RetryParams()
     delay = retry.backoff_s
     t0 = asyncio.get_running_loop().time()
+    ring_dir: Optional[str] = None
+    if hostport.startswith("ring:"):
+        ring_dir = hostport[len("ring:"):]
+        if tenant_key is None:
+            tenant_key = message
     for attempt in range(max(1, retry.attempts)):
         _MET_ATTEMPTS.inc()
         if attempt:
             await asyncio.sleep(delay)
             delay = min(delay * 2, retry.backoff_cap_s)
+        target_hostport = hostport
+        if ring_dir is not None:
+            from .procs import resolve_owner
+            owner = resolve_owner(ring_dir, tenant_key)
+            if owner is None:
+                logger.info("attempt %d: no advertised ring yet; "
+                            "backing off", attempt + 1)
+                continue
+            _rid, target_hostport = owner
         try:
-            client = await new_async_client(hostport, params)
+            client = await new_async_client(target_hostport, params)
         except LspError as exc:
             logger.info("attempt %d: connect failed (%s); will retry",
                         attempt + 1, exc)
@@ -247,7 +277,11 @@ def main(argv=None) -> int:
     # reconnect+resubmit, and a connect failure prints "Disconnected"
     # instead of "Failed to connect"). A missing, unparsable, 0, or 1
     # value keeps the reference behavior.
-    want_retry = _int_env("DBM_RETRY_ATTEMPTS", 0) > 1
+    # A ring:<statedir> target (the multi-process replica tier) is only
+    # meaningful through the replica-aware retry plane: owner
+    # re-resolution happens per attempt.
+    want_retry = _int_env("DBM_RETRY_ATTEMPTS", 0) > 1 \
+        or argv[1].startswith("ring:")
     try:
         if want_retry:
             until = asyncio.run(submit_with_retry(

@@ -1,0 +1,248 @@
+"""Membership/health plane for the multi-process replica tier (ISSUE 12).
+
+PR 11's in-process :class:`~.replicas.ReplicaSet` proved exactly-once
+lease takeover for replicas killed by a METHOD CALL (``kill()``). The
+multi-process tier replaces that test hook with OBSERVED failure: each
+replica process heartbeats a small state blob (the :class:`Beat` —
+serving bit, miner-slice size, queue depth, the membership epoch it has
+seen), and a router declares a replica dead after ``miss_k`` missed
+beats, bumps the FENCING EPOCH, and publishes the new membership. Every
+piece of that logic lives HERE, transport-free — the real router
+(``apps/procs.py``) drives it over a shared state directory with wall
+clocks, and the dbmcheck ``health_takeover`` scenario drives the same
+code over a virtual clock with an in-memory beat bus, so the
+detection/fencing state machine the processes run is the one the
+deterministic explorer proves.
+
+The three objects:
+
+- :class:`Beat` — one replica's heartbeat blob. ``seq`` must advance
+  every beat; a frozen seq is a missed beat whatever the wall clock
+  says (a SIGSTOPped process's stale file keeps its old mtime AND its
+  old seq — the monitor never trusts file timestamps).
+- :class:`BeatMonitor` — missed-beat failure detection: a replica whose
+  seq has not advanced within ``miss_k * beat_s`` of the observer's
+  clock is DEAD. Purely a function of (observations, now).
+- :class:`Membership` — the advertised ring + the fencing ledger.
+  ``epoch`` bumps on every change. Declaring a replica dead records its
+  ``(rid, incarnation)`` in ``fenced``: a fenced incarnation is NEVER
+  re-admitted (only a fresh incarnation of the rid is), its late
+  Results land on conns its clients/miners have already abandoned, and
+  its cache spool lines are dropped at ingest
+  (:meth:`Membership.writer_fenced`) — the "declared dead but still
+  serving" partitioned-replica case resolves stale everywhere.
+
+Fencing contract (the dbmcheck scenario's invariant): once
+``declare_dead(rid)`` has been observed by a replica (its own
+``(rid, incarnation)`` in ``fenced``), that replica must STOP SERVING —
+close its transport so its clients resubmit to the new ring owner and
+its miners rejoin a survivor. Until it observes the fence it may keep
+serving; that window is safe because (a) its clients' retry plane has
+already abandoned the conns its late Results ride, and (b) every answer
+is a pure function of the request key, so even a delivered late Result
+is bit-identical to the survivor's — the fence exists to bound waste
+and to keep the replicated cache tier hygienic, not to patch a
+correctness hole.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Beat", "BeatMonitor", "Membership"]
+
+
+@dataclass
+class Beat:
+    """One replica heartbeat (the small state blob on the wire/file)."""
+
+    rid: int
+    incarnation: str        # unique per process start (pid + stamp)
+    seq: int                # MUST advance every beat
+    port: int = 0           # the replica's own LSP socket
+    serving: bool = True
+    miners: int = 0         # miner-slice size (agent placement hint)
+    queue_depth: int = 0
+    epoch_seen: int = 0     # membership epoch the replica last observed
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Beat":
+        return cls(**{k: d[k] for k in
+                      ("rid", "incarnation", "seq", "port", "serving",
+                       "miners", "queue_depth", "epoch_seen") if k in d})
+
+
+class BeatMonitor:
+    """Missed-beat failure detection over observed :class:`Beat`\\ s.
+
+    ``observe(beat, now)`` records a beat; ``dead(now)`` lists replicas
+    whose seq has not advanced within ``miss_k * beat_s`` of ``now``.
+    The deadline is re-anchored ONLY when seq advances — replaying a
+    stale blob (same seq) does not count as life, which is what makes a
+    SIGSTOPped process's lingering state file a death, not a heartbeat.
+    """
+
+    def __init__(self, beat_s: float, miss_k: int):
+        self.beat_s = max(1e-3, beat_s)
+        self.miss_k = max(1, miss_k)
+        self._last: Dict[int, Beat] = {}      # rid -> newest beat
+        self._fresh_at: Dict[int, float] = {} # rid -> when seq advanced
+
+    @property
+    def window_s(self) -> float:
+        """Seconds of seq silence that mean death."""
+        return self.beat_s * self.miss_k
+
+    def observe(self, beat: Beat, now: float) -> bool:
+        """Record one beat; True when it ADVANCED the replica's seq
+        (same-or-older seqs, e.g. a re-read of a stale file, do not
+        refresh the death deadline)."""
+        prev = self._last.get(beat.rid)
+        advanced = (prev is None or beat.incarnation != prev.incarnation
+                    or beat.seq > prev.seq)
+        if advanced:
+            self._last[beat.rid] = beat
+            self._fresh_at[beat.rid] = now
+        return advanced
+
+    def last(self, rid: int) -> Optional[Beat]:
+        return self._last.get(rid)
+
+    def beats(self) -> List[Beat]:
+        return list(self._last.values())
+
+    def dead(self, now: float) -> List[int]:
+        """Replica ids whose seq has been frozen past the window."""
+        return [rid for rid, at in self._fresh_at.items()
+                if now - at > self.window_s]
+
+    def forget(self, rid: int) -> None:
+        """Stop watching a declared-dead replica (it re-enters the
+        watch when a fresh incarnation beats)."""
+        self._last.pop(rid, None)
+        self._fresh_at.pop(rid, None)
+
+
+class Membership:
+    """The advertised ring + fencing ledger the router publishes.
+
+    ``live`` maps rid -> {port, incarnation}; ``epoch`` bumps on every
+    membership change; ``fenced`` maps rid -> {incarnation, epoch} for
+    the LAST fenced incarnation of that rid (one suffices: a rid has at
+    most one live incarnation, and older fenced ones can never beat
+    again without being re-fenced as stale by the incarnation check).
+    """
+
+    def __init__(self):
+        self.epoch = 0
+        self.live: Dict[int, dict] = {}
+        self.fenced: Dict[int, dict] = {}
+
+    # ------------------------------------------------------------ changes
+
+    def admit(self, beat: Beat) -> bool:
+        """Admit a beating replica: first sight of the rid, or a FRESH
+        incarnation of a previously fenced/dead one. A fenced
+        incarnation is never re-admitted — that is the fence. Returns
+        True when membership changed."""
+        fence = self.fenced.get(beat.rid)
+        if fence is not None and fence["incarnation"] == beat.incarnation:
+            return False        # the fenced incarnation itself: refused
+        entry = self.live.get(beat.rid)
+        if entry is not None and entry["incarnation"] == beat.incarnation:
+            if entry.get("port") == beat.port:
+                return False    # already live, nothing changed
+        self.live[beat.rid] = {"port": beat.port,
+                               "incarnation": beat.incarnation}
+        self.epoch += 1
+        return True
+
+    def declare_dead(self, rid: int) -> bool:
+        """Missed-beat death: drop the rid from the ring and FENCE its
+        incarnation at the new epoch. Returns True when it was live."""
+        entry = self.live.pop(rid, None)
+        if entry is None:
+            return False
+        self.epoch += 1
+        self.fenced[rid] = {"incarnation": entry["incarnation"],
+                            "epoch": self.epoch}
+        return True
+
+    # ------------------------------------------------------------ queries
+
+    def is_fenced(self, rid: int, incarnation: str) -> bool:
+        """Has THIS incarnation of ``rid`` been declared dead? (What a
+        replica checks about itself to decide to stop serving.)"""
+        fence = self.fenced.get(rid)
+        return fence is not None and fence["incarnation"] == incarnation
+
+    def writer_fenced(self, rid: int, incarnation: str) -> bool:
+        """Should a cache-spool line from this writer be dropped?
+        Everything a fenced incarnation wrote is refused — conservative
+        (its pre-death entries are sacrificed too), but a replicated-
+        cache miss only degrades to recompute, never to a wrong reply,
+        and a fenced process's post-death writes must never propagate."""
+        return self.is_fenced(rid, incarnation)
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "live": {str(r): dict(v) for r, v in self.live.items()},
+                "fenced": {str(r): dict(v)
+                           for r, v in self.fenced.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Membership":
+        m = cls()
+        m.epoch = int(d.get("epoch", 0))
+        m.live = {int(r): dict(v)
+                  for r, v in (d.get("live") or {}).items()}
+        m.fenced = {int(r): dict(v)
+                    for r, v in (d.get("fenced") or {}).items()}
+        return m
+
+
+@dataclass
+class RouterState:
+    """One router tick's working state (monitor + membership), bundled
+    so the file-based router and the dbmcheck model share the exact
+    tick logic via :func:`router_tick`."""
+
+    monitor: BeatMonitor
+    membership: Membership = field(default_factory=Membership)
+
+
+def router_tick(state: RouterState, beats: List[Beat],
+                now: float) -> bool:
+    """One detection/advertisement tick, shared by the real router and
+    the dbmcheck ``health_takeover`` model: feed the freshly read beats
+    to the monitor, admit fresh serving incarnations, declare
+    missed-beat deaths. Returns True when membership changed (the
+    file-based router republishes only then)."""
+    changed = False
+    for beat in beats:
+        advanced = state.monitor.observe(beat, now)
+        if beat.serving:
+            if state.membership.admit(beat):
+                changed = True
+        elif advanced:
+            # Graceful leave: a live incarnation beating serving=False
+            # fences itself immediately instead of burning the missed-
+            # beat window.
+            entry = state.membership.live.get(beat.rid)
+            if entry is not None and \
+                    entry["incarnation"] == beat.incarnation:
+                state.membership.declare_dead(beat.rid)
+                state.monitor.forget(beat.rid)
+                changed = True
+    for rid in state.monitor.dead(now):
+        if state.membership.declare_dead(rid):
+            changed = True
+        state.monitor.forget(rid)
+    return changed
+
+
+__all__.extend(["RouterState", "router_tick"])

@@ -116,15 +116,20 @@ def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
              *, requests_per_tenant: int = 1, req_nonces: int = 256,
              max_queued: int = 4096, recv_batch: Optional[int] = None,
              trace_sample: Optional[float] = None,
+             qos_lazy: Optional[bool] = None,
              timeout_s: float = 300.0) -> dict:
-    """One storm leg; returns the leg's measurement dict."""
+    """One storm leg; returns the leg's measurement dict.
+
+    ``qos_lazy`` pins the lazy-DRR walk knob for A/B legs (ISSUE 12;
+    None = the default, lazy on)."""
 
     async def leg() -> dict:
         from .replicas import ReplicaSet
         from .scheduler import Scheduler
         server = DetServer(record=False)
+        qos_kw = {} if qos_lazy is None else {"lazy": qos_lazy}
         qos = QosParams(enabled=True, max_queued=max(
-            1, max_queued // max(1, replicas)))
+            1, max_queued // max(1, replicas)), **qos_kw)
         lease = LeaseParams(grace_s=120.0, floor_s=60.0,
                             queue_alarm_s=0.0)
         kw = dict(lease=lease, cache=CacheParams(enabled=False), qos=qos,
@@ -217,6 +222,133 @@ def _trace_summary(coord, replicas: int) -> dict:
     for ph, xs in sorted(phases.items()):
         out[f"miner_{ph}_p50"] = round(median(xs), 6)
     return out
+
+
+def _children_cpu_s(pids) -> float:
+    """Summed utime+stime of child processes (``/proc/<pid>/stat``) —
+    the procs leg's scheduler CPU lives in other processes, so the
+    harness's own ``process_time`` would measure nothing."""
+    import os
+    tick = os.sysconf("SC_CLK_TCK")
+    total = 0.0
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/stat", encoding="ascii") as fh:
+                parts = fh.read().rsplit(") ", 1)[-1].split()
+            total += (int(parts[11]) + int(parts[12])) / tick
+        except (OSError, ValueError, IndexError):
+            continue
+    return total
+
+
+def run_load_procs(tenants: int = 200, replicas: int = 2,
+                   miners: int = 4, *, requests_per_tenant: int = 1,
+                   req_nonces: int = 256,
+                   timeout_s: float = 180.0) -> dict:
+    """Multi-process topology leg (ISSUE 12, ``loadharness --procs``):
+    the REAL process topology — router + one OS process per replica on
+    its own LSP socket + fake (instant-compute) miner agents — driven
+    by ring-resolving tenants over real localhost UDP, so ``detail.load``
+    can compare in-process vs multi-process replicas at equal tenant
+    count. The shape of the returned dict matches :func:`run_load`
+    (``cpu_s_per_request`` sums the CHILD processes' CPU from /proc)."""
+    import shutil
+    import tempfile
+
+    async def leg() -> dict:
+        from ..lsp.client import new_async_client
+        from ..lsp.params import Params
+        from .procs import ProcCluster, resolve_owner
+        statedir = tempfile.mkdtemp(prefix="dbm_loadprocs_")
+        env = {"DBM_HEALTH_BEAT_S": "0.25", "DBM_HEALTH_MISS_K": "3",
+               "DBM_EPOCH_MILLIS": "500", "DBM_EPOCH_LIMIT": "8",
+               "DBM_TRACE_SAMPLE": "0.01"}
+        params = Params(epoch_limit=8, epoch_millis=500, window_size=32,
+                        max_backoff_interval=2)
+        cluster = ProcCluster(statedir, replicas=replicas, miners=miners,
+                              env=env, fake_miners=True)
+        cluster.start()
+        latencies: list = []
+        sheds: list = []
+
+        async def tenant(name: str, count: int) -> None:
+            owner = resolve_owner(statedir, name)
+            if owner is None:
+                sheds.append(count)
+                return
+            try:
+                client = await new_async_client(owner[1], params)
+            except LspError:
+                sheds.append(count)
+                return
+            stamps = []
+            try:
+                for i in range(count):
+                    stamps.append(time.monotonic())
+                    client.write(new_request(f"{name}#{i}", 0,
+                                             req_nonces - 1).to_json())
+                got = 0
+                while got < count:
+                    msg = Message.from_json(await client.read())
+                    if msg.type == MsgType.RESULT:
+                        latencies.append(time.monotonic() - stamps[got])
+                        got += 1
+            except LspError:
+                sheds.append(len(stamps))
+            finally:
+                await client.close()
+
+        try:
+            await cluster.wait_live(replicas, timeout_s=30.0,
+                                    miners=miners)
+            pids = [p.pid for p in cluster.procs.values()]
+            cpu0 = _children_cpu_s(pids)
+            t0 = time.monotonic()
+            tasks = [asyncio.create_task(
+                tenant(f"t{t}", requests_per_tenant))
+                for t in range(tenants)]
+            try:
+                await asyncio.wait_for(asyncio.gather(*tasks), timeout_s)
+                timed_out = False
+            except asyncio.TimeoutError:
+                timed_out = True
+            makespan = time.monotonic() - t0
+            cpu_s = _children_cpu_s(pids) - cpu0
+            for task in tasks:
+                task.cancel()
+        finally:
+            cluster.close()
+            shutil.rmtree(statedir, ignore_errors=True)
+        total = tenants * requests_per_tenant
+        completed = len(latencies)
+        latencies.sort()
+
+        def pct(q: float):
+            if not latencies:
+                return None
+            return round(latencies[min(len(latencies) - 1,
+                                       int(q * len(latencies)))], 4)
+
+        out = {
+            "tenants": tenants, "replicas": replicas, "miners": miners,
+            "topology": "procs",
+            "requests": total, "completed": completed,
+            "shed_tenants": len(sheds),
+            "shed_rate": round(1 - completed / total, 4) if total
+            else 0.0,
+            "makespan_s": round(makespan, 3),
+            "admitted_per_s": round(completed / makespan, 1)
+            if makespan > 0 else None,
+            "p50_s": pct(0.50), "p99_s": pct(0.99),
+            "cpu_s_per_request": round(cpu_s / completed, 6)
+            if completed else None,
+            "trace": {"sampled_traces": 0},
+        }
+        if timed_out:
+            out["timed_out"] = True
+        return out
+
+    return asyncio.run(leg())
 
 
 def load_curve(points, replica_counts=(1, 4), rounds: int = 2,

@@ -1,0 +1,733 @@
+"""Multi-process replica tier: one OS process per replica (ISSUE 12).
+
+PR 11 ran N scheduler replicas IN ONE PROCESS behind one socket, with
+takeover driven by a method call. This module promotes the topology to
+real processes that survive real crashes (SIGKILL, SIGSTOP wedge,
+partition), with failure detected by the health plane in
+``apps/health.py`` instead of any test hook:
+
+- **Replica process** (:class:`ReplicaProcess`, CLI ``replica``): one
+  :class:`~.scheduler.Scheduler` on its OWN LSP socket, heartbeating a
+  :class:`~.health.Beat` file every ``DBM_HEALTH_BEAT_S`` seconds and
+  watching the published membership for its own fence — a replica that
+  finds its ``(rid, incarnation)`` in the fenced ledger STOPS SERVING
+  (closes its socket so clients resubmit and miners rejoin) and exits
+  with :data:`FENCED_EXIT` for its supervisor to respawn fresh.
+- **Router process** (:class:`Router`, CLI ``router``): control-plane
+  ONLY — it scans the beat files at the beat cadence, runs the shared
+  :func:`~.health.router_tick` detection (a replica whose beat ``seq``
+  freezes for ``DBM_HEALTH_MISS_K`` beats is dead), and publishes
+  ``membership.json`` with a bumped fencing epoch. It is NOT on the
+  data path: clients hash the tenant over the advertised ring
+  themselves (client-side ring — see README "Horizontal scale" for the
+  justification vs a proxy router), so a router restart never
+  interrupts traffic; it only delays the NEXT membership change.
+- **Miner agent** (:class:`MinerAgent`, CLI ``miner``): wraps a
+  :class:`~.miner.MinerWorker`; joins the live replica with the
+  thinnest advertised miner slice and, when its conn dies (replica
+  killed or fenced), re-reads the membership and REJOINS a survivor —
+  the process-topology analog of PR 11's in-process miner adoption.
+- **Replicated cache tier** (:class:`SpoolResultCache`): each replica's
+  ResultCache WRITES THROUGH finished results to an append-only
+  per-incarnation spool file; every replica ingests its peers' spools
+  on the beat cadence, so a tenant re-hashed after a failover replays
+  answers the dead replica produced. Lines from a FENCED incarnation
+  are dropped at ingest (:meth:`~.health.Membership.writer_fenced`) —
+  a declared-dead replica's late writes must not propagate; a missing
+  entry only degrades to recompute, never to a wrong or duplicate
+  reply. (The alternative — an LSP-served cache process — was
+  rejected: a synchronous miss-path RPC from inside the scheduler's
+  event handlers is exactly the loop-block class dbmlint polices, an
+  asynchronous one gives no stronger guarantee than spool ingest, and
+  the extra process is one more thing to health-check; the measured
+  cost of the spool tier is one file append per finished request and
+  an O(new lines) read per beat.)
+
+Exactly-once across process death is the PR 11 argument re-based on the
+client: a killed replica never replied to the requests still queued or
+in flight with it (a replied request is no longer in flight), so the
+client's retry plane re-serves them through the new ring owner — a
+retry of an ALREADY-replied request replays from the replicated cache
+(or recomputes the identical pure function of the request key). The
+fencing epoch closes the partitioned-but-alive hole: a replica that
+was declared dead but keeps serving only ever answers conns its
+clients have already abandoned, and its cache writes are refused.
+
+State directory layout (all writes atomic tmp+rename)::
+
+    <statedir>/beat_<rid>.json       one Beat per replica, seq advancing
+    <statedir>/membership.json       ring + fencing ledger (router-owned)
+    <statedir>/cache_<rid>_<inc>.spool   append-only result spool
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..lsp.errors import LspError
+from ..utils._env import float_env as _float_env, int_env as _int_env
+from .health import Beat, BeatMonitor, Membership, RouterState, router_tick
+from .replicas import HashRing
+from .scheduler import ResultCache
+
+logger = logging.getLogger("dbm.procs")
+
+__all__ = ["ReplicaProcess", "Router", "MinerAgent", "SpoolResultCache",
+           "ProcCluster", "read_membership", "resolve_owner",
+           "gc_fenced_spools", "FENCED_EXIT"]
+
+#: Exit code of a replica process that observed its own fence: the
+#: supervisor (ProcCluster, or an operator's systemd unit) respawns it
+#: with a fresh incarnation, which the router re-admits.
+FENCED_EXIT = 3
+
+
+def health_beat_s() -> float:
+    """``DBM_HEALTH_BEAT_S`` (default 0.5): replica heartbeat period and
+    router poll cadence."""
+    return max(0.01, _float_env("DBM_HEALTH_BEAT_S", 0.5))
+
+
+def health_miss_k() -> int:
+    """``DBM_HEALTH_MISS_K`` (default 3): missed beats before a replica
+    is declared dead and fenced."""
+    return max(1, _int_env("DBM_HEALTH_MISS_K", 3))
+
+
+def proc_cache_enabled() -> bool:
+    """``DBM_PROC_CACHE`` (default 1): the spool-replicated cache tier;
+    0 = per-replica caches only (failover replays degrade to
+    recompute)."""
+    return _int_env("DBM_PROC_CACHE", 1) != 0
+
+
+# ------------------------------------------------------------ state files
+
+def write_json_atomic(path: str, obj: dict) -> None:
+    """Atomic publish: a reader sees the old or the new document, never
+    a torn write (rename is atomic on one filesystem)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def beat_path(statedir: str, rid: int) -> str:
+    return os.path.join(statedir, f"beat_{rid}.json")
+
+
+def membership_path(statedir: str) -> str:
+    return os.path.join(statedir, "membership.json")
+
+
+def read_membership(statedir: str) -> Optional[Membership]:
+    """The advertised membership, or None while the router has not yet
+    published (or mid-restart with no file) — callers back off."""
+    d = read_json(membership_path(statedir))
+    return Membership.from_dict(d) if d else None
+
+
+def read_beats(statedir: str) -> List[Beat]:
+    out = []
+    try:
+        names = os.listdir(statedir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("beat_") and name.endswith(".json")):
+            continue
+        d = read_json(os.path.join(statedir, name))
+        if d is not None:
+            try:
+                out.append(Beat.from_dict(d))
+            except (TypeError, KeyError):
+                continue
+    return out
+
+
+def resolve_owner(statedir: str, key) -> Optional[Tuple[int, str]]:
+    """Client-side ring: ``(rid, hostport)`` of the replica owning
+    ``key``, or None when no membership / no live replica is advertised
+    (back off and retry).
+
+    The ring spans SERVING replicas — live AND advertising at least one
+    joined miner in their current incarnation's beat — mirroring the
+    PR 11 in-process routing rule: a hash owner with an empty miner
+    slice would queue the request into the age alarm forever while
+    capacity sat idle next door. When no replica holds miners yet, every
+    key resolves to the FIRST live replica — exactly where the miner
+    agent's thinnest-slice rule lands the first JOIN (min miner count,
+    ties by lowest rid), so pre-miner requests wait where capacity will
+    first appear."""
+    m = read_membership(statedir)
+    if m is None or not m.live:
+        return None
+    counts = {b.rid: b.miners for b in read_beats(statedir)
+              if b.rid in m.live
+              and b.incarnation == m.live[b.rid]["incarnation"]}
+    serving = sorted(r for r in m.live if counts.get(r, 0) > 0)
+    ring_ids = serving or [min(m.live)]
+    rid = HashRing(ring_ids).owner(key)
+    return rid, f"127.0.0.1:{m.live[rid]['port']}"
+
+
+# ------------------------------------------------------- replicated cache
+
+class SpoolResultCache(ResultCache):
+    """ResultCache with write-through spool replication (module
+    docstring). ``put`` appends one JSON line to this incarnation's
+    spool; :meth:`ingest` folds peers' new lines into the local LRU,
+    dropping lines whose writer incarnation is fenced.
+
+    Disk discipline (code review): the in-memory LRU is bounded by
+    ``size`` but an append-only file is not — after
+    ``ROTATE_FACTOR * size`` lines the spool ROTATES (the old file is
+    unlinked and a fresh ``.<seq>.spool`` starts), so one incarnation
+    never holds more than ~one rotation window on disk. Entries a slow
+    peer had not yet consumed from an unlinked file are lost — a
+    recompute, never a wrong reply (the tier is best-effort by
+    contract). Fenced incarnations' leftover spools are unlinked by
+    the router (:func:`gc_fenced_spools`)."""
+
+    #: Spool lines per file before rotation, as a multiple of the LRU
+    #: bound (entries past ~1 LRU's worth are evictees anyway).
+    ROTATE_FACTOR = 4
+
+    def __init__(self, size: int, statedir: str, rid: int,
+                 incarnation: str):
+        super().__init__(size)
+        self.statedir = statedir
+        self.rid = rid
+        self.incarnation = incarnation
+        self._spool_seq = 0
+        self._spool_lines = 0
+        self._rotate_at = max(1024, self.ROTATE_FACTOR * size)
+        self._spool = os.path.join(
+            statedir, f"cache_{rid}_{incarnation}.spool")
+        self._offsets: Dict[str, int] = {}     # peer spool -> bytes read
+        self.spooled = 0
+        self.ingested = 0
+        self.dropped_fenced = 0
+
+    def put(self, key, value) -> None:
+        super().put(key, value)
+        line = json.dumps({"rid": self.rid, "inc": self.incarnation,
+                           "key": list(key), "h": value[0],
+                           "n": value[1]})
+        try:
+            with open(self._spool, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+            self.spooled += 1
+            self._spool_lines += 1
+            if self._spool_lines >= self._rotate_at:
+                self._rotate()
+        except OSError:
+            logger.warning("cache spool append failed; entry stays "
+                           "local-only", exc_info=True)
+
+    def _rotate(self) -> None:
+        """Unlink the full spool and start a fresh one (class
+        docstring). The filename keeps the ``cache_<rid>_<inc>`` stem
+        (ingesters parse writer identity from the LINES, the router's
+        fence GC from the stem)."""
+        try:
+            os.unlink(self._spool)
+        except OSError:
+            pass
+        self._spool_seq += 1
+        self._spool_lines = 0
+        self._spool = os.path.join(
+            self.statedir,
+            f"cache_{self.rid}_{self.incarnation}"
+            f".{self._spool_seq}.spool")
+
+    def ingest(self, membership: Optional[Membership]) -> int:
+        """Fold peers' new spool lines into the local cache (best-effort
+        replay forwarding). Returns entries ingested this call."""
+        got = 0
+        try:
+            names = os.listdir(self.statedir)
+        except OSError:
+            return 0
+        spools = sorted(n for n in names if n.startswith("cache_")
+                        and n.endswith(".spool"))
+        # Offsets of rotated/GC'd-away files would otherwise accumulate
+        # one entry per dead filename forever.
+        for stale in set(self._offsets) - set(spools):
+            self._offsets.pop(stale, None)
+        for name in spools:
+            path = os.path.join(self.statedir, name)
+            if path == self._spool:
+                continue
+            offset = self._offsets.get(name, 0)
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    data = fh.read()
+            except FileNotFoundError:
+                # Rotated/GC'd away: drop the stale offset so the
+                # tracking map stays bounded by LIVE spool files.
+                self._offsets.pop(name, None)
+                continue
+            except OSError:
+                continue
+            # Consume only COMPLETE lines: a read racing the writer's
+            # append may end mid-line — leave the partial tail for the
+            # next pass instead of losing the entry.
+            end = data.rfind(b"\n")
+            if end < 0:
+                continue
+            self._offsets[name] = offset + end + 1
+            for raw in data[:end].splitlines():
+                try:
+                    d = json.loads(raw.decode("utf-8"))
+                    key = tuple(d["key"])
+                    value = (int(d["h"]), int(d["n"]))
+                    wrid, winc = int(d["rid"]), str(d["inc"])
+                except (ValueError, KeyError, TypeError):
+                    continue      # corrupt line = one lost entry = one
+                    # recompute, never a wrong reply
+                if membership is not None and \
+                        membership.writer_fenced(wrid, winc):
+                    self.dropped_fenced += 1
+                    continue
+                ResultCache.put(self, key, value)   # no re-spool
+                got += 1
+        self.ingested += got
+        return got
+
+
+def gc_fenced_spools(statedir: str, membership: Membership) -> int:
+    """Unlink cache spools left behind by FENCED incarnations (their
+    lines are refused at ingest anyway — the files are pure disk
+    leak). Run by the router; returns files removed."""
+    removed = 0
+    try:
+        names = os.listdir(statedir)
+    except OSError:
+        return 0
+    for name in names:
+        if not (name.startswith("cache_") and name.endswith(".spool")):
+            continue
+        core = name[len("cache_"):-len(".spool")]
+        rid_s, _, rest = core.partition("_")
+        inc = rest.split(".")[0]     # strip a rotation suffix
+        try:
+            rid = int(rid_s)
+        except ValueError:
+            continue
+        if membership.writer_fenced(rid, inc):
+            try:
+                os.unlink(os.path.join(statedir, name))
+                removed += 1
+            except OSError:
+                continue
+    return removed
+
+
+# --------------------------------------------------------- replica process
+
+class ReplicaProcess:
+    """One scheduler replica as its own OS process (module docstring).
+
+    Owns: the LSP server on ``port`` (0 = ephemeral, advertised via the
+    beat), the Scheduler, the beat task, and the fence watch. ``run()``
+    returns ``"fenced"`` when the replica observed its own fence and
+    stopped serving, ``"closed"`` on transport close.
+    """
+
+    def __init__(self, statedir: str, rid: int, port: int = 0,
+                 params=None, lease=None, cache=None, stripe=None,
+                 qos=None, beat_s: Optional[float] = None,
+                 spool: Optional[bool] = None):
+        from ..utils.config import CacheParams
+        self.statedir = statedir
+        self.rid = rid
+        self.port = port
+        self.params = params
+        self.lease = lease
+        self.stripe = stripe
+        self.qos = qos
+        self.beat_s = beat_s if beat_s is not None else health_beat_s()
+        self.incarnation = f"{os.getpid()}-{int(time.time() * 1000)}"
+        cache = cache if cache is not None else CacheParams()
+        use_spool = spool if spool is not None else proc_cache_enabled()
+        self.cache_params = cache
+        self.cache: Optional[ResultCache] = None
+        if cache.enabled:
+            self.cache = (SpoolResultCache(cache.size, statedir, rid,
+                                           self.incarnation)
+                          if use_spool else ResultCache(cache.size))
+        self.server = None
+        self.sched = None
+        self.fenced = False
+        self._seq = 0
+
+    async def run(self) -> str:
+        from ..lsp.server import new_async_server
+        from .scheduler import Scheduler
+        os.makedirs(self.statedir, exist_ok=True)
+        self.server = await new_async_server(self.port, self.params)
+        self.sched = Scheduler(self.server, lease=self.lease,
+                               cache=self.cache_params,
+                               stripe=self.stripe, qos=self.qos,
+                               result_cache=self.cache)
+        print(f"Replica {self.rid} listening on port {self.server.port}",
+              flush=True)
+        self._write_beat()                 # admit before first request
+        beat_task = asyncio.get_running_loop().create_task(
+            self._beat_loop())
+        try:
+            await self.sched.run()
+            return "fenced" if self.fenced else "closed"
+        finally:
+            beat_task.cancel()
+            self._write_beat(final=True)
+            await self.server.close()
+
+    def _write_beat(self, final: bool = False) -> None:
+        self._seq += 1
+        m = read_membership(self.statedir)
+        beat = Beat(
+            rid=self.rid, incarnation=self.incarnation, seq=self._seq,
+            port=self.server.port if self.server else 0,
+            serving=not self.fenced and not final,
+            miners=len(self.sched.miners) if self.sched else 0,
+            queue_depth=(self.sched.tenant_plane.queue_len()
+                         if self.sched else 0),
+            epoch_seen=m.epoch if m else 0)
+        try:
+            write_json_atomic(beat_path(self.statedir, self.rid),
+                              beat.to_dict())
+        except OSError:
+            logger.warning("beat write failed; retrying next tick",
+                           exc_info=True)
+
+    async def _beat_loop(self) -> None:
+        """Heartbeat + fence watch + cache-spool ingest, one tick per
+        ``beat_s``. On observing its own fence the replica stops
+        serving: the server closes, every conn dies (clients resubmit
+        via the ring, miners rejoin a survivor), and ``run`` returns."""
+        while True:
+            await asyncio.sleep(self.beat_s)
+            m = read_membership(self.statedir)
+            if m is not None and m.is_fenced(self.rid, self.incarnation):
+                self.fenced = True
+                logger.warning(
+                    "replica %d (%s) observed its own fence at epoch %d:"
+                    " closing the socket and exiting for respawn",
+                    self.rid, self.incarnation, m.epoch)
+                self._write_beat()
+                await self.server.close()
+                return
+            if isinstance(self.cache, SpoolResultCache):
+                self.cache.ingest(m)
+            self._write_beat()
+
+
+# ----------------------------------------------------------------- router
+
+class Router:
+    """The thin membership/health router (control plane only)."""
+
+    def __init__(self, statedir: str, beat_s: Optional[float] = None,
+                 miss_k: Optional[int] = None):
+        self.statedir = statedir
+        self.beat_s = beat_s if beat_s is not None else health_beat_s()
+        self.miss_k = miss_k if miss_k is not None else health_miss_k()
+        self.state = RouterState(BeatMonitor(self.beat_s, self.miss_k))
+
+    async def run(self) -> None:
+        os.makedirs(self.statedir, exist_ok=True)
+        # Restart continuity: the fencing epoch must never regress, so
+        # a restarted router resumes from the published document.
+        prior = read_membership(self.statedir)
+        if prior is not None:
+            self.state.membership = prior
+        print(f"Router watching {self.statedir} "
+              f"(beat {self.beat_s}s, K={self.miss_k})", flush=True)
+        loop = asyncio.get_running_loop()
+        published = False
+        ticks = 0
+        while True:
+            changed = router_tick(self.state, read_beats(self.statedir),
+                                  loop.time())
+            if changed or not published:
+                write_json_atomic(membership_path(self.statedir),
+                                  self.state.membership.to_dict())
+                published = True
+                if changed:
+                    m = self.state.membership
+                    logger.warning(
+                        "membership epoch %d: live=%s fenced=%s",
+                        m.epoch, sorted(m.live),
+                        {r: f["epoch"] for r, f in m.fenced.items()})
+            ticks += 1
+            if changed or ticks % 64 == 0:
+                # Fenced incarnations' leftover spools are a pure disk
+                # leak (their lines are refused at ingest): sweep them
+                # on every fence and periodically thereafter.
+                gc_fenced_spools(self.statedir, self.state.membership)
+            await asyncio.sleep(self.beat_s)
+
+
+# ------------------------------------------------------------ miner agent
+
+class MinerAgent:
+    """Replica-aware miner wrapper: join the thinnest live slice, rejoin
+    a survivor when the conn dies (module docstring)."""
+
+    def __init__(self, statedir: str, params=None,
+                 searcher_factory: Optional[Callable] = None,
+                 backoff_s: float = 0.2):
+        self.statedir = statedir
+        self.params = params
+        self.backoff_s = backoff_s
+        if searcher_factory is None:
+            from .miner import HostSearcher
+            searcher_factory = lambda d, b: HostSearcher(d)  # noqa: E731
+        self.factory = searcher_factory
+        self.joins = 0
+
+    def _pick(self) -> Optional[str]:
+        m = read_membership(self.statedir)
+        if m is None or not m.live:
+            return None
+        counts = {b.rid: b.miners for b in read_beats(self.statedir)}
+        rid = min(sorted(m.live), key=lambda r: counts.get(r, 0))
+        return f"127.0.0.1:{m.live[rid]['port']}"
+
+    async def run(self) -> None:
+        from .miner import MinerWorker
+        while True:
+            hostport = self._pick()
+            if hostport is None:
+                await asyncio.sleep(self.backoff_s)
+                continue
+            worker = MinerWorker(hostport, params=self.params,
+                                 searcher_factory=self.factory)
+            try:
+                await worker.join()
+                self.joins += 1
+                logger.info("miner agent joined %s (join #%d)",
+                            hostport, self.joins)
+                await worker.run()     # returns when the conn dies
+            except LspError as exc:
+                logger.info("miner agent join/run to %s failed: %s",
+                            hostport, exc)
+            finally:
+                await worker.close()
+            await asyncio.sleep(self.backoff_s)
+
+
+class _InstantSearcher:
+    """Fake miner compute for the ``--fake`` agent mode (loadharness
+    ``--procs``): answers instantly with a deterministic function of
+    (data, lower) — the control plane is the thing being measured."""
+
+    _MIX = 0xBF58476D1CE4E5B9
+    _MASK = (1 << 64) - 1
+
+    def __init__(self, data: str):
+        self.data = data
+
+    def search(self, lower: int, upper: int):
+        h = (hash(self.data) * self._MIX
+             + lower * 0x9E3779B97F4A7C15) & self._MASK
+        return h, lower
+
+
+# ------------------------------------------------------- process cluster
+
+class ProcCluster:
+    """Spawn and fault a whole topology of REAL OS processes — the
+    harness behind the tier-1 procs smoke leg, the process chaos storms
+    in tests/test_chaos.py, and ``loadharness --procs``.
+
+    The cluster only SPAWNS and SIGNALS; failure detection is entirely
+    the router's beat watch (no test-hook kill path — the acceptance
+    criterion). ``kill_replica`` is a raw SIGKILL; ``stop_replica`` /
+    ``cont_replica`` model the partitioned-but-alive wedge (SIGSTOP
+    freezes the beat writer while the OS keeps its sockets alive).
+    """
+
+    def __init__(self, statedir: str, replicas: int = 2, miners: int = 1,
+                 env: Optional[dict] = None, fake_miners: bool = False):
+        self.statedir = statedir
+        self.n = replicas
+        self.m = miners
+        self.fake = fake_miners
+        self.env = dict(os.environ)
+        # Children must never touch JAX or pay emitter/probe overhead.
+        self.env.update({"JAX_PLATFORMS": "cpu",
+                         "DBM_METRICS_INTERVAL_S": "0",
+                         "DBM_QUEUE_ALARM_S": "0"})
+        self.env.update(env or {})
+        self.procs: Dict[str, object] = {}      # name -> Popen
+
+    # -- spawning ------------------------------------------------------
+
+    def _spawn(self, name: str, args: List[str]):
+        import subprocess
+        import sys
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_bitcoinminer_tpu.apps.procs", *args],
+            env=self.env, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.procs[name] = proc
+        return proc
+
+    def start(self) -> None:
+        os.makedirs(self.statedir, exist_ok=True)
+        self._spawn("router", ["router", self.statedir])
+        for rid in range(self.n):
+            self.spawn_replica(rid)
+        for i in range(self.m):
+            args = ["miner", self.statedir]
+            if self.fake:
+                args.append("--fake")
+            self._spawn(f"miner{i}", args)
+
+    def spawn_replica(self, rid: int):
+        return self._spawn(f"replica{rid}",
+                           ["replica", self.statedir, "--rid", str(rid)])
+
+    def respawn_router(self):
+        return self._spawn("router", ["router", self.statedir])
+
+    # -- faults --------------------------------------------------------
+
+    def _signal(self, name: str, sig: int) -> bool:
+        proc = self.procs.get(name)
+        if proc is None or proc.poll() is not None:
+            return False
+        os.kill(proc.pid, sig)
+        return True
+
+    def kill_replica(self, rid: int) -> bool:
+        import signal
+        return self._signal(f"replica{rid}", signal.SIGKILL)
+
+    def stop_replica(self, rid: int) -> bool:
+        import signal
+        return self._signal(f"replica{rid}", signal.SIGSTOP)
+
+    def cont_replica(self, rid: int) -> bool:
+        import signal
+        return self._signal(f"replica{rid}", signal.SIGCONT)
+
+    def kill_router(self) -> bool:
+        import signal
+        return self._signal("router", signal.SIGKILL)
+
+    def replica_alive(self, rid: int) -> bool:
+        proc = self.procs.get(f"replica{rid}")
+        return proc is not None and proc.poll() is None
+
+    # -- observation ---------------------------------------------------
+
+    def membership(self) -> Optional[Membership]:
+        return read_membership(self.statedir)
+
+    async def wait_live(self, k: int, timeout_s: float = 20.0,
+                        miners: int = 0) -> Membership:
+        """Wait until the advertised membership has ``k`` live replicas
+        (and, optionally, the beats show ``miners`` joined miners)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            m = self.membership()
+            if m is not None and len(m.live) == k:
+                if miners <= sum(b.miners for b in
+                                 read_beats(self.statedir)
+                                 if b.rid in m.live
+                                 and b.serving):
+                    return m
+            await asyncio.sleep(0.05)
+        raise TimeoutError(
+            f"membership never reached {k} live / {miners} miners: "
+            f"{self.membership() and self.membership().to_dict()}")
+
+    def close(self) -> None:
+        import signal
+        for name, proc in self.procs.items():
+            if proc.poll() is None:
+                try:
+                    os.kill(proc.pid, signal.SIGCONT)  # unfreeze first
+                    proc.terminate()
+                except OSError:
+                    pass
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001 — teardown must finish
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+# -------------------------------------------------------------------- CLI
+
+def main(argv=None) -> int:
+    """CLI: ``procs {replica|router|miner} <statedir> [options]`` — the
+    process entrypoints ProcCluster (and operators) spawn."""
+    import argparse
+    import sys
+    argv = sys.argv[1:] if argv is None else argv
+    ap = argparse.ArgumentParser(prog="procs", description=__doc__)
+    sub = ap.add_subparsers(dest="role", required=True)
+    rep = sub.add_parser("replica")
+    rep.add_argument("statedir")
+    rep.add_argument("--rid", type=int, required=True)
+    rep.add_argument("--port", type=int, default=0)
+    rout = sub.add_parser("router")
+    rout.add_argument("statedir")
+    mine = sub.add_parser("miner")
+    mine.add_argument("statedir")
+    mine.add_argument("--fake", action="store_true",
+                      help="instant fake compute (loadharness --procs)")
+    args = ap.parse_args(argv)
+
+    from ..utils import configure_logging, from_env
+    configure_logging(logging.INFO)
+    cfg = from_env()
+    try:
+        if args.role == "replica":
+            proc = ReplicaProcess(args.statedir, args.rid,
+                                  port=args.port, params=cfg.params,
+                                  lease=cfg.lease, cache=cfg.cache,
+                                  stripe=cfg.stripe, qos=cfg.qos)
+            outcome = asyncio.run(proc.run())
+            return FENCED_EXIT if outcome == "fenced" else 0
+        if args.role == "router":
+            asyncio.run(Router(args.statedir).run())
+            return 0
+        factory = None
+        if args.fake:
+            factory = lambda d, b: _InstantSearcher(d)  # noqa: E731
+        asyncio.run(MinerAgent(args.statedir, params=cfg.params,
+                               searcher_factory=factory).run())
+        return 0
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -57,7 +57,14 @@ from typing import Callable, Dict, Optional
 
 from ..utils.metrics import Registry
 
-__all__ = ["TokenBucket", "TenantState", "QosPlane"]
+__all__ = ["TokenBucket", "TenantState", "QosPlane", "LAZY_REMOVE"]
+
+#: Sentinel a :meth:`QosPlane.pick_lazy` head callback returns when the
+#: tenant has NO backlog at all (nothing queued, no ungranted chunks):
+#: the walk drops it from the ring on the spot, forfeiting its deficit
+#: (the idle-banks-no-credit rule, applied lazily instead of by the
+#: stock pump's per-pass ``sync_backlog`` scan).
+LAZY_REMOVE = object()
 
 
 class TokenBucket:
@@ -161,6 +168,17 @@ class QosPlane:
         # Tenants granted since the last sweep (share-gauge dirty set).
         self._dirty_shares: set = set()
         self._sweeps = 0
+        # Lazy-walk incremental quantum bound (ISSUE 12): the largest
+        # head cost SEEN so far by pick_lazy, reset when the ring
+        # drains. The stock pick recomputes max(candidates) per pick —
+        # O(candidates); the lazy walk grows this bound incrementally
+        # as heads are priced, which keeps the classic DRR guarantee
+        # (top-up >= weight * any candidate cost once that cost has
+        # been seen) at O(1) per visit. A larger-than-necessary quantum
+        # only coarsens grant granularity — share still converges to
+        # the weight RATIO, because every tenant tops up from the same
+        # bound.
+        self._lazy_quantum = 0.0
         self._g_tenants = metrics.gauge("qos_tenants")
 
     # ------------------------------------------------------------- tenants
@@ -214,6 +232,21 @@ class QosPlane:
                 if st is not None:
                     st.deficit = 0.0   # idle credit never re-enters
                 self._ensure_ring(tenant)
+
+    def backlog_enter(self, tenant) -> None:
+        """Lazy-mode ring entry (ISSUE 12): called the moment a tenant
+        GAINS backlog (request enqueued, chunked activation with chunks
+        left) instead of by a per-pass ``sync_backlog`` scan. A tenant
+        (re-)entering the ring starts from zero deficit — the same
+        idle-banks-no-credit rule ``sync_backlog`` enforces at both
+        membership edges; one already IN the ring keeps its earned
+        deficit (continuity)."""
+        if tenant in self._in_ring:
+            return
+        st = self.tenants.get(tenant)
+        if st is not None:
+            st.deficit = 0.0
+        self._ensure_ring(tenant)
 
     def set_weight(self, tenant, weight: float) -> None:
         if tenant in self.tenants:
@@ -322,6 +355,72 @@ class QosPlane:
                 visited = 0
                 self._topped.clear()   # a new cycle may top up afresh
         return next(iter(candidates))   # unreachable safety valve
+
+    def pick_lazy(self, head_fn) -> Optional[object]:
+        """Lazy ring-ordered DRR selection (ISSUE 12, ``DBM_QOS_LAZY``).
+
+        The stock :meth:`pick` consumes a fully materialized candidate
+        map — the scheduler rebuilds it with an O(backlogged-tenants)
+        heads scan before EVERY grant, the per-completion melt behind
+        the N=1 superlinear tail at 10k tenants (BENCH_r06). Here the
+        walk itself drives candidate discovery: ``head_fn(tenant)``
+        prices ONE tenant's next grantable item on demand and returns
+
+        - a positive cost in nonces (grantable now),
+        - ``None`` (backlogged but not grantable this instant — at its
+          in-flight cap, or no executable slot), or
+        - :data:`LAZY_REMOVE` (no backlog at all — dropped from the
+          ring on the spot, deficit forfeited).
+
+        DRR semantics are the stock ones: persistent ring head, top-up
+        at most once per cycle, rotate past a tenant that cannot afford
+        after its cycle top-up. The quantum is the INCREMENTAL bound
+        :attr:`_lazy_quantum` (max head cost seen so far) instead of a
+        per-pick max over all candidates; since the bound dominates
+        every priced cost, a backlogged tenant still affords within
+        ``ceil(1/weight)`` cycles of its pricing, and sustained share
+        still converges to the weight ratio. Amortized cost per grant
+        is O(visited tenants) with the head staying put while its
+        deficit lasts — O(1) for homogeneous traffic — instead of
+        O(backlogged) per grant.
+        """
+        for _cycle in range(self.MAX_PASSES):
+            visited = 0
+            candidate_seen = False
+            while visited < len(self.ring):
+                if not self.ring:
+                    break
+                tenant = self.ring[0]
+                cost = head_fn(tenant)
+                if cost is LAZY_REMOVE:
+                    self.ring.popleft()
+                    self._in_ring.discard(tenant)
+                    self._topped.discard(tenant)
+                    st = self.tenants.get(tenant)
+                    if st is not None:
+                        st.deficit = 0.0   # idle credit never survives
+                    continue               # next head, visit not spent
+                if cost is not None:
+                    candidate_seen = True
+                    if cost > self._lazy_quantum:
+                        self._lazy_quantum = float(cost)
+                    st = self.tenant(tenant)
+                    if st.deficit >= cost:
+                        return tenant
+                    if tenant not in self._topped:
+                        self._topped.add(tenant)
+                        st.deficit += st.weight * self._lazy_quantum
+                        if st.deficit >= cost:
+                            return tenant
+                self.ring.rotate(-1)
+                visited += 1
+            if not self.ring:
+                self._lazy_quantum = 0.0   # idle plane: fresh bound
+                return None
+            if not candidate_seen:
+                return None                # nothing grantable this pass
+            self._topped.clear()           # new cycle may top up afresh
+        return None                        # safety valve (corrupt state)
 
     def on_grant(self, tenant, nonces: int) -> None:
         """Account one executed grant: debit the deficit, bump in-flight

@@ -40,8 +40,12 @@ with a live job.
 ``DBM_REPLICAS=1`` (default) means ``apps/server.py`` runs the plain
 single :class:`~.scheduler.Scheduler` — today's topology, bit-for-bit.
 In-process replicas shard the CONTROL-PLANE work (queues, pumps,
-sweeps, alarms — the 10k-tenant melt the load harness measures); the
-multi-process extension rides the same router unchanged.
+sweeps, alarms — the 10k-tenant melt the load harness measures). The
+MULTI-PROCESS tier (ISSUE 12, ``apps/procs.py`` + ``apps/health.py``)
+runs one OS process per replica on its own socket, replaces
+:meth:`ReplicaSet.kill` with missed-beat failure detection + fencing
+epochs, and reuses this module's :class:`HashRing` for the
+client-side tenant ring.
 """
 
 from __future__ import annotations

@@ -116,6 +116,7 @@ from ..utils.config import CacheParams, CoalesceParams, LeaseParams, \
 from ..utils.metrics import (Registry, RequestTrace, ensure_emitter,
                              registry as process_registry)
 from .miner_plane import Chunk, MinerPlane, MinerState
+from .qos import LAZY_REMOVE
 from .tenant_plane import TenantPlane
 
 logger = logging.getLogger("dbm.scheduler")
@@ -279,6 +280,16 @@ class Scheduler:
         self._inflight: dict[int, Request] = {}
         self._next_job_id = 0
         self._chunked_inflight = 0                # count of chunked mode
+        # Lazy-DRR per-tenant indexes (ISSUE 12, DBM_QOS_LAZY): the
+        # tenant's chunked in-flight requests with ungranted chunks
+        # (insertion order = activation order = oldest first) and its
+        # total in-flight request count — what makes the lazy pump's
+        # per-visit head pricing O(1) instead of an O(inflight +
+        # backlogged tenants) heads rebuild per grant. Maintained
+        # unconditionally (dict ops on retire/dispatch are noise); read
+        # only by the lazy pump.
+        self._qos_chunked_reqs: dict = {}         # tenant -> {job: Request}
+        self._tenant_inflight: dict = {}          # tenant -> request count
         self._dispatching = False                 # _maybe_dispatch guard
         self._starved = False                     # no-eligible-miner latch
         # Observability plane (ISSUE 3): a per-scheduler registry (so unit
@@ -324,6 +335,7 @@ class Scheduler:
             trace_get=self.tenant_plane.traces.get,
             lease_event=self._on_lease_event,
             dispatch=self._maybe_dispatch, trace_on=self._trace_on)
+        self._sync_backlog_hook()
 
     # Param blocks live on the planes (single source of truth); these
     # properties keep the pre-split read/WRITE surface — tests and
@@ -361,6 +373,26 @@ class Scheduler:
     @qos.setter
     def qos(self, value: QosParams) -> None:
         self.tenant_plane.qos = value
+        self._sync_backlog_hook()
+
+    def _sync_backlog_hook(self) -> None:
+        """(Un)register the lazy-DRR ring-entry hook to match the live
+        QoS params — tests reconfigure a live scheduler by assignment,
+        and the hook must track the ``lazy`` knob with them. On
+        REGISTRATION the ring is seeded from the backlog that already
+        exists (queued tenants + chunked in-flight requests with
+        ungranted chunks): the hook only fires on FUTURE enqueues, so
+        without the seed a request queued before the reconfigure would
+        never enter the ring and never be granted (code review)."""
+        lazy = self.qos.enabled and self.qos.lazy
+        if not lazy:
+            self.tenant_plane.backlog_hook = None
+            return
+        self.tenant_plane.backlog_hook = self.qos_plane.backlog_enter
+        for tenant in self.tenant_plane.backlog_tenants():
+            self.qos_plane.backlog_enter(tenant)
+        for tenant in self._qos_chunked_reqs:
+            self.qos_plane.backlog_enter(tenant)
 
     # ---------------------------------------------------------- public view
 
@@ -861,9 +893,19 @@ class Scheduler:
         and any UNGRANTED chunks simply evaporate (a difficulty prefix
         release on a chunked elephant skips their scans entirely)."""
         self.miner_plane.cancel_job(curr.job_id)
-        if self._inflight.pop(curr.job_id, None) is not None \
-                and curr.qos_mode == "chunked":
-            self._chunked_inflight -= 1
+        if self._inflight.pop(curr.job_id, None) is not None:
+            if curr.qos_mode == "chunked":
+                self._chunked_inflight -= 1
+            n = self._tenant_inflight.get(curr.conn_id, 0)
+            if n <= 1:
+                self._tenant_inflight.pop(curr.conn_id, None)
+            else:
+                self._tenant_inflight[curr.conn_id] = n - 1
+            d = self._qos_chunked_reqs.get(curr.conn_id)
+            if d is not None:
+                d.pop(curr.job_id, None)
+                if not d:
+                    del self._qos_chunked_reqs[curr.conn_id]
         if self.qos.enabled:
             self.qos_plane.release(
                 curr.conn_id, curr.granted_chunks - sum(curr.answered))
@@ -888,7 +930,10 @@ class Scheduler:
         self._dispatching = True
         try:
             if self.qos.enabled:
-                self._qos_pump()
+                if self.qos.lazy:
+                    self._qos_pump_lazy()
+                else:
+                    self._qos_pump()
             else:
                 self._fifo_pump()
         finally:
@@ -1193,6 +1238,138 @@ class Scheduler:
                 self._qos_activate(req, cap_pool, window)
             self._starved = False
 
+    def _qos_pump_lazy(self) -> None:
+        """The lazy-walk QoS grant loop (ISSUE 12, ``DBM_QOS_LAZY``,
+        default on; 0 = the stock :meth:`_qos_pump`).
+
+        Same grant semantics as the stock pump — chunk heads for chunked
+        in-flight requests, start heads for queued ones, the wholesale/
+        chunked dispatch decision, coalescing windows, DRR fairness —
+        but candidate DISCOVERY is lazy: instead of rebuilding the full
+        O(backlogged-tenants) heads map and re-syncing the ring before
+        every grant (the per-completion scan behind the 10k-tenant N=1
+        superlinear tail, BENCH_r06), the DRR ring itself is walked and
+        each visited tenant's head is priced on demand from two O(1)
+        per-tenant indexes (``_qos_chunked_reqs``,
+        ``tenant_plane.tenant_head``). Ring membership is maintained at
+        the edges (enqueue hook, chunked activation) and pruned lazily
+        by the walk (:data:`LAZY_REMOVE`), so a grant costs O(tenants
+        actually visited) — O(1) amortized — rather than O(backlogged).
+
+        Grant ORDER may differ from the stock walk (the incremental
+        quantum bound and visit order are not bit-identical), but the
+        DRR guarantees — no starvation within ``ceil(1/weight)``
+        cycles, share convergence to the weight ratio — and every merge
+        /accounting invariant are unchanged (dbmcheck explores this
+        path by default; the tier-1 matrix leg pins the stock walk)."""
+        plane = self.qos_plane
+        mp = self.miner_plane
+        tp = self.tenant_plane
+        # Same O(1) no-op exits as the stock pump.
+        if self._inflight and not self._chunked_inflight:
+            return
+        if not tp.queue_len() and not self._chunked_inflight:
+            return
+        if not mp.capacity_pool(self.qos.depth) and \
+                (self._inflight or not (mp.eligible()
+                                        or mp.desperation_pool())):
+            return     # saturated: nothing grantable this event
+        window: dict = {}
+        cap = self.qos.max_inflight
+        tenants_map = plane.tenants
+        while True:
+            # Stock one-at-a-time order: a wholesale dispatch from THIS
+            # pass (or a concurrent event) withholds further starts,
+            # and with no chunked work in flight there are no heads.
+            if self._inflight and not self._chunked_inflight:
+                break
+            eligible = mp.eligible()
+            cap_pool = mp.capacity_pool(self.qos.depth)
+            cold, small_bound = self._qos_small_bound()
+            none_inflight = not self._inflight
+            can_start = bool(eligible) or bool(mp.desperation_pool())
+            pool_n = len(mp.miners) or 1
+            heads: dict = {}     # tenants priced by THIS pick's walk
+
+            def head_for(tenant):
+                # Chunk head first: the tenant's oldest chunked
+                # in-flight request with ungranted chunks (pruning
+                # retired/exhausted index entries as they surface).
+                reqs = self._qos_chunked_reqs.get(tenant)
+                creq = None
+                while reqs:
+                    cand = next(iter(reqs.values()))
+                    if cand.job_id not in self._inflight or \
+                            cand.next_chunk >= cand.num_chunks:
+                        reqs.pop(cand.job_id, None)
+                        if not reqs:
+                            self._qos_chunked_reqs.pop(tenant, None)
+                        continue
+                    creq = cand
+                    break
+                st = tenants_map.get(tenant)
+                at_cap = cap > 0 and st is not None \
+                    and st.inflight >= cap
+                if creq is not None:
+                    if at_cap:
+                        return None
+                    lo, up = creq.chunk_bounds[creq.next_chunk]
+                    cost = up - lo
+                    joinable = (mp.window_room(window, creq.job_id)
+                                and self._coalescible_cost(creq, cost))
+                    if not (cap_pool or joinable):
+                        return None
+                    heads[tenant] = ("chunk", creq, cost)
+                    return cost
+                # Start head: the tenant's oldest queued request.
+                sreq = tp.tenant_head(tenant)
+                if sreq is None:
+                    return LAZY_REMOVE        # no backlog at all
+                if self._tenant_inflight.get(tenant) or at_cap:
+                    return None               # busy tenant: no start
+                total = sreq.upper - sreq.lower + 1
+                if none_inflight and self._qos_is_small(total, cold,
+                                                        small_bound):
+                    if not can_start:
+                        return None
+                    cost = max(1, total)
+                else:
+                    _, cost = self._qos_chunk_plan(max(1, total), pool_n)
+                    joinable = (mp.window_room(window, sreq.job_id)
+                                and self._coalescible_cost(sreq, cost))
+                    if not (cap_pool or joinable):
+                        return None
+                heads[tenant] = ("start", sreq, cost)
+                return cost
+
+            t = plane.pick_lazy(head_for)
+            if t is None:
+                break
+            kind, req, _cost = heads[t]
+            if kind == "chunk":
+                self._qos_grant(req, cap_pool, window)
+                if req.next_chunk >= req.num_chunks:
+                    d = self._qos_chunked_reqs.get(t)
+                    if d is not None:
+                        d.pop(req.job_id, None)
+                        if not d:
+                            self._qos_chunked_reqs.pop(t, None)
+                continue
+            self.tenant_plane.dequeue(req)
+            if self._replay_at_dispatch(req):
+                continue
+            # Same (cold, bound) pair as pricing above: pricing,
+            # candidacy, and the dispatch decision share ONE predicate.
+            if not self._inflight and self._qos_is_small(
+                    req.upper - req.lower + 1, cold, small_bound):
+                pool, desperate = mp.eligible(), False
+                if not pool:
+                    pool, desperate = mp.desperation_pool(), True
+                self._load_balance(req, pool, desperate=desperate)
+            else:
+                self._qos_activate(req, cap_pool, window)
+            self._starved = False
+
     def _qos_activate(self, req: Request, pool: list[MinerState],
                       window: Optional[dict] = None) -> None:
         """Activate a request in CHUNKED mode: plan contiguous ascending
@@ -1204,6 +1381,8 @@ class Scheduler:
         req.job_id = self._next_job_id
         req.qos_mode = "chunked"
         self._chunked_inflight += 1
+        self._tenant_inflight[req.conn_id] = \
+            self._tenant_inflight.get(req.conn_id, 0) + 1
         req.started = time.monotonic()
         self.tenant_plane.observe_queue_wait(req.started - req.queued_at)
         self.tenant_plane.traces.register(req.job_id, req.trace)
@@ -1239,6 +1418,13 @@ class Scheduler:
         req.num_chunks = n
         req.answered = [False] * n
         req.next_chunk = 0
+        # Lazy-DRR index (ISSUE 12): the tenant's chunked requests with
+        # ungranted chunks, activation order; the lazy pump prices
+        # chunk heads from it in O(1) and the entry retires with the
+        # request (or at grant exhaustion).
+        self._qos_chunked_reqs.setdefault(req.conn_id, {})[req.job_id] = req
+        if self.tenant_plane.backlog_hook is not None:
+            self.qos_plane.backlog_enter(req.conn_id)
         self._qos_grant(req, pool, window)
 
     def _qos_grant(self, req: Request, pool: list[MinerState],
@@ -1299,6 +1485,8 @@ class Scheduler:
         request.job_id = self._next_job_id
         request.qos_mode = "wholesale"
         self._inflight[request.job_id] = request
+        self._tenant_inflight[request.conn_id] = \
+            self._tenant_inflight.get(request.conn_id, 0) + 1
         request.started = time.monotonic()
         self.tenant_plane.observe_queue_wait(
             request.started - request.queued_at)

@@ -81,6 +81,11 @@ class TenantPlane:
         self._next_qkey = 0
         self.traces = TraceBuffer()
         self.tracks = _tracing.TrackSet()
+        # Lazy-DRR entry hook (ISSUE 12): the scheduler registers a
+        # callable fired on EVERY enqueue (including direct driver
+        # injections) so ring membership tracks backlog without a
+        # per-pump sync scan. None = stock walk, no hook cost.
+        self.backlog_hook = None
         self._cache_trace_seq = 0
         self._queue_depth = metrics.gauge("queue_depth")
         self._queue_wait = metrics.histogram("queue_wait_s",
@@ -132,6 +137,8 @@ class TenantPlane:
         self._queue[req.qkey] = req
         self._by_tenant.setdefault(req.conn_id, deque()).append(req)
         self._queue_depth.set(len(self._queue))
+        if self.backlog_hook is not None:
+            self.backlog_hook(req.conn_id)
 
     def dequeue(self, req) -> None:
         """Remove one specific queued request (a pump grant)."""
@@ -184,6 +191,12 @@ class TenantPlane:
     def backlog_tenants(self) -> list:
         """Tenants with queued work, first-queued order (ring sync)."""
         return [t for t, dq in self._by_tenant.items() if dq]
+
+    def tenant_head(self, tenant):
+        """One tenant's oldest queued request, or None — the lazy
+        pump's O(1) per-visit start-head lookup (ISSUE 12)."""
+        dq = self._by_tenant.get(tenant)
+        return dq[0] if dq else None
 
     def observe_queue_wait(self, waited_s: float) -> None:
         self._queue_wait.observe(waited_s)

@@ -292,6 +292,105 @@ async def _apply_event(ev: ChaosEvent,
         raise ValueError(f"unknown chaos action {ev.action!r}")
 
 
+# ------------------------------------------------ process-level storms
+
+#: Episode kinds of the PROCESS-level storm generator (ISSUE 12): a raw
+#: SIGKILL of the replica owning the in-flight request, a SIGSTOP wedge
+#: (partitioned-but-alive: the OS keeps its sockets, its beat seq
+#: freezes — the fencing case), and a router kill (control-plane
+#: outage: the data path must ride the last advertised membership).
+PROC_EPISODES = ("kill_replica", "stop_replica", "kill_router")
+
+
+@dataclass(frozen=True)
+class ProcEpisode:
+    """One process-storm episode: submit a request sized to outlive
+    failure detection, inject the fault ``fault_at`` seconds later,
+    assert the oracle-exact exactly-once reply, heal."""
+
+    kind: str           # see PROC_EPISODES
+    fault_at: float     # seconds after the episode's submit
+    max_nonce: int      # request size (must outlive the detection window)
+    tenant: str         # ring key (also the request data)
+
+
+def generate_proc_storm(seed: int, episodes: int,
+                        kinds: Sequence[str] = PROC_EPISODES,
+                        nonce_range=(600_000, 1_200_000),
+                        ) -> List[ProcEpisode]:
+    """Deterministic process-storm schedule: same seed, same storm."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(episodes):
+        out.append(ProcEpisode(
+            kind=rng.choice(list(kinds)),
+            fault_at=round(rng.uniform(0.05, 0.3), 3),
+            max_nonce=rng.randrange(*nonce_range),
+            tenant=f"storm{seed}#{i}"))
+    return out
+
+
+async def run_proc_episode(cluster, ep: ProcEpisode, params,
+                           retry=None, reply_timeout_s: float = 60.0,
+                           ) -> dict:
+    """Execute one :class:`ProcEpisode` against a live
+    :class:`~..apps.procs.ProcCluster` and HEAL afterwards (respawn the
+    killed/fenced replica or router, wait for re-admission), so
+    episodes compose into an arbitrarily long storm.
+
+    The fault is raw signal injection; DETECTION is entirely the
+    router's missed-beat watch — no kill hook exists anywhere in the
+    process topology. Returns a record dict (kind, victim, elapsed,
+    reply) after asserting the reply arrived exactly once (the retry
+    plane's one-conn-at-a-time contract) and ORACLE-EXACT.
+    """
+    import time as _time
+    from ..apps.client import submit_with_retry
+    from ..apps.procs import resolve_owner
+    from ..bitcoin.hash import scan_min
+    from ..utils.config import RetryParams
+    retry = retry or RetryParams(attempts=24, timeout_s=3.0,
+                                 backoff_s=0.2, backoff_cap_s=1.0)
+    owner = resolve_owner(cluster.statedir, ep.tenant)
+    assert owner is not None, "no advertised ring before the episode"
+    rid = owner[0]
+    t0 = _time.monotonic()
+    task = asyncio.create_task(submit_with_retry(
+        f"ring:{cluster.statedir}", ep.tenant, ep.max_nonce, 0, params,
+        retry))
+    await asyncio.sleep(ep.fault_at)
+    victim = f"replica{rid}" if ep.kind != "kill_router" else "router"
+    if ep.kind == "kill_replica":
+        cluster.kill_replica(rid)
+    elif ep.kind == "stop_replica":
+        cluster.stop_replica(rid)
+    else:
+        cluster.kill_router()
+    got = await asyncio.wait_for(task, reply_timeout_s)
+    want = scan_min(ep.tenant, 0, ep.max_nonce + 1)
+    assert got is not None, f"{ep} never answered"
+    assert got[:2] == want, (ep, got, want)
+    # Heal: bring the topology back to full strength for the next
+    # episode (fenced SIGSTOP victims are woken first so they can
+    # observe the fence and exit for respawn).
+    fenced_exit = None
+    if ep.kind == "stop_replica":
+        cluster.cont_replica(rid)
+        deadline = _time.monotonic() + 20.0
+        proc = cluster.procs.get(victim)
+        while proc is not None and proc.poll() is None \
+                and _time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        fenced_exit = proc.poll() if proc is not None else None
+    if ep.kind == "kill_router":
+        cluster.respawn_router()
+    else:
+        cluster.spawn_replica(rid)
+    return {"kind": ep.kind, "victim": victim, "reply": got,
+            "fenced_exit": fenced_exit,
+            "elapsed_s": round(_time.monotonic() - t0, 3)}
+
+
 async def run_schedule(schedule: Sequence[ChaosEvent],
                        miners: Dict[str, "ChaosMiner"]) -> int:
     """Apply ``schedule`` on the event-loop clock; heal everything after.

@@ -282,6 +282,48 @@ Environment variables:
   today's allocate-every-trace behavior (tier-1 matrix leg pin); the
   10k-tenant load harness runs at ~0.01 so tracing stays on without
   being the bottleneck.
+- ``DBM_QOS_LAZY`` (default 1): lazy ring-ordered DRR candidate walk
+  (ISSUE 12; apps/qos.QosPlane.pick_lazy + apps/scheduler.
+  _qos_pump_lazy). The stock pump rebuilds an O(backlogged-tenants)
+  candidate map and re-syncs the DRR ring before EVERY grant — the
+  per-completion scan behind the single-replica superlinear tail at
+  10k tenants (BENCH_r06). With the lazy walk, ring membership is
+  maintained at the edges (enqueue hook, chunked activation, lazy
+  removal during the walk) and each visited tenant's head is priced on
+  demand from O(1) per-tenant indexes, with an INCREMENTAL quantum
+  bound (max head cost seen) replacing the per-pick max — grants are
+  O(1) amortized, DRR fairness/starvation guarantees unchanged (grant
+  ORDER may differ from the stock walk; dbmcheck explores the lazy
+  path by default). 0 = the stock walk bit-for-bit (tier-1 matrix
+  leg). Measured (loadharness, 1 replica): 5k tenants 186 -> 1981
+  admitted/s, CPU/request 5.3ms -> 0.5ms.
+- ``DBM_HEALTH_BEAT_S`` (default 0.5) / ``DBM_HEALTH_MISS_K``
+  (default 3): the multi-process replica tier's health plane
+  (apps/health.py + apps/procs.py, ISSUE 12). Every replica process
+  heartbeats a Beat blob (seq, serving bit, miner-slice size, queue
+  depth, epoch seen) to its state-dir beat file every
+  ``DBM_HEALTH_BEAT_S`` seconds; the router declares a replica DEAD —
+  and fences its incarnation at a bumped membership epoch — once its
+  beat seq has been frozen for ``DBM_HEALTH_MISS_K`` beats. Detection
+  is purely seq-based (a SIGSTOPped process's stale file is a death,
+  not a heartbeat).
+- ``DBM_PROC_CACHE`` (default 1): the multi-process tier's replicated
+  result-cache tier (apps/procs.SpoolResultCache): finished results
+  write through to an append-only per-incarnation spool file and every
+  replica ingests its peers' spools on the beat cadence, so a tenant
+  re-hashed after a failover replays answers the dead replica
+  produced; lines written by a FENCED incarnation are dropped at
+  ingest. 0 = per-replica caches only (failover replays degrade to
+  recompute — never to a wrong or duplicate reply either way).
+- ``DBM_TIER1_PROCS`` (0 disables): scripts/tier1.sh's multi-process
+  smoke leg (scripts/procsmoke.py): router + 2 replica processes + 1
+  miner agent on localhost, kill -9 of the replica owning an in-flight
+  request, exactly-once oracle-exact reply asserted with failover
+  driven solely by missed health beats.
+- ``DBM_BENCH_LOAD_PROCS`` (0 disables): ``bench.py detail.load``'s
+  in-process-vs-multi-process comparison leg — 2 in-process replicas
+  vs the real 2-process topology (loadharness ``--procs``) at equal
+  tenant count.
 - ``DBM_TIER1_LOAD`` (0 disables): scripts/tier1.sh's mini-load leg —
   a bounded ~500-tenant storm through the split scheduler on detnet
   (scripts/loadharness.py) gating completion, a generous reply-p99
@@ -580,6 +622,7 @@ class QosParams:
     burst: float = 8.0             # admission bucket capacity
     default_weight: float = 1.0
     weights: tuple = ()            # ((tenant_id_str, weight), ...)
+    lazy: bool = True              # lazy ring walk (DBM_QOS_LAZY)
 
     def __post_init__(self):
         # chunk_s <= 0 pins the wholesale path (the repo-wide 0-disables
@@ -713,6 +756,7 @@ def qos_from_env() -> QosParams:
         default_weight=_float_env("DBM_QOS_WEIGHT_DEFAULT",
                                   d.default_weight),
         weights=tuple(weights),
+        lazy=_int_env("DBM_QOS_LAZY", 1) != 0,
     )
 
 

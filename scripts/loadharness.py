@@ -47,6 +47,13 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queued", type=int, default=4096)
     ap.add_argument("--recv-batch", type=int, default=None)
     ap.add_argument("--trace-sample", type=float, default=None)
+    ap.add_argument("--qos-lazy", type=int, choices=(0, 1), default=None,
+                    help="pin the lazy DRR walk (ISSUE 12 A/B; "
+                         "default: on)")
+    ap.add_argument("--procs", action="store_true",
+                    help="drive the MULTI-PROCESS topology (real LSP "
+                         "sockets, router + replica processes, fake "
+                         "miner agents) instead of in-process detnet")
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--assert-p99", type=float, default=None,
                     help="gate: reply p99 ceiling in seconds")
@@ -54,14 +61,25 @@ def main(argv=None) -> int:
                     help="gate: max process metric series after the run")
     args = ap.parse_args(argv)
 
-    from distributed_bitcoinminer_tpu.apps.loadharness import run_load
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        run_load, run_load_procs)
     before = _series_count()
-    leg = run_load(
-        tenants=args.tenants, replicas=args.replicas, miners=args.miners,
-        requests_per_tenant=args.requests_per_tenant,
-        req_nonces=args.nonces, max_queued=args.max_queued,
-        recv_batch=args.recv_batch, trace_sample=args.trace_sample,
-        timeout_s=args.timeout)
+    if args.procs:
+        leg = run_load_procs(
+            tenants=args.tenants, replicas=args.replicas,
+            miners=args.miners,
+            requests_per_tenant=args.requests_per_tenant,
+            req_nonces=args.nonces, timeout_s=args.timeout)
+    else:
+        leg = run_load(
+            tenants=args.tenants, replicas=args.replicas,
+            miners=args.miners,
+            requests_per_tenant=args.requests_per_tenant,
+            req_nonces=args.nonces, max_queued=args.max_queued,
+            recv_batch=args.recv_batch, trace_sample=args.trace_sample,
+            qos_lazy=(None if args.qos_lazy is None
+                      else bool(args.qos_lazy)),
+            timeout_s=args.timeout)
     after = _series_count()
     leg["metric_series"] = {"before": before, "after": after}
     print(json.dumps(leg, sort_keys=True), flush=True)

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Tier-1 multi-process smoke leg (ISSUE 12; ``DBM_TIER1_PROCS=0``
+skips it in scripts/tier1.sh).
+
+Spawns the REAL process topology on localhost — router + 2 replica
+processes (each with its own LSP socket) + 1 miner agent — drives one
+replica-aware client through ``ring:<statedir>``, then ``kill -9``\\ s
+the replica that owns the in-flight request and asserts the reply still
+arrives EXACTLY ONCE and ORACLE-EXACT, with failover driven solely by
+the router's missed-beat detection (no test-hook kill path exists in
+this topology). Exit 0 on success, 1 on any violation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+async def smoke() -> int:
+    from distributed_bitcoinminer_tpu.apps.client import submit_with_retry
+    from distributed_bitcoinminer_tpu.apps.procs import (ProcCluster,
+                                                         resolve_owner)
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+    from distributed_bitcoinminer_tpu.lsp.params import Params
+    from distributed_bitcoinminer_tpu.utils.config import RetryParams
+
+    statedir = tempfile.mkdtemp(prefix="dbm_procsmoke_")
+    env = {"DBM_HEALTH_BEAT_S": "0.15", "DBM_HEALTH_MISS_K": "3",
+           "DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "4",
+           "DBM_COMPUTE": "host"}
+    params = Params(epoch_limit=4, epoch_millis=200, window_size=8,
+                    max_backoff_interval=2)
+    cluster = ProcCluster(statedir, replicas=2, miners=1, env=env)
+    cluster.start()
+    try:
+        await cluster.wait_live(2, timeout_s=30.0, miners=1)
+        # Warm sanity: one small request end to end.
+        retry = RetryParams(attempts=12, timeout_s=3.0, backoff_s=0.2,
+                            backoff_cap_s=1.0)
+        got = await asyncio.wait_for(submit_with_retry(
+            f"ring:{statedir}", "procsmoke warm", 499, 0, params, retry),
+            40)
+        want = scan_min("procsmoke warm", 0, 500)
+        if got is None or got[:2] != want:
+            print(f"PROCSMOKE: warm request wrong: {got} != {want}",
+                  file=sys.stderr)
+            return 1
+        # The headline: kill -9 the owner mid-request.
+        owner = resolve_owner(statedir, "procsmoke kill")
+        assert owner is not None
+        rid, _ = owner
+        t0 = time.monotonic()
+        task = asyncio.create_task(submit_with_retry(
+            f"ring:{statedir}", "procsmoke kill", 2_500_000, 0, params,
+            RetryParams(attempts=20, timeout_s=3.0, backoff_s=0.2,
+                        backoff_cap_s=1.0)))
+        await asyncio.sleep(0.3)          # the request is in flight
+        if not cluster.kill_replica(rid):
+            print("PROCSMOKE: could not SIGKILL the owner replica",
+                  file=sys.stderr)
+            return 1
+        got = await asyncio.wait_for(task, 90)
+        want = scan_min("procsmoke kill", 0, 2_500_001)
+        if got is None or got[:2] != want:
+            print(f"PROCSMOKE: post-kill reply wrong: {got} != {want}",
+                  file=sys.stderr)
+            return 1
+        m = cluster.membership()
+        if m is None or rid in m.live or rid not in m.fenced:
+            print(f"PROCSMOKE: killed replica never fenced: "
+                  f"{m and m.to_dict()}", file=sys.stderr)
+            return 1
+        print(f"PROCSMOKE: ok — kill -9 of replica {rid} mid-request "
+              f"recovered oracle-exact in {time.monotonic() - t0:.1f}s "
+              f"(membership epoch {m.epoch})", flush=True)
+        return 0
+    finally:
+        cluster.close()
+        shutil.rmtree(statedir, ignore_errors=True)
+
+
+def main() -> int:
+    try:
+        return asyncio.run(asyncio.wait_for(smoke(), 150))
+    except (asyncio.TimeoutError, TimeoutError):
+        print("PROCSMOKE: timed out", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,20 @@ if [ "${DBM_TIER1_LOAD:-1}" != "0" ]; then
     echo "LOAD_LEG_RC=$load_rc"
 fi
 
+# Multi-process smoke leg (ISSUE 12): the REAL process topology on
+# localhost — router + 2 replica processes on their own LSP sockets +
+# 1 miner agent — with a kill -9 of the replica owning an in-flight
+# request; the reply must arrive exactly-once and oracle-exact with
+# failover driven solely by missed health beats (no test-hook kill
+# path exists in the topology). Host-searcher compute, no JAX import.
+# DBM_TIER1_PROCS=0 skips.
+procs_rc=0
+if [ "${DBM_TIER1_PROCS:-1}" != "0" ]; then
+    timeout -k 5 180 python scripts/procsmoke.py
+    procs_rc=$?
+    echo "PROCS_LEG_RC=$procs_rc"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
@@ -118,11 +132,13 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # (stock one-message-per-await recv), DBM_TIMER_WHEEL=0 (per-conn
     # epoch tasks), DBM_TRACE_SAMPLE=1.0 (every request allocates its
     # trace — stock), DBM_REPLICAS=1 (single-scheduler topology), and
-    # the plane-split suite joins the module list.
+    # the plane-split suite joins the module list. ISSUE 12 addition:
+    # DBM_QOS_LAZY=0 pins the STOCK DRR candidate walk (the lazy
+    # ring walk is default-on everywhere else in the gate).
     timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
-        DBM_REPLICAS=1 \
+        DBM_REPLICAS=1 DBM_QOS_LAZY=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
@@ -137,4 +153,5 @@ fi
 [ "$lint_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$lint_rc
 [ "$check_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$check_rc
 [ "$load_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$load_rc
+[ "$procs_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$procs_rc
 exit $rc

@@ -417,3 +417,99 @@ def test_seeded_chaos_schedule_invariants(seed):
             assert c.scheduler.queue == []
             assert c.scheduler.parked == []
     asyncio.run(scenario())
+
+
+# ------------------------------------------ process-level storms (ISSUE 12)
+#
+# The faults here are raw OS signals against REAL processes (router +
+# replica schedulers on their own LSP sockets + a rejoining miner
+# agent); failure detection is SOLELY the router's missed-beat watch —
+# no test-hook kill path exists anywhere in the process topology (the
+# acceptance criterion that separates this tier from PR 11's
+# ReplicaSet.kill()).
+
+PROC_ENV = {"DBM_HEALTH_BEAT_S": "0.15", "DBM_HEALTH_MISS_K": "3",
+            "DBM_EPOCH_MILLIS": "200", "DBM_EPOCH_LIMIT": "4",
+            "DBM_COMPUTE": "host"}
+
+
+def proc_params():
+    return Params(epoch_limit=4, epoch_millis=200, window_size=8,
+                  max_backoff_interval=2)
+
+
+def test_proc_storm_sigkill_twenty_seeds_exactly_once(tmp_path):
+    """THE acceptance storm: >=20 seeded episodes, each SIGKILLing the
+    replica that owns the in-flight request, with failover driven
+    solely by missed health beats. Every request must complete exactly
+    once (the retry plane's one-live-conn contract) and oracle-exact.
+    One topology serves all episodes — each heals before the next."""
+    from distributed_bitcoinminer_tpu.apps.procs import ProcCluster
+    from distributed_bitcoinminer_tpu.lspnet.chaos import (
+        generate_proc_storm, run_proc_episode)
+
+    async def scenario():
+        cluster = ProcCluster(str(tmp_path), replicas=2, miners=1,
+                              env=PROC_ENV)
+        cluster.start()
+        records = []
+        try:
+            await cluster.wait_live(2, timeout_s=30.0, miners=1)
+            for seed in range(20):
+                (ep,) = generate_proc_storm(
+                    seed, 1, kinds=("kill_replica",))
+                assert generate_proc_storm(
+                    seed, 1, kinds=("kill_replica",)) == [ep]  # seeded
+                records.append(await run_proc_episode(
+                    cluster, ep, proc_params()))
+                await cluster.wait_live(2, timeout_s=30.0, miners=1)
+        finally:
+            cluster.close()
+        assert len(records) == 20
+        assert all(r["reply"] is not None for r in records)
+    asyncio.run(scenario())
+
+
+def test_proc_storm_sigstop_fencing_and_router_kill(tmp_path):
+    """The partitioned-but-alive fencing case at PROCESS level, plus a
+    router kill mid-request: a SIGSTOPped serving replica is declared
+    dead by its frozen beat seq, the reply re-routes to the survivor,
+    and on SIGCONT the zombie observes its own fence and exits
+    FENCED_EXIT (its late writes fenced everywhere); a killed router
+    never interrupts the data path — clients ride the last advertised
+    membership — and its restart resumes the SAME fencing epoch."""
+    from distributed_bitcoinminer_tpu.apps.procs import (FENCED_EXIT,
+                                                         ProcCluster)
+    from distributed_bitcoinminer_tpu.lspnet.chaos import (
+        generate_proc_storm, run_proc_episode)
+
+    async def scenario():
+        cluster = ProcCluster(str(tmp_path), replicas=2, miners=2,
+                              env=PROC_ENV)
+        cluster.start()
+        try:
+            await cluster.wait_live(2, timeout_s=30.0, miners=2)
+            epoch_before = cluster.membership().epoch
+            (stop_ep,) = generate_proc_storm(
+                7, 1, kinds=("stop_replica",))
+            rec = await run_proc_episode(cluster, stop_ep, proc_params())
+            # The woken zombie observed its fence and exited for respawn.
+            assert rec["fenced_exit"] == FENCED_EXIT, rec
+            m = cluster.membership()
+            assert m.fenced and m.epoch > epoch_before
+            await cluster.wait_live(2, timeout_s=30.0, miners=2)
+            # Router kill mid-request: reply arrives off the last
+            # membership; the restarted router resumes the epoch.
+            epoch_mid = cluster.membership().epoch
+            (rt_ep,) = generate_proc_storm(11, 1, kinds=("kill_router",))
+            rec2 = await run_proc_episode(cluster, rt_ep, proc_params())
+            assert rec2["reply"] is not None
+            for _ in range(100):
+                m2 = cluster.membership()
+                if m2 is not None and m2.epoch >= epoch_mid:
+                    break
+                await asyncio.sleep(0.1)
+            assert m2.epoch >= epoch_mid     # fencing epoch never regresses
+        finally:
+            cluster.close()
+    asyncio.run(scenario())

@@ -648,3 +648,219 @@ def test_load_harness_sheds_over_capacity():
     # Overload shed fired and the shed tenants saw their conns die.
     assert leg["shed_tenants"] > 0
     assert leg["completed"] + leg["shed_tenants"] >= 60
+
+
+# ------------------------------------- health/membership plane (ISSUE 12)
+
+
+def _beat(rid, seq, inc=None, serving=True, miners=1, port=9000):
+    from distributed_bitcoinminer_tpu.apps.health import Beat
+    return Beat(rid=rid, incarnation=inc or f"i{rid}", seq=seq,
+                port=port, serving=serving, miners=miners)
+
+
+def test_beat_monitor_frozen_seq_is_death():
+    """A stale blob re-read (same seq) is NOT life: only an advancing
+    seq re-anchors the deadline — the SIGSTOP semantics (the frozen
+    process's file keeps existing; its seq keeps not moving)."""
+    from distributed_bitcoinminer_tpu.apps.health import BeatMonitor
+    mon = BeatMonitor(beat_s=0.5, miss_k=3)      # window 1.5s
+    assert mon.observe(_beat(0, 1), now=10.0)
+    assert not mon.observe(_beat(0, 1), now=11.4)  # same seq: no refresh
+    assert mon.dead(11.6) == [0]
+    # An advancing seq refreshes.
+    mon2 = BeatMonitor(beat_s=0.5, miss_k=3)
+    mon2.observe(_beat(0, 1), now=10.0)
+    mon2.observe(_beat(0, 2), now=11.4)
+    assert mon2.dead(11.6) == []
+    # A fresh incarnation counts as an advance even with a lower seq.
+    assert mon2.observe(_beat(0, 1, inc="newinc"), now=12.0)
+
+
+def test_membership_fencing_epoch_and_refused_zombie():
+    """declare_dead bumps the epoch and fences the incarnation; the
+    FENCED incarnation is never re-admitted (the partitioned-but-alive
+    zombie), while a FRESH incarnation of the same rid is."""
+    from distributed_bitcoinminer_tpu.apps.health import Membership
+    m = Membership()
+    assert m.admit(_beat(0, 1)) and m.admit(_beat(1, 1, inc="i1"))
+    e0 = m.epoch
+    assert m.declare_dead(0)
+    assert m.epoch == e0 + 1
+    assert m.is_fenced(0, "i0") and m.writer_fenced(0, "i0")
+    assert 0 not in m.live
+    # The zombie beats again: refused, epoch unchanged.
+    assert not m.admit(_beat(0, 99))
+    assert 0 not in m.live
+    # A fresh incarnation is re-admitted at a new epoch.
+    e1 = m.epoch
+    assert m.admit(_beat(0, 1, inc="i0-reborn"))
+    assert m.epoch == e1 + 1 and m.live[0]["incarnation"] == "i0-reborn"
+    # The OLD incarnation stays fenced; the new one is not.
+    assert m.is_fenced(0, "i0") and not m.is_fenced(0, "i0-reborn")
+    # Round-trips through the published document.
+    m2 = Membership.from_dict(m.to_dict())
+    assert m2.epoch == m.epoch and m2.live == m.live
+    assert m2.is_fenced(0, "i0")
+
+
+def test_router_tick_detects_death_and_graceful_leave():
+    from distributed_bitcoinminer_tpu.apps.health import (BeatMonitor,
+                                                          RouterState,
+                                                          router_tick)
+    state = RouterState(BeatMonitor(beat_s=0.2, miss_k=2))  # window .4s
+    assert router_tick(state, [_beat(0, 1), _beat(1, 1, inc="i1")], 0.0)
+    assert sorted(state.membership.live) == [0, 1]
+    # Replica 0's seq freezes; 1 keeps beating.
+    assert not router_tick(state, [_beat(0, 1),
+                                   _beat(1, 2, inc="i1")], 0.3)
+    assert router_tick(state, [_beat(0, 1), _beat(1, 3, inc="i1")], 0.5)
+    assert sorted(state.membership.live) == [1]
+    assert state.membership.is_fenced(0, "i0")
+    # Graceful leave: serving=False with an advancing seq fences NOW.
+    assert router_tick(state, [_beat(1, 4, inc="i1", serving=False)], 0.6)
+    assert state.membership.live == {}
+    assert state.membership.is_fenced(1, "i1")
+
+
+def test_spool_cache_write_through_ingest_and_fence_drop(tmp_path):
+    """The replicated cache tier: write-through spooling, peer ingest,
+    the FENCED-writer drop (a declared-dead replica's cache writes must
+    not propagate — unit for the ISSUE 12 fencing satellite), and
+    torn-tail-line tolerance."""
+    from distributed_bitcoinminer_tpu.apps.health import Membership
+    from distributed_bitcoinminer_tpu.apps.procs import SpoolResultCache
+    d = str(tmp_path)
+    a = SpoolResultCache(16, d, 0, "incA")
+    b = SpoolResultCache(16, d, 1, "incB")
+    a.put(("k", 0, 9, 0), (111, 4))
+    assert a.spooled == 1
+    m = Membership()
+    m.admit(_beat(0, 1, inc="incA"))
+    m.admit(_beat(1, 1, inc="incB"))
+    assert b.ingest(m) == 1
+    assert b.get(("k", 0, 9, 0)) == (111, 4)
+    # Ingest is incremental: nothing new, nothing read.
+    assert b.ingest(m) == 0
+    # Fence replica 0: its LATER writes are dropped at ingest.
+    m.declare_dead(0)
+    a.put(("k2", 0, 9, 0), (222, 5))
+    assert b.ingest(m) == 0 and b.dropped_fenced == 1
+    assert b.get(("k2", 0, 9, 0)) is None       # miss -> recompute
+    # Torn tail line: unconsumed until the newline lands, then folded.
+    c = SpoolResultCache(16, d, 2, "incC")
+    import json as _json
+    with open(c._spool, "a", encoding="utf-8") as fh:
+        fh.write(_json.dumps({"rid": 2, "inc": "incC",
+                              "key": ["t", 0, 5, 0],
+                              "h": 7, "n": 1})[:10])   # torn, no newline
+    assert b.ingest(m) == 0
+    with open(c._spool, "w", encoding="utf-8") as fh:
+        fh.write(_json.dumps({"rid": 2, "inc": "incC",
+                              "key": ["t", 0, 5, 0],
+                              "h": 7, "n": 1}) + "\n")
+    assert b.ingest(m) == 1
+    assert b.get(("t", 0, 5, 0)) == (7, 1)
+
+
+def test_resolve_owner_serving_rule(tmp_path):
+    """The client-side ring spans SERVING replicas (live + miners in
+    the live incarnation's beat); with no miners anywhere it falls back
+    to the FIRST live replica — where the agent's thinnest-slice rule
+    lands the first JOIN."""
+    from distributed_bitcoinminer_tpu.apps.health import Membership
+    from distributed_bitcoinminer_tpu.apps.procs import (
+        beat_path, membership_path, resolve_owner, write_json_atomic)
+    d = str(tmp_path)
+    assert resolve_owner(d, "k") is None          # no membership yet
+    m = Membership()
+    m.admit(_beat(0, 1, inc="i0", port=7000))
+    m.admit(_beat(1, 1, inc="i1", port=7001))
+    write_json_atomic(membership_path(d), m.to_dict())
+    write_json_atomic(beat_path(d, 0),
+                      _beat(0, 5, inc="i0", miners=0, port=7000)
+                      .to_dict())
+    write_json_atomic(beat_path(d, 1),
+                      _beat(1, 5, inc="i1", miners=0, port=7001)
+                      .to_dict())
+    # No miners anywhere: every key lands on the FIRST live replica.
+    for key in ("a", "b", "c"):
+        assert resolve_owner(d, key) == (0, "127.0.0.1:7000")
+    # Only replica 1 holds miners: every key lands there.
+    write_json_atomic(beat_path(d, 1),
+                      _beat(1, 6, inc="i1", miners=2, port=7001)
+                      .to_dict())
+    for key in ("a", "b", "c"):
+        assert resolve_owner(d, key) == (1, "127.0.0.1:7001")
+    # A STALE incarnation's beat never vouches for the live one.
+    write_json_atomic(beat_path(d, 0),
+                      _beat(0, 9, inc="ghost", miners=8, port=7000)
+                      .to_dict())
+    for key in ("a", "b", "c"):
+        assert resolve_owner(d, key) == (1, "127.0.0.1:7001")
+    # Both serving: the ring splits keys across both replicas.
+    write_json_atomic(beat_path(d, 0),
+                      _beat(0, 10, inc="i0", miners=1, port=7000)
+                      .to_dict())
+    owners = {resolve_owner(d, f"key{i}")[0] for i in range(64)}
+    assert owners == {0, 1}
+
+
+def test_lazy_hook_seeds_existing_backlog_on_reconfigure():
+    """Code review (ISSUE 12): enabling the lazy walk on a LIVE
+    scheduler must seed the ring from the backlog that already exists —
+    the enqueue hook only fires on future arrivals, so without the seed
+    a request queued before the reconfigure would never be granted."""
+    from distributed_bitcoinminer_tpu.bitcoin.message import new_request
+    from tests.test_qos import FakeServer, pop_next
+    server = FakeServer()
+    sched = Scheduler(server, lease=LeaseParams(queue_alarm_s=0.0),
+                      qos=QosParams(enabled=False))
+    sched._on_join(MINER_A)
+    # Queue a second tenant's request behind an in-flight one (stock
+    # FIFO: one in flight at a time).
+    sched._on_request(CLIENT_X, new_request("infl", 0, 49))
+    sched._on_request(CLIENT_X + 1, new_request("queued", 0, 49))
+    assert len(sched.queue) == 1
+    sched.qos = QosParams(enabled=True, lazy=True)
+    assert CLIENT_X + 1 in sched.qos_plane._in_ring    # seeded
+    for _ in range(4):
+        pop_next(sched)
+    assert len(sched.queue) == 0
+    assert len(server.sent_to(CLIENT_X + 1, MsgType.RESULT)) == 1
+
+
+def test_spool_rotation_and_fenced_gc(tmp_path):
+    """Code review (ISSUE 12): the spool is disk-bounded — it rotates
+    (old file unlinked) after ROTATE_FACTOR*size lines — and a fenced
+    incarnation's leftover spools (rotated names included) are removed
+    by the router's GC; ingest prunes offsets of vanished files."""
+    import os
+    from distributed_bitcoinminer_tpu.apps.health import Membership
+    from distributed_bitcoinminer_tpu.apps.procs import (
+        SpoolResultCache, gc_fenced_spools)
+    d = str(tmp_path)
+    a = SpoolResultCache(4, d, 0, "incA")
+    a._rotate_at = 5                   # tighten the bound for the test
+    first_spool = a._spool
+    b = SpoolResultCache(16, d, 1, "incB")
+    m = Membership()
+    m.admit(_beat(0, 1, inc="incA"))
+    m.admit(_beat(1, 1, inc="incB"))
+    for i in range(7):
+        a.put((f"k{i}", 0, 9, 0), (100 + i, i))
+        b.ingest(m)
+    # Rotation happened: the first spool is gone, a .1 spool exists.
+    assert not os.path.exists(first_spool)
+    assert a._spool.endswith(".1.spool") and os.path.exists(a._spool)
+    assert a._spool_lines == 7 - 5
+    # The consumer's offset entry for the unlinked file was pruned.
+    assert os.path.basename(first_spool) not in b._offsets
+    # Fence incarnation A: the router GC removes its remaining spools.
+    m.declare_dead(0)
+    assert gc_fenced_spools(d, m) == 1
+    assert not any(n.startswith("cache_0_") for n in os.listdir(d))
+    # B's own spool (live incarnation) survives.
+    b.put(("own", 0, 9, 0), (9, 9))
+    assert gc_fenced_spools(d, m) == 0
+    assert any(n.startswith("cache_1_") for n in os.listdir(d))

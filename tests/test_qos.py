@@ -605,3 +605,123 @@ def test_chaos_storm_wedged_miner_exactly_once(seed):
             await server.close()
 
     asyncio.run(scenario())
+
+
+# ------------------------------------------------- lazy DRR walk (ISSUE 12)
+
+
+def test_pick_lazy_share_proportional_to_weight():
+    """The lazy ring walk preserves the DRR share invariant: with a
+    constant backlog and an incremental quantum, sustained grant share
+    still converges to the weight ratio."""
+    plane = QosPlane(Registry())
+    weights = {TEN_X: 1.0, TEN_Y: 2.0, TEN_Z: 4.0}
+    for t, w in weights.items():
+        plane.tenant(t, weight=w)
+        plane.backlog_enter(t)
+    counts = {t: 0 for t in weights}
+    for _ in range(700):
+        t = plane.pick_lazy(lambda tenant: 100)
+        assert t is not None
+        plane.on_grant(t, 100)
+        plane.on_chunk_answered(t)
+        counts[t] += 1
+    total_w = sum(weights.values())
+    for t, w in weights.items():
+        assert counts[t] / 700 == pytest.approx(w / total_w, abs=0.05), \
+            (t, counts)
+
+
+def test_pick_lazy_removes_idle_and_zeroes_reentry_deficit():
+    """LAZY_REMOVE drops a no-backlog tenant from the ring on the spot,
+    forfeiting its deficit; re-entry via backlog_enter starts from zero
+    (idle-banks-no-credit at both edges, the sync_backlog rule applied
+    lazily)."""
+    from distributed_bitcoinminer_tpu.apps.qos import LAZY_REMOVE
+    plane = QosPlane(Registry())
+    for t in (TEN_X, TEN_Y):
+        plane.tenant(t)
+        plane.backlog_enter(t)
+    plane.tenants[TEN_X].deficit = 500.0
+
+    def head(tenant):
+        return LAZY_REMOVE if tenant == TEN_X else 50
+
+    got = plane.pick_lazy(head)
+    assert got == TEN_Y
+    assert TEN_X not in plane._in_ring and list(plane.ring) == [TEN_Y]
+    assert plane.tenants[TEN_X].deficit == 0.0
+    # Re-entry starts fresh even if deficit was scribbled meanwhile.
+    plane.tenants[TEN_X].deficit = 75.0
+    plane.backlog_enter(TEN_X)
+    assert plane.tenants[TEN_X].deficit == 0.0
+    # A continuing member keeps its earned deficit.
+    earned = plane.tenants[TEN_Y].deficit
+    plane.backlog_enter(TEN_Y)
+    assert plane.tenants[TEN_Y].deficit == earned
+
+
+def test_pick_lazy_incremental_quantum_unblocks_expensive_head():
+    """The incremental quantum bound: once an expensive head has been
+    priced, the per-cycle top-up is large enough that its tenant is
+    granted within ceil(1/weight) cycles — no starvation of big-chunk
+    tenants behind cheap ones."""
+    plane = QosPlane(Registry())
+    plane.tenant(TEN_X, weight=1.0)
+    plane.tenant(TEN_Y, weight=1.0)
+    plane.backlog_enter(TEN_X)
+    plane.backlog_enter(TEN_Y)
+    costs = {TEN_X: 10, TEN_Y: 10_000}
+    granted = []
+    for _ in range(40):
+        t = plane.pick_lazy(lambda tenant: costs[tenant])
+        assert t is not None
+        plane.on_grant(t, costs[t])
+        granted.append(t)
+        if granted.count(TEN_Y) >= 2:
+            break
+    assert granted.count(TEN_Y) >= 2, granted
+
+
+def test_lazy_pump_matches_stock_walk_replies():
+    """Knob A/B (DBM_QOS_LAZY): the lazy pump and the stock walk serve
+    the same mixed elephant+mice storm to the same replies per tenant
+    (grant ORDER may differ; merges and exactly-once may not)."""
+    def drive(lazy):
+        sched, server = make_sched(
+            qos=chunky_qos(lazy=lazy,
+                           weights=((str(TEN_X), 1.0),
+                                    (str(TEN_Y), 2.0))))
+        sched._on_join(MINER_A)
+        sched._on_join(MINER_B)
+        pin_rate(sched)
+        sched._on_request(TEN_X, new_request("el-x", 0, 9999))
+        sched._on_request(TEN_Y, new_request("el-y", 0, 7999))
+        sched._on_request(TEN_Z, new_request("mouse", 0, 49))
+        for _ in range(500):
+            if pop_next(sched) is None:
+                break
+        return {t: [(m.hash, m.nonce)
+                    for m in server.sent_to(t, MsgType.RESULT)]
+                for t in (TEN_X, TEN_Y, TEN_Z)}
+
+    lazy, stock = drive(True), drive(False)
+    assert lazy == stock
+    for t in (TEN_X, TEN_Y, TEN_Z):
+        assert len(lazy[t]) == 1, (t, lazy)
+
+
+def test_lazy_pump_grants_via_direct_enqueue_injection():
+    """Ring membership must track EVERY enqueue path: a request injected
+    via tenant_plane.enqueue (the driver idiom) still enters the lazy
+    ring through the backlog hook and is granted."""
+    from distributed_bitcoinminer_tpu.apps.scheduler import Request
+    sched, server = make_sched(qos=chunky_qos())
+    sched._on_join(MINER_A)
+    req = Request(conn_id=TEN_X, data="inject", lower=0, upper=49)
+    sched.tenant_plane.enqueue(req)
+    sched._maybe_dispatch()
+    pop_next(sched)
+    assert [(m.hash, m.nonce)
+            for m in server.sent_to(TEN_X, MsgType.RESULT)] \
+        == [(1_000_000, 0)]
