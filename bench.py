@@ -843,6 +843,33 @@ def _load_probe() -> dict:
     }
 
 
+def _adapt_probe() -> dict:
+    """Self-tuning control plane A/B (ISSUE 13, ``detail.adapt``): the
+    three adversarial load-harness workloads — mice stampede, tenant
+    churn storm, elephant convoy (``apps/loadharness.WORKLOADS``) —
+    each run with the STATIC knob defaults every deployment would ship
+    vs the ``DBM_ADAPT`` setpoint controllers, on the socket-free
+    detnet transport with RATE-LIMITED fake miners (known service
+    capacity; the control plane and its controllers are the only
+    things measured). Legs are interleaved order-swapped per round and
+    median-aggregated, the repo's storm-probe noise discipline.
+
+    Acceptance shape (ISSUE 13): adaptive beats static on >= 2 of the
+    3 workloads (p99 at equal admitted/s — congestion admission trades
+    a bounded shed for queue-age control — or admitted/s at equal
+    shed), is within noise on the rest, and the elephant-convoy
+    completion regression bound (makespan_ratio <= 1.10) holds.
+
+    ``DBM_BENCH_ADAPT=0`` skips; ``DBM_BENCH_ADAPT_ROUNDS`` (default
+    3) sets the rounds per workload.
+    """
+    from distributed_bitcoinminer_tpu.apps.loadharness import \
+        adversarial_ab
+
+    rounds = max(1, _int_env("DBM_BENCH_ADAPT_ROUNDS", 3))
+    return adversarial_ab(rounds=rounds)
+
+
 def main() -> int:
     from distributed_bitcoinminer_tpu.utils.config import probe_backend
     from distributed_bitcoinminer_tpu.utils.metrics import ensure_emitter
@@ -1133,6 +1160,17 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             load_detail = {"load": {"error": repr(exc)[:300]}}
 
+    # Self-tuning control plane A/B (ISSUE 13): the three adversarial
+    # workloads static-vs-adaptive on detnet with rate-limited instant
+    # miners — no JAX compute involved, so it runs on any box.
+    # DBM_BENCH_ADAPT=0 skips it.
+    adapt_detail = {}
+    if _str_env("DBM_BENCH_ADAPT", "1") != "0":
+        try:
+            adapt_detail = {"adapt": _adapt_probe()}
+        except Exception as exc:  # noqa: BLE001
+            adapt_detail = {"adapt": {"error": repr(exc)[:300]}}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
@@ -1165,6 +1203,7 @@ def main() -> int:
         **qos_detail,
         **batch_detail,
         **load_detail,
+        **adapt_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
         # (midstate cache behavior, until-tier degradations) stay

@@ -49,16 +49,21 @@ def _fork(rng: random.Random) -> random.Random:
 
 def _make_sched(ctx: Ctx, lease: LeaseParams, qos: QosParams,
                 stripe: StripeParams = None,
-                coalesce: CoalesceParams = None) -> Scheduler:
-    # clock=ctx.loop.time: the admission buckets must tick on the
-    # VIRTUAL clock (they capture their clock at construction, before
-    # the time.monotonic patch could reach them).
+                coalesce: CoalesceParams = None,
+                adapt=None) -> Scheduler:
+    # clock=ctx.loop.time: the admission buckets — and the ISSUE 13
+    # adapt controllers — must tick on the VIRTUAL clock (they capture
+    # their clock at construction, before the time.monotonic patch
+    # could reach them).
+    from ...utils.config import AdaptParams
     sched = Scheduler(
         ctx.server, lease=lease, cache=CacheParams(),
         stripe=stripe if stripe is not None
         else StripeParams(enabled=False), qos=qos,
         coalesce=coalesce if coalesce is not None
-        else CoalesceParams(enabled=False), clock=ctx.loop.time)
+        else CoalesceParams(enabled=False),
+        adapt=adapt if adapt is not None
+        else AdaptParams(enabled=False), clock=ctx.loop.time)
     ctx.sched = sched
     ctx.spawn(sched.run())
     return sched
@@ -593,6 +598,108 @@ class ReplicaTakeover(Scenario):
         return out
 
 
+# -------------------------------------------------------- adaptive_control
+
+class AdaptiveControl(Scenario):
+    """The self-tuning control plane (ISSUE 13) under the explorer: a
+    REAL scheduler with the chunk/window/admission controllers mounted
+    on the VIRTUAL clock, a chunked elephant + mice trains, and miners
+    whose service rate DRIFTS mid-schedule (a seed-drawn step change —
+    the adversarial input a static knob cannot follow). Invariants on
+    top of the generic pack: every controller value stays inside its
+    hard floor/ceiling at every recorded point, and no post-transient
+    oscillation exceeds a bounded peak/trough amplitude
+    (:func:`~....apps.adapt.oscillation_ratio`) — an unstable loop
+    (self-amplifying sawtooth, limit cycle wider than one
+    multiplicative step + dead-band) fails here; starvation fails the
+    generic liveness/reply pack."""
+
+    name = "adaptive_control"
+
+    #: Peak/trough bound per post-transient swing: one multiplicative
+    #: step (x2 at mul=0.5) compounded with the dead-band and one
+    #: ratio-capped probe, doubled for headroom. ONE swing over the
+    #: bound is tolerated per history — a congestion episode (anchored
+    #: multiplicative descent + the recovery ramp back toward open) is
+    #: exactly that shape — but TWO is a limit cycle: a loop swinging
+    #: wide repeatedly is fighting its own measurement, which is what
+    #: this scenario exists to catch (and did: the pre-settle-tick
+    #: chunk controller's EWMA-lag cascade produced wide swings in
+    #: BOTH directions).
+    AMPLITUDE_BOUND = 5.0
+
+    def build(self, ctx: Ctx) -> None:
+        from ...utils.config import AdaptParams
+        rng = ctx.rng
+        adapt = AdaptParams(
+            enabled=True, tick_s=0.2, band=0.25,
+            force_s=rng.choice((0.3, 0.5)),
+            rate0=rng.choice((0.0, 20.0)))
+        _make_sched(ctx, lease=LeaseParams(
+            grace_s=5.0, factor=4.0, floor_s=2.0, tick_s=0.1,
+            queue_alarm_s=30.0), qos=QosParams(
+            enabled=True, chunk_s=0.2, max_chunks=32, depth=2,
+            wholesale_s=0.5, max_queued=rng.choice((4, 6))),
+            coalesce=CoalesceParams(enabled=True,
+                                    lanes=rng.choice((3, 4)),
+                                    small_s=0.25),
+            adapt=adapt)
+        # Miners whose rate steps mid-schedule: the drift the
+        # controllers exist to track. The mutable cell is flipped by a
+        # timer at a seed-drawn virtual time.
+        self.rate_cell = {"rate": 1000.0}
+        drift_at = rng.uniform(0.8, 2.0)
+        drift_to = rng.choice((400.0, 2500.0))
+
+        async def drift():
+            await asyncio.sleep(drift_at)
+            self.rate_cell["rate"] = drift_to
+        ctx.spawn(drift())
+        for i in range(2):
+            ctx.add_miner(
+                f"m{i}",
+                delay_fn=lambda size, r=_fork(rng), cell=self.rate_cell:
+                    size / cell["rate"] * r.uniform(0.8, 1.2))
+        ctx.spawn(_warm_rates(ctx, 2, 1000.0))
+        # Elephant (chunked at the warmed 2x1000 nps pool) + mice
+        # trains — the population whose interleavings drive every
+        # controller: chunk pops feed the sizing loop, small arrivals
+        # the window loop, queue age the admission loop.
+        ctx.add_client("elephant", [
+            Req(rng.choice(_DATA), 0, 1999, pre_delay=0.5)])
+        for t, n in (("mice_a", rng.choice((2, 3))), ("mice_b", 2)):
+            reqs = [Req(f"{rng.choice(_DATA)}#{t}{j}", 0,
+                        rng.choice((99, 149)),
+                        pre_delay=0.5 + rng.uniform(0.0, 1.5))
+                    for j in range(n)]
+            ctx.add_client(t, reqs)
+
+    def check(self, ctx: Ctx):
+        from ...apps.adapt import oscillation_ratios
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        plane = ctx.sched.adapt_plane
+        if plane is None:
+            return out + ["adaptive_control ran without an adapt plane"]
+        for name, (floor, ceil, hist) in plane.histories().items():
+            for _t, v in hist:
+                if not (floor - 1e-9 <= v <= ceil + 1e-9):
+                    out.append(
+                        f"adapt {name}: value {v} escaped its clamps "
+                        f"[{floor}, {ceil}]")
+                    break
+            wide = [r for r in oscillation_ratios(hist)
+                    if r > self.AMPLITUDE_BOUND]
+            if len(wide) >= 2:
+                out.append(
+                    f"adapt {name}: {len(wide)} swings exceed the "
+                    f"{self.AMPLITUDE_BOUND}x amplitude bound (worst "
+                    f"{max(wide):.2f}x — limit cycle, not one "
+                    f"congestion episode; history tail "
+                    f"{[round(v, 4) for _t, v in hist[-8:]]})")
+        return out
+
+
 # -------------------------------------------------------- health_takeover
 
 class _ProcView:
@@ -914,6 +1021,7 @@ SCENARIOS = {
     "difficulty_prefix": DifficultyPrefix,
     "plane_split": PlaneSplit,
     "replica_takeover": ReplicaTakeover,
+    "adaptive_control": AdaptiveControl,
     "health_takeover": HealthTakeover,
 }
 

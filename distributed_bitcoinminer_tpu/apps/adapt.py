@@ -1,0 +1,666 @@
+"""Self-tuning control plane: setpoint controllers for the dispatch
+knobs (ISSUE 13, ``DBM_ADAPT``).
+
+Every performance knob in the dispatch plane is a static env var —
+``DBM_QOS_CHUNK_S`` / ``DBM_STRIPE_CHUNK_S`` (seconds of work per
+chunk), ``DBM_COALESCE_SMALL_S`` (the coalescing-window smallness
+bound), ``DBM_QOS_RATE`` (a fixed admission token rate) — yet the spans
+and metrics the control plane already collects (ISSUE 10) measure
+exactly the quantities those knobs should track. A substrate serving
+"millions of users" (PNPCoin's framing, arXiv 2208.12628) cannot ship
+hand-tuned constants per deployment; this module closes the loop with
+three small, clock-injectable setpoint controllers the scheduler mounts
+under one master knob:
+
+- :class:`ChunkSizeController` — drives the QoS grant-chunk seconds
+  AND the stripe-chunk seconds (one value: both knobs mean "seconds of
+  work per dispatch unit") toward a per-chunk FORCE-LATENCY setpoint
+  (``DBM_ADAPT_FORCE_S``), from the per-chunk service time the lease
+  plane already stamps and the miner-side ``force_s`` span when one
+  rides the Result. AIMD with a hysteresis dead-band: additive increase
+  while measured latency sits below the band, multiplicative decrease
+  above it — and an unconditional decrease when the observed
+  LEASE-MARGIN fraction collapses (chunks finishing just under their
+  lease are one stall away from a blow/re-issue storm). Hard
+  floors/ceilings bound the value so chunk-size churn can never walk
+  into recompile-storm territory (the jit-static lint and the
+  CompileObserver police that boundary; the clamps keep the controller
+  out of it by construction).
+- :class:`CoalesceWindowController` — widens the coalescing-window
+  bound (``small_s``) when the SMALL-request arrival rate shows a mouse
+  flood deep enough that a wider window would actually stack rows
+  (arrivals/s x window >= ~2) while queue wait is non-trivial, and
+  COLLAPSES it multiplicatively when the miner-side ``gap_s`` spans
+  show pipeline bubbles (idle executor time means batching is starving
+  the device, not feeding it).
+- :class:`AdmissionController` — congestion-style admission replacing
+  the fixed token rate: a scheduler-wide token bucket whose rate is
+  AIMD-controlled on the QUEUE-AGE SLOPE — additive increase while the
+  oldest queued request's age falls (or the queue is empty), multiplicative
+  decrease while it rises — so the shed rate tracks the pool's ACTUAL
+  service capacity across replica counts instead of a constant. The
+  controller starts OPEN (rate at the ceiling — it never sheds until
+  congestion is observed) unless ``DBM_ADAPT_RATE0`` pins a starting
+  rate. Per-tenant admission buckets (``DBM_QOS_RATE``), when
+  configured, still apply in front for fairness; this bucket is the
+  capacity governor behind them.
+
+Every controller observes only ALREADY-COLLECTED signals (lease
+stamps, Result spans, queue stamps — no new per-nonce instrumentation),
+exposes its value as a gauge (``adapt_chunk_s`` / ``adapt_small_s`` /
+``adapt_admit_rate``) plus per-controller adjustment counters and
+flight-recorder events, and keeps a bounded value HISTORY the dbmcheck
+``adaptive_control`` scenario audits for stability: values clamped to
+their floors/ceilings always, and no REPEATED post-transient swing
+wider than a bounded peak/trough ratio (:func:`oscillation_ratios`) —
+AIMD's sawtooth is bounded by one multiplicative step plus the
+dead-band, one wide swing is a congestion episode riding out a load
+change, and two is a controller fighting its own measurement.
+
+``DBM_ADAPT=0`` (the default for this PR's soak) is bit-for-bit stock:
+the scheduler constructs NO plane and every hook is one ``is None``
+test — pinned by the tier-1 knob-off matrix leg and
+``tests/test_adapt.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils import trace as _tracing
+from ..utils.config import AdaptParams
+from ..utils.metrics import Registry
+from .qos import TokenBucket
+
+__all__ = ["AdaptPlane", "AimdValue", "ChunkSizeController",
+           "CoalesceWindowController", "AdmissionController",
+           "oscillation_ratio", "oscillation_ratios"]
+
+#: Bounded per-controller value history (enough for a whole dbmcheck
+#: schedule or a bench leg at 10 Hz; old entries roll off).
+HISTORY = 512
+
+
+def oscillation_ratios(history) -> List[float]:
+    """Peak/trough ratios of the POST-TRANSIENT swings of one
+    controller's ``[(t, value), ...]`` history.
+
+    The initial monotone run (e.g. the admission controller descending
+    from its open ceiling to the observed capacity) is a transient, not
+    an oscillation — it is skipped up to and including the FIRST
+    direction reversal. After that, every adjacent local-extremum
+    pair's ``hi / lo`` ratio is one swing's amplitude (the history's
+    final value closes the last swing). For a healthy AIMD loop each
+    swing is bounded by ~``(1/mul) * (1 + band)`` — one multiplicative
+    step plus the dead-band the capped probe crosses.
+
+    The stability audit (dbmcheck ``adaptive_control``) tolerates ONE
+    swing over its amplitude bound per history — a congestion episode
+    is exactly that shape (an anchored multiplicative descent, then
+    the recovery ramp back toward open, which this function's endpoint
+    rule counts as the episode's second half) — and fails on TWO: a
+    loop that repeatedly swings wide is fighting its own measurement
+    (limit cycle), not riding out one load change.
+    """
+    values = [v for _t, v in history]
+    if len(values) < 3:
+        return []
+    # Local extrema of the piecewise-monotone value series.
+    extrema: List[float] = []
+    direction = 0
+    for prev, curr in zip(values, values[1:]):
+        if curr == prev:
+            continue
+        d = 1 if curr > prev else -1
+        if direction and d != direction:
+            extrema.append(prev)
+        direction = d
+    extrema.append(values[-1])
+    if len(extrema) < 3:
+        return []           # at most the transient + its end: no swing
+    # extrema[0] ends the initial transient; ratios start after it.
+    out: List[float] = []
+    for a, b in zip(extrema[1:], extrema[2:]):
+        hi, lo = max(a, b), max(min(a, b), 1e-12)
+        out.append(hi / lo)
+    return out
+
+
+def oscillation_ratio(history) -> float:
+    """Worst single post-transient swing amplitude (1.0 when the
+    history has no closed swing) — see :func:`oscillation_ratios`."""
+    return max(oscillation_ratios(history), default=1.0)
+
+
+class _Ewma:
+    """Tiny fixed-alpha EWMA (the metrics registry's EWMA is
+    wall-clock-aware; controllers want a plain sample smoother)."""
+
+    __slots__ = ("alpha", "value")
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self.value: Optional[float] = None
+
+    def observe(self, x: float) -> float:
+        self.value = x if self.value is None else \
+            self.alpha * x + (1 - self.alpha) * self.value
+        return self.value
+
+
+class AimdValue:
+    """One AIMD-governed value with hard floor/ceiling clamps and a
+    bounded ``(t, value)`` history.
+
+    ``increase()`` adds ``max(add, add_frac * value)`` (a bounded
+    proportional probe — pure constant-additive would take minutes to
+    recover a rate that was halved from 10^4) CAPPED at a 2x growth
+    ratio per step: near the floor a constant step is a huge RELATIVE
+    move (0.05 -> 0.30 is 6x — the dbmcheck sweep caught exactly that
+    as an oscillation-amplitude violation), and the cap is what keeps
+    the sawtooth's peak/trough ratio bounded at every value scale.
+    ``decrease()`` multiplies by ``mul``. Both clamp and both record
+    history only when the value actually moved — the clamps are HARD:
+    no sequence of observations can push the value outside
+    ``[floor, ceil]``, which is the no-recompile-storm /
+    no-starvation safety argument.
+    """
+
+    __slots__ = ("value", "floor", "ceil", "add", "add_frac", "mul",
+                 "history", "adjustments", "_clock")
+
+    def __init__(self, value: float, floor: float, ceil: float,
+                 add: float, mul: float = 0.5, add_frac: float = 0.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.floor = floor
+        self.ceil = ceil
+        self.add = add
+        self.add_frac = add_frac
+        self.mul = mul
+        self._clock = clock
+        self.value = min(ceil, max(floor, value))
+        self.adjustments = 0
+        self.history: deque = deque([(clock(), self.value)],
+                                    maxlen=HISTORY)
+
+    def _set(self, v: float) -> bool:
+        v = min(self.ceil, max(self.floor, v))
+        if v == self.value:
+            return False
+        self.value = v
+        self.adjustments += 1
+        self.history.append((self._clock(), v))
+        return True
+
+    def increase(self) -> bool:
+        step = max(self.add, self.add_frac * self.value)
+        return self._set(min(self.value + step, 2.0 * self.value))
+
+    def decrease(self) -> bool:
+        return self._set(self.value * self.mul)
+
+    def decrease_floored(self, floor: Optional[float]) -> bool:
+        """Multiplicative decrease that never lands below ``floor`` —
+        and HOLDS (no change) when the value already sits at or under
+        it: a decrease signal at a value the anchor says is sustainable
+        is backlog drain, not fresh congestion."""
+        if floor is not None and self.value <= floor:
+            return False
+        v = self.value * self.mul
+        if floor is not None:
+            v = max(v, floor)
+        return self._set(v)
+
+
+class ChunkSizeController:
+    """Drive the chunk/stripe seconds-of-work knob toward a per-chunk
+    force-latency setpoint (module docstring, controller 1)."""
+
+    #: Hard clamps on seconds-of-work per chunk. The floor keeps a
+    #: mispriced pool from shattering requests into confetti (and the
+    #: resulting fresh jit signatures from storming the compile cache);
+    #: the ceiling bounds how much work one lease can put at risk.
+    FLOOR_S = 0.05
+    CEIL_S = 10.0
+    #: Additive step per adjustment interval, seconds.
+    ADD_S = 0.25
+    #: Observed lease-margin fraction below which the controller
+    #: decreases REGARDLESS of the latency error: chunks finishing with
+    #: <25% of their lease left are one stall away from a blow.
+    MARGIN_FLOOR = 0.25
+
+    def __init__(self, value: float, setpoint_s: float, band: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.setpoint_s = setpoint_s
+        self.band = band
+        self.aimd = AimdValue(value, self.FLOOR_S, self.CEIL_S,
+                              self.ADD_S, clock=clock)
+        self._latency = _Ewma()
+        self._min_margin: Optional[float] = None
+        self._samples = 0
+        self._settle = False
+
+    def observe(self, service_s: Optional[float],
+                margin_frac: Optional[float],
+                force_s: Optional[float] = None) -> None:
+        """One answered chunk: miner-side ``force_s`` span when it rode
+        the Result, else the scheduler-side service time the lease plane
+        stamped; plus the chunk's remaining-lease fraction."""
+        lat = force_s if force_s is not None else service_s
+        if lat is not None and lat >= 0:
+            self._latency.observe(lat)
+            self._samples += 1
+        if margin_frac is not None:
+            self._min_margin = margin_frac if self._min_margin is None \
+                else min(self._min_margin, margin_frac)
+
+    def tick(self) -> Optional[float]:
+        """One adjustment interval; returns the new value or None.
+
+        After every adjustment the controller takes one SETTLE tick —
+        it drains (and discards) the samples still arriving from
+        chunks granted at the OLD size, and resets the latency EWMA so
+        the next decision measures only post-change chunks. Without
+        this, measurement lag turns one honest decrease into a
+        multiplicative cascade (stale large-chunk samples keep the
+        EWMA above the band for several ticks) followed by the mirror
+        overshoot on the way back up — the exact bounded-amplitude
+        violation the dbmcheck ``adaptive_control`` sweep caught.
+        """
+        if not self._samples:
+            return None
+        lat = self._latency.value
+        margin = self._min_margin
+        self._samples = 0
+        self._min_margin = None
+        if self._settle:
+            self._settle = False
+            self._latency = _Ewma()
+            return None
+        changed = None
+        if (margin is not None and margin < self.MARGIN_FLOOR) or \
+                lat > self.setpoint_s * (1 + self.band):
+            if self.aimd.decrease():
+                changed = self.aimd.value
+        elif lat < self.setpoint_s * (1 - self.band):
+            if self.aimd.increase():
+                changed = self.aimd.value
+        if changed is not None:
+            self._settle = True
+            self._latency = _Ewma()
+        return changed
+
+
+class CoalesceWindowController:
+    """Widen/collapse the coalescing-window smallness bound (module
+    docstring, controller 2)."""
+
+    FLOOR_S = 0.05
+    CEIL_S = 2.0
+    ADD_S = 0.05
+    #: A wider window only helps when it would actually stack rows:
+    #: small arrivals per window >= this many.
+    FLOOD_ROWS = 2.0
+    #: Queue wait (EWMA) below this is an unloaded system — no widening.
+    WAIT_MIN_S = 0.05
+    #: Executor bubbles: a gap EWMA above this fraction of the window
+    #: means batching is starving the device — collapse.
+    GAP_FRAC = 0.5
+
+    def __init__(self, value: float, band: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.band = band
+        self.aimd = AimdValue(value, self.FLOOR_S, self.CEIL_S,
+                              self.ADD_S, clock=clock)
+        self._clock = clock
+        self._small_arrivals = 0
+        self._last_tick = clock()
+        self._wait = _Ewma()
+        self._gap = _Ewma()
+        self._gap_samples = 0
+
+    def observe_arrival(self, small: bool) -> None:
+        if small:
+            self._small_arrivals += 1
+
+    def observe_wait(self, wait_s: float) -> None:
+        if wait_s >= 0:
+            self._wait.observe(wait_s)
+
+    def observe_gap(self, gap_s: float) -> None:
+        # gap_s is "idle executor time before this chunk", UNBOUNDED:
+        # the first chunk after a traffic lull carries the whole lull.
+        # A gap larger than any possible window is a lull, not a
+        # pipeline bubble — batching cannot have caused it, so it must
+        # not feed the collapse signal (one 60s lull would seed the
+        # EWMA at 60 and pin the window to its floor).
+        if 0 <= gap_s <= self.CEIL_S:
+            self._gap.observe(gap_s)
+            self._gap_samples += 1
+
+    def tick(self) -> Optional[float]:
+        now = self._clock()
+        dt = max(1e-9, now - self._last_tick)
+        self._last_tick = now
+        rate = self._small_arrivals / dt
+        self._small_arrivals = 0
+        gap_fresh = self._gap_samples > 0
+        self._gap_samples = 0
+        gap = self._gap.value or 0.0
+        changed = None
+        if gap_fresh and gap > self.GAP_FRAC * self.aimd.value:
+            # Collapse only on FRESH bubble evidence — a stale EWMA
+            # with zero new samples this interval is yesterday's
+            # traffic, and repeatedly acting on it would walk the
+            # window to its floor during exactly the lull before the
+            # next flood.
+            if self.aimd.decrease():
+                changed = self.aimd.value
+        elif (rate * self.aimd.value >= self.FLOOD_ROWS
+                and (self._wait.value or 0.0) >= self.WAIT_MIN_S):
+            if self.aimd.increase():
+                changed = self.aimd.value
+        if changed is not None:
+            self._gap = _Ewma()     # measure the NEW window fresh
+        return changed
+
+
+class AdmissionController:
+    """Congestion-style admission on the queue-age slope (module
+    docstring, controller 3). Owns the scheduler-wide token bucket."""
+
+    RATE_FLOOR = 1.0
+    RATE_CEIL = 1e5
+    #: Additive step (requests/s) and bounded proportional term — see
+    #: AimdValue docstring for why the probe is not purely constant.
+    ADD_RATE = 8.0
+    ADD_FRAC = 0.1
+    #: Multiplicative decrease: gentler than the 0.5 the latency
+    #: controllers use — the feedback here (queue-age jitter) is far
+    #: noisier than a latency EWMA, and halving on every wiggle was
+    #: measured to park the rate ~25% under capacity (utilization
+    #: loss), shedding work an honest controller would have served.
+    MUL = 0.7
+    #: Age-slope dead zone (seconds of age change per tick), and the
+    #: HEALTHY-QUEUE age floor: below it the system is underloaded
+    #: whatever the slope says — keep probing up; only a queue already
+    #: older than this with a RISING age is congestion. The floor is
+    #: also the knee the equilibrium queue age oscillates around, i.e.
+    #: the latency the controller trades for full utilization.
+    SLOPE_EPS = 0.02
+    MIN_AGE_S = 0.3
+    #: Bucket burst as seconds of the controlled rate (an arrival burst
+    #: shorter than this rides through without shedding).
+    BURST_S = 0.25
+
+    #: Service-rate anchors (the capacity signal is the scheduler's own
+    #: ``results_sent`` counter — already collected). The MD result is
+    #: floored at ``SRV_FLOOR_FRAC x`` the measured service rate: under
+    #: sustained overload the HEAD AGE keeps rising through the whole
+    #: drain of an old backlog (its entries arrived faster than the
+    #: pool serves), and an unanchored MD cascade was measured parking
+    #: the rate at ~20% of capacity. The congestion QUEUE BOUND is the
+    #: depth at which the backlog itself costs ~``MIN_AGE_S`` of wait
+    #: (``srv_rate x MIN_AGE_S``, floored at ``QUEUE_MIN``): beyond it
+    #: the OLDEST requests shed through the stock overload path, so a
+    #: descent transient's backlog cannot dominate every later
+    #: request's latency — this depth-at-capacity trim is exactly how
+    #: "shed rate tracks actual service capacity".
+    SRV_FLOOR_FRAC = 0.7
+    QUEUE_MIN = 8
+
+    def __init__(self, rate0: float,
+                 clock: Callable[[], float] = time.monotonic):
+        start = rate0 if rate0 > 0 else self.RATE_CEIL
+        self.aimd = AimdValue(start, self.RATE_FLOOR, self.RATE_CEIL,
+                              self.ADD_RATE, mul=self.MUL,
+                              add_frac=self.ADD_FRAC, clock=clock)
+        self.bucket = TokenBucket(self.aimd.value,
+                                  self._burst(self.aimd.value), clock)
+        self._prev_age: Optional[float] = None
+        self._srv = _Ewma()
+        self._settle = False
+        self.shed = 0
+
+    def _burst(self, rate: float) -> float:
+        return max(8.0, rate * self.BURST_S)
+
+    def admit(self) -> bool:
+        ok = self.bucket.take(1.0)
+        if not ok:
+            self.shed += 1
+        return ok
+
+    def observe_service_rate(self, served_per_s: float) -> None:
+        """One tick's measured completion rate (requests/s)."""
+        if served_per_s >= 0:
+            self._srv.observe(served_per_s)
+
+    def queue_bound(self) -> Optional[int]:
+        """Congestion queue-depth bound (class docstring), or None
+        before any service rate has been observed."""
+        srv = self._srv.value
+        if srv is None or srv <= 0:
+            return None
+        return max(self.QUEUE_MIN, int(srv * self.MIN_AGE_S))
+
+    def tick(self, queue_age_s: float) -> Optional[float]:
+        prev, self._prev_age = self._prev_age, queue_age_s
+        if prev is None:
+            return None
+        if self._settle:
+            # One settle tick after every adjustment: the queue age
+            # needs a tick to respond to the new rate before the slope
+            # means anything (same lag rule as the chunk controller).
+            self._settle = False
+            return None
+        slope = queue_age_s - prev
+        changed = None
+        if queue_age_s < self.MIN_AGE_S or slope < -self.SLOPE_EPS:
+            if self.aimd.increase():
+                changed = self.aimd.value
+        elif slope > self.SLOPE_EPS:
+            srv = self._srv.value
+            floor = srv * self.SRV_FLOOR_FRAC if srv else None
+            if self.aimd.decrease_floored(floor):
+                changed = self.aimd.value
+        if changed is not None:
+            self._settle = True
+            self.bucket.set_rate(changed, self._burst(changed))
+        return changed
+
+
+class AdaptPlane:
+    """The scheduler-mounted bundle of enabled controllers.
+
+    Constructed only when ``AdaptParams.enabled`` — with the knob off
+    the scheduler holds ``None`` and every hook is one attribute test
+    (the bit-for-bit stock contract). The ``clock`` is injectable for
+    dbmcheck's virtual time and the unit tests' scripted series; the
+    initial values are the live param blocks' statics, so an adaptive
+    run STARTS at the static configuration and departs from it only on
+    evidence.
+    """
+
+    def __init__(self, params: AdaptParams, metrics: Registry,
+                 clock: Optional[Callable[[], float]] = None,
+                 *, chunk_s: float = 1.0, small_s: float = 0.25,
+                 trace_on: bool = False):
+        clock = clock if clock is not None else time.monotonic
+        self.params = params
+        self._clock = clock
+        self._trace_on = trace_on
+        self._last_apply = clock()
+        self._served_prev: Optional[int] = None
+        # A statically DISABLED plane (chunk_s/small_s <= 0 is the repo
+        # 0-disables convention) stays disabled: the controllers tune
+        # live knobs, they never re-enable what an operator turned off.
+        self.chunk = (ChunkSizeController(
+            chunk_s, params.force_s, params.band, clock)
+            if params.chunk and chunk_s > 0 else None)
+        self.window = (CoalesceWindowController(
+            small_s, params.band, clock)
+            if params.coalesce and small_s > 0 else None)
+        self.admission = (AdmissionController(params.rate0, clock)
+                          if params.admit else None)
+        # Series exist only for MOUNTED controllers: registering a
+        # gauge creates it in the snapshot, and a permanent
+        # adapt_admit_rate=0.0 for an admission controller that does
+        # not exist reads as "admission fully closed" to an operator.
+        self._g_chunk = self._g_small = self._g_rate = None
+        self._c_adjust: Dict[str, object] = {}
+        self._c_shed = None
+        if self.chunk is not None:
+            self._g_chunk = metrics.gauge("adapt_chunk_s")
+            self._c_adjust["chunk"] = metrics.counter(
+                "adapt_adjust_chunk")
+            self._g_chunk.set(self.chunk.aimd.value)
+        if self.window is not None:
+            self._g_small = metrics.gauge("adapt_small_s")
+            self._c_adjust["window"] = metrics.counter(
+                "adapt_adjust_window")
+            self._g_small.set(self.window.aimd.value)
+        if self.admission is not None:
+            self._g_rate = metrics.gauge("adapt_admit_rate")
+            self._c_adjust["admit"] = metrics.counter(
+                "adapt_adjust_admit")
+            self._c_shed = metrics.counter("adapt_admit_shed")
+            self._g_rate.set(self.admission.aimd.value)
+
+    # ------------------------------------------------------ observations
+
+    def observe_chunk(self, service_s: Optional[float],
+                      margin_frac: Optional[float],
+                      span: Optional[dict] = None,
+                      sized: bool = True) -> None:
+        """One popped chunk: scheduler-side service/margin plus the
+        Result's span extension when it carried one (force_s feeds the
+        chunk controller, gap_s the window controller). Span values are
+        whitelisted numerics exactly like the trace fold.
+
+        ``sized`` marks a chunk whose size was actually DERIVED from
+        the controlled seconds-of-work knob (a chunked-mode grant):
+        only those feed the sizing loop — a mouse's wholesale split is
+        small because the REQUEST is small, and letting its
+        milliseconds-scale latency into the EWMA walked the chunk size
+        to its ceiling under pure mouse traffic, handing the next
+        elephant a transient of maximal chunks (measured in the
+        adversarial A/B). Gap spans feed the window controller from
+        every pop either way."""
+        force_s = gap_s = None
+        if isinstance(span, dict):
+            v = span.get("force_s")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                force_s = float(v)
+            v = span.get("gap_s")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                gap_s = float(v)
+        if self.chunk is not None and sized:
+            self.chunk.observe(service_s, margin_frac, force_s)
+        if self.window is not None and gap_s is not None:
+            self.window.observe_gap(gap_s)
+
+    def observe_arrival(self, small: bool) -> None:
+        if self.window is not None:
+            self.window.observe_arrival(small)
+
+    def observe_wait(self, wait_s: float) -> None:
+        if self.window is not None:
+            self.window.observe_wait(wait_s)
+
+    def admit(self) -> bool:
+        """Congestion-admission gate at arrival; True when no admission
+        controller is mounted."""
+        if self.admission is None:
+            return True
+        ok = self.admission.admit()
+        if not ok:
+            self._c_shed.inc()
+        return ok
+
+    def effective_max_queued(self, static_bound: int) -> int:
+        """The tighter of the static overload bound and the admission
+        controller's congestion depth (capacity x age knee): what the
+        scheduler's oldest-first overload shed trims to. The static
+        bound's 0-means-unbounded convention is preserved when no
+        congestion bound exists yet."""
+        if self.admission is None:
+            return static_bound
+        bound = self.admission.queue_bound()
+        if bound is None:
+            return static_bound
+        return min(static_bound, bound) if static_bound > 0 else bound
+
+    # ------------------------------------------------------------- ticks
+
+    def tick(self, queue_age_s: float,
+             served_total: Optional[int] = None) -> Dict[str, float]:
+        """One sweep tick: rate-limited to ``params.tick_s``; returns
+        the changed knob values for the scheduler to apply (empty dict
+        = nothing moved). ``served_total`` is the scheduler's
+        cumulative ``results_sent`` counter — the plane differentiates
+        it into the service-rate anchor the admission controller
+        floors itself on."""
+        now = self._clock()
+        if now - self._last_apply < self.params.tick_s:
+            return {}
+        dt = max(1e-9, now - self._last_apply)
+        self._last_apply = now
+        if served_total is not None and self.admission is not None:
+            if self._served_prev is not None:
+                self.admission.observe_service_rate(
+                    (served_total - self._served_prev) / dt)
+            self._served_prev = served_total
+        out: Dict[str, float] = {}
+        if self.chunk is not None:
+            v = self.chunk.tick()
+            if v is not None:
+                out["chunk_s"] = v
+                self._g_chunk.set(v)
+                self._c_adjust["chunk"].inc()
+        if self.window is not None:
+            v = self.window.tick()
+            if v is not None:
+                out["small_s"] = v
+                self._g_small.set(v)
+                self._c_adjust["window"].inc()
+        if self.admission is not None:
+            v = self.admission.tick(queue_age_s)
+            if v is not None:
+                self._g_rate.set(v)
+                self._c_adjust["admit"].inc()
+                out["admit_rate"] = v   # informational: applied in-plane
+        if out and self._trace_on:
+            _tracing.flight("adapt", **{k: round(v, 6)
+                                        for k, v in out.items()})
+        return out
+
+    # ----------------------------------------------------------- queries
+
+    def histories(self) -> Dict[str, Tuple[float, float, list]]:
+        """``{controller: (floor, ceil, [(t, value), ...])}`` — the
+        dbmcheck stability audit's view."""
+        out: Dict[str, Tuple[float, float, list]] = {}
+        for name, ctl in (("chunk", self.chunk), ("window", self.window),
+                          ("admit", self.admission)):
+            if ctl is not None:
+                a = ctl.aimd
+                out[name] = (a.floor, a.ceil, list(a.history))
+        return out
+
+    def state(self) -> dict:
+        """Current values + adjustment counts (bench/harness echo)."""
+        out: dict = {}
+        if self.chunk is not None:
+            out["chunk_s"] = round(self.chunk.aimd.value, 6)
+            out["chunk_adjustments"] = self.chunk.aimd.adjustments
+        if self.window is not None:
+            out["small_s"] = round(self.window.aimd.value, 6)
+            out["window_adjustments"] = self.window.aimd.adjustments
+        if self.admission is not None:
+            out["admit_rate"] = round(self.admission.aimd.value, 3)
+            out["admit_adjustments"] = self.admission.aimd.adjustments
+            out["admit_shed"] = self.admission.shed
+        return out

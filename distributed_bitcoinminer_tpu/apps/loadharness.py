@@ -48,10 +48,12 @@ from ..bitcoin.message import Message, MsgType, new_join, new_request, \
     new_result
 from ..lsp.errors import LspError
 from ..lspnet.detnet import DetServer
-from ..utils.config import CacheParams, LeaseParams, QosParams
+from ..utils.config import AdaptParams, CacheParams, LeaseParams, \
+    QosParams
 from ..utils.trace import SPAN_PHASES
 
-__all__ = ["run_load", "load_curve"]
+__all__ = ["run_load", "load_curve", "run_adversarial",
+           "adversarial_ab", "WORKLOADS"]
 
 #: A 64-bit odd multiplier (splitmix64 finalizer constant): the fake
 #: miner's answer must be a deterministic function of the chunk so
@@ -65,10 +67,14 @@ def _fake_hash(data: str, lower: int) -> int:
     return (hash(data) * _MIX + lower * 0x9E3779B97F4A7C15) & _MASK
 
 
-async def _fake_miner(chan, trace_spans: bool) -> None:
+async def _fake_miner(chan, trace_spans: bool,
+                      rate: float = 0.0) -> None:
     """Instant miner actor: JOIN, then answer every Request with the
     fake hash — attaching a measured (honest, if tiny) span when
-    ``trace_spans``."""
+    ``trace_spans``. ``rate > 0`` makes it a RATE-LIMITED miner
+    (``size / rate`` seconds of 'compute' per chunk, served serially),
+    so the adversarial workloads (ISSUE 13) run against a KNOWN
+    service capacity instead of whatever the box does."""
     chan.write(new_join().to_json())
     while True:
         try:
@@ -79,6 +85,8 @@ async def _fake_miner(chan, trace_spans: bool) -> None:
         msg = Message.from_json(payload)
         if msg.type != MsgType.REQUEST:
             continue
+        if rate > 0:
+            await asyncio.sleep((msg.upper - msg.lower + 1) / rate)
         h = _fake_hash(msg.data, msg.lower)
         span = None
         if trace_spans:
@@ -197,6 +205,252 @@ def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
     return asyncio.run(leg())
 
 
+# --------------------------------------------- adversarial workloads
+
+#: The three adversarial workload generators (ISSUE 13; the first half
+#: of the ROADMAP trace-replay item — synthesized storms with the
+#: shapes measured traffic produces). Arrival is PACED (tenants start
+#: uniformly over ``duration_s``), miners are rate-limited so service
+#: capacity is a known constant, and the flood factors are chosen so
+#: the static control plane is genuinely mis-tuned:
+#:
+#: - ``mice_stampede``: a sustained small-request flood well past pool
+#:   capacity — the static plane queues to ``max_queued`` and serves
+#:   every admitted mouse a queue-depth's worth of latency; adaptive
+#:   admission converges the intake rate to capacity and keeps the
+#:   queue (and p99) near the service floor.
+#: - ``tenant_churn``: the same overload carried by SHORT-LIVED
+#:   tenants (connect, one request, disconnect) — admission + tenant
+#:   GC under maximum state churn.
+#: - ``elephant_convoy``: few tenants submitting chunked elephants
+#:   back-to-back against a rate-limited pool — the chunk-sizing
+#:   controller's territory, and the workload the <=10% completion
+#:   regression bound is checked on.
+WORKLOADS = {
+    "mice_stampede": dict(tenants=1200, duration_s=5.0, nonces=4096,
+                          requests_per_tenant=1, miner_rate=200_000.0,
+                          wholesale_s=5.0, max_queued=256, churn=False,
+                          sequential=False),
+    "tenant_churn": dict(tenants=1200, duration_s=5.0, nonces=4096,
+                         requests_per_tenant=1, miner_rate=200_000.0,
+                         wholesale_s=5.0, max_queued=256, churn=True,
+                         sequential=False),
+    "elephant_convoy": dict(tenants=3, duration_s=0.0, nonces=1 << 21,
+                            requests_per_tenant=2,
+                            miner_rate=1_000_000.0, wholesale_s=0.3,
+                            max_queued=256, churn=False,
+                            sequential=True),
+}
+
+
+async def _paced_tenant(server, name: str, start_s: float, count: int,
+                        nonces: int, latencies: list, sheds: list,
+                        churn: bool, sequential: bool) -> None:
+    """One adversarial-workload tenant: wait for its paced arrival
+    slot, connect, then either storm its requests (stampede/churn) or
+    submit them SEQUENTIALLY (convoy: next elephant only after the
+    previous replied). ``churn`` closes the conn after the last reply
+    (short-lived tenant). A dead conn sheds EVERY still-unanswered
+    request of this tenant (submitted or not — the conn they would
+    ride is gone), and only those: counting already-answered requests
+    too would inflate ``shed_requests`` and quietly lower the
+    completed-plus-shed-covers-everything bar the load gate asserts."""
+    if start_s > 0:
+        await asyncio.sleep(start_s)
+    chan = server.connect()
+    answered = 0
+    try:
+        if sequential:
+            for i in range(count):
+                t0 = time.monotonic()
+                chan.write(new_request(f"{name}#{i}", 0,
+                                       nonces - 1).to_json())
+                while True:
+                    msg = Message.from_json(await chan.read())
+                    if msg.type == MsgType.RESULT:
+                        latencies.append(time.monotonic() - t0)
+                        answered += 1
+                        break
+        else:
+            stamps = []
+            for i in range(count):
+                stamps.append(time.monotonic())
+                chan.write(new_request(f"{name}#{i}", 0,
+                                       nonces - 1).to_json())
+            while answered < count:
+                msg = Message.from_json(await chan.read())
+                if msg.type == MsgType.RESULT:
+                    latencies.append(time.monotonic() - stamps[answered])
+                    answered += 1
+        if churn:
+            await chan.close()
+    except LspError:
+        lost = count - answered
+        if lost > 0:
+            sheds.append(lost)
+
+
+def run_adversarial(workload: str, *, adapt: bool = False,
+                    tenants: Optional[int] = None,
+                    duration_s: Optional[float] = None,
+                    miners: int = 4,
+                    adapt_params: Optional[AdaptParams] = None,
+                    timeout_s: float = 120.0) -> dict:
+    """One adversarial-workload leg (ISSUE 13), static knobs
+    (``adapt=False`` — the defaults every deployment would ship) or
+    the self-tuning controllers (``adapt=True``). Everything else —
+    transport, miners, arrival schedule — is identical between legs,
+    so the A/B isolates the controllers. Returns the ``run_load``
+    measurement shape plus the controllers' final state."""
+    spec = dict(WORKLOADS[workload])
+    n_tenants = tenants if tenants is not None else spec["tenants"]
+    duration = duration_s if duration_s is not None \
+        else spec["duration_s"]
+    # Sheds are the WORKLOAD here, not incidents: muting the per-shed
+    # warning keeps hundreds of log lines from distorting the very leg
+    # that sheds more (and from burying the CLI's JSON output) — the
+    # dbmcheck executor applies the same discipline.
+    import logging
+    dbm_logger = logging.getLogger("dbm")
+
+    async def leg() -> dict:
+        from .scheduler import Scheduler
+        server = DetServer(record=False)
+        # The CONTROLLED knobs stay at their static defaults in both
+        # legs (chunk_s=1.0, small_s=0.25, rate=0) — the adaptive leg
+        # starts there and departs on evidence; workload-shape knobs
+        # (wholesale bound, queue cap, lease cadence) are harness
+        # configuration, identical in both legs.
+        qos = QosParams(enabled=True, wholesale_s=spec["wholesale_s"],
+                        max_queued=spec["max_queued"])
+        lease = LeaseParams(grace_s=120.0, floor_s=60.0, tick_s=0.1,
+                            queue_alarm_s=0.0)
+        ap = adapt_params if adapt_params is not None else AdaptParams(
+            enabled=True, tick_s=0.1)
+        coord = Scheduler(server, lease=lease,
+                          cache=CacheParams(enabled=False), qos=qos,
+                          adapt=ap if adapt
+                          else AdaptParams(enabled=False))
+        coord_task = asyncio.create_task(coord.run())
+        miner_tasks = [asyncio.create_task(
+            _fake_miner(server.connect(), trace_spans=True,
+                        rate=spec["miner_rate"]))
+            for _ in range(miners)]
+        for _ in range(4):
+            await asyncio.sleep(0)
+        latencies: list = []
+        sheds: list = []
+        cpu0 = time.process_time()
+        t0 = time.monotonic()
+        tenant_tasks = [asyncio.create_task(
+            _paced_tenant(server, f"t{t}",
+                          (t / n_tenants) * duration if duration > 0
+                          else 0.0,
+                          spec["requests_per_tenant"], spec["nonces"],
+                          latencies, sheds, spec["churn"],
+                          spec["sequential"]))
+            for t in range(n_tenants)]
+        try:
+            await asyncio.wait_for(asyncio.gather(*tenant_tasks),
+                                   timeout_s)
+            timed_out = False
+        except asyncio.TimeoutError:
+            timed_out = True
+        makespan = time.monotonic() - t0
+        cpu_s = time.process_time() - cpu0
+        for task in tenant_tasks + miner_tasks + [coord_task]:
+            task.cancel()
+        total = n_tenants * spec["requests_per_tenant"]
+        completed = len(latencies)
+        latencies.sort()
+
+        def pct(q: float):
+            if not latencies:
+                return None
+            return round(latencies[min(len(latencies) - 1,
+                                       int(q * len(latencies)))], 4)
+
+        out = {
+            "workload": workload,
+            "adapt": bool(adapt),
+            "tenants": n_tenants,
+            "miners": miners,
+            "requests": total,
+            "completed": completed,
+            "shed_tenants": len(sheds),
+            "shed_requests": sum(sheds),
+            "shed_rate": round(1 - completed / total, 4) if total
+            else 0.0,
+            "makespan_s": round(makespan, 3),
+            "admitted_per_s": round(completed / makespan, 1)
+            if makespan > 0 else None,
+            "p50_s": pct(0.50),
+            "p99_s": pct(0.99),
+            "cpu_s_per_request": round(cpu_s / completed, 6)
+            if completed else None,
+        }
+        if adapt and coord.adapt_plane is not None:
+            out["adapt_state"] = coord.adapt_plane.state()
+        if timed_out:
+            out["timed_out"] = True
+        return out
+
+    prev_level = dbm_logger.level
+    dbm_logger.setLevel(logging.CRITICAL)
+    try:
+        return asyncio.run(leg())
+    finally:
+        dbm_logger.setLevel(prev_level)
+
+
+def adversarial_ab(workloads=None, rounds: int = 3, **kw) -> dict:
+    """The ``detail.adapt`` A/B (ISSUE 13): each adversarial workload
+    run static-vs-adaptive over ``rounds`` interleaved order-swapped
+    rounds (the repo's storm-probe noise discipline), medians reported
+    per leg plus a per-workload comparison summary."""
+    workloads = list(workloads) if workloads is not None \
+        else list(WORKLOADS)
+    out: dict = {"rounds": rounds, "workloads": {}}
+    keys = ("makespan_s", "admitted_per_s", "p50_s", "p99_s",
+            "cpu_s_per_request", "shed_rate")
+    for workload in workloads:
+        legs: dict = {False: [], True: []}
+        for rnd in range(max(1, rounds)):
+            order = (False, True) if rnd % 2 == 0 else (True, False)
+            for flag in order:
+                legs[flag].append(
+                    run_adversarial(workload, adapt=flag, **kw))
+        entry: dict = {}
+        for flag, name in ((False, "static"), (True, "adaptive")):
+            med = {}
+            for key in keys:
+                vals = [leg[key] for leg in legs[flag]
+                        if leg.get(key) is not None]
+                med[key] = round(median(vals), 6) if vals else None
+            med["completed"] = int(median(
+                [leg["completed"] for leg in legs[flag]]))
+            entry[name] = med
+        entry["adapt_state"] = legs[True][-1].get("adapt_state")
+        s, a = entry["static"], entry["adaptive"]
+        cmp: dict = {}
+        if s["p99_s"] and a["p99_s"]:
+            cmp["p99_speedup"] = round(s["p99_s"] / a["p99_s"], 3)
+        if s["admitted_per_s"] and a["admitted_per_s"]:
+            cmp["admitted_ratio"] = round(
+                a["admitted_per_s"] / s["admitted_per_s"], 3)
+        if s["makespan_s"] and a["makespan_s"]:
+            cmp["makespan_ratio"] = round(
+                a["makespan_s"] / s["makespan_s"], 3)
+        entry["compare"] = cmp
+        entry["samples"] = [
+            {k: leg.get(k) for k in
+             ("adapt", "completed", "shed_rate", "makespan_s",
+              "admitted_per_s", "p50_s", "p99_s")}
+            for flag in (False, True) for leg in legs[flag]]
+        out["workloads"][workload] = entry
+    return out
+
+
 def _trace_summary(coord, replicas: int) -> dict:
     """Per-phase medians over the (sampled) traces of a finished leg —
     the same shape as ``bench._Cluster.trace_summary`` so ``detail.load``
@@ -241,9 +495,78 @@ def _children_cpu_s(pids) -> float:
     return total
 
 
+async def _ring_tenant(statedir: str, params, name: str, count: int,
+                       req_nonces: int, latencies: list,
+                       sheds: list) -> None:
+    """One ring-resolving tenant over real UDP (the --procs driver's
+    unit of work, shared by the in-harness driver and the sharded
+    driver subprocesses)."""
+    from ..lsp.client import new_async_client
+    from .procs import resolve_owner
+    owner = resolve_owner(statedir, name)
+    if owner is None:
+        sheds.append(count)
+        return
+    try:
+        client = await new_async_client(owner[1], params)
+    except LspError:
+        sheds.append(count)
+        return
+    stamps = []
+    got = 0
+    try:
+        for i in range(count):
+            stamps.append(time.monotonic())
+            client.write(new_request(f"{name}#{i}", 0,
+                                     req_nonces - 1).to_json())
+        while got < count:
+            msg = Message.from_json(await client.read())
+            if msg.type == MsgType.RESULT:
+                latencies.append(time.monotonic() - stamps[got])
+                got += 1
+    except LspError:
+        # Only the UNANSWERED requests are casualties of the dead conn
+        # (same accounting rule as _paced_tenant: counting answered
+        # ones too would lower the completed+shed-covers-all gate bar).
+        if count - got > 0:
+            sheds.append(count - got)
+    finally:
+        await client.close()
+
+
+def _proc_params():
+    from ..lsp.params import Params
+    return Params(epoch_limit=8, epoch_millis=500, window_size=32,
+                  max_backoff_interval=2)
+
+
+async def drive_ring_tenants(statedir: str, start: int, count: int,
+                             requests_per_tenant: int, req_nonces: int,
+                             timeout_s: float) -> dict:
+    """Drive tenants ``t<start>..t<start+count-1>`` against the ring at
+    ``statedir``; returns ``{"latencies": [...], "sheds": [...]}`` —
+    one DRIVER's share of a (possibly sharded) --procs storm."""
+    params = _proc_params()
+    latencies: list = []
+    sheds: list = []
+    tasks = [asyncio.create_task(
+        _ring_tenant(statedir, params, f"t{start + i}",
+                     requests_per_tenant, req_nonces, latencies, sheds))
+        for i in range(count)]
+    timed_out = False
+    try:
+        await asyncio.wait_for(asyncio.gather(*tasks), timeout_s)
+    except asyncio.TimeoutError:
+        timed_out = True
+    for task in tasks:
+        task.cancel()
+    return {"latencies": latencies, "sheds": sheds,
+            "timed_out": timed_out}
+
+
 def run_load_procs(tenants: int = 200, replicas: int = 2,
                    miners: int = 4, *, requests_per_tenant: int = 1,
-                   req_nonces: int = 256,
+                   req_nonces: int = 256, drivers: int = 1,
                    timeout_s: float = 180.0) -> dict:
     """Multi-process topology leg (ISSUE 12, ``loadharness --procs``):
     the REAL process topology — router + one OS process per replica on
@@ -251,71 +574,53 @@ def run_load_procs(tenants: int = 200, replicas: int = 2,
     by ring-resolving tenants over real localhost UDP, so ``detail.load``
     can compare in-process vs multi-process replicas at equal tenant
     count. The shape of the returned dict matches :func:`run_load`
-    (``cpu_s_per_request`` sums the CHILD processes' CPU from /proc)."""
+    (``cpu_s_per_request`` sums the CHILD processes' CPU from /proc).
+
+    ``drivers > 1`` SHARDS the storm driver itself across that many
+    OS processes (ISSUE 13 satellite): one harness process tops out
+    around O(500) real UDP conns — its own event loop becomes the
+    bottleneck and the measurement — so each driver subprocess
+    (``python -m ...apps.loadharness driver``) runs an equal tenant
+    slice and prints one JSON result line the parent merges. Driver
+    CPU stays out of ``cpu_s_per_request`` exactly like the inline
+    driver's (only cluster children are summed)."""
     import shutil
     import tempfile
 
     async def leg() -> dict:
-        from ..lsp.client import new_async_client
-        from ..lsp.params import Params
-        from .procs import ProcCluster, resolve_owner
+        from .procs import ProcCluster
         statedir = tempfile.mkdtemp(prefix="dbm_loadprocs_")
         env = {"DBM_HEALTH_BEAT_S": "0.25", "DBM_HEALTH_MISS_K": "3",
                "DBM_EPOCH_MILLIS": "500", "DBM_EPOCH_LIMIT": "8",
                "DBM_TRACE_SAMPLE": "0.01"}
-        params = Params(epoch_limit=8, epoch_millis=500, window_size=32,
-                        max_backoff_interval=2)
         cluster = ProcCluster(statedir, replicas=replicas, miners=miners,
                               env=env, fake_miners=True)
         cluster.start()
         latencies: list = []
         sheds: list = []
-
-        async def tenant(name: str, count: int) -> None:
-            owner = resolve_owner(statedir, name)
-            if owner is None:
-                sheds.append(count)
-                return
-            try:
-                client = await new_async_client(owner[1], params)
-            except LspError:
-                sheds.append(count)
-                return
-            stamps = []
-            try:
-                for i in range(count):
-                    stamps.append(time.monotonic())
-                    client.write(new_request(f"{name}#{i}", 0,
-                                             req_nonces - 1).to_json())
-                got = 0
-                while got < count:
-                    msg = Message.from_json(await client.read())
-                    if msg.type == MsgType.RESULT:
-                        latencies.append(time.monotonic() - stamps[got])
-                        got += 1
-            except LspError:
-                sheds.append(len(stamps))
-            finally:
-                await client.close()
-
+        timed_out = False
         try:
             await cluster.wait_live(replicas, timeout_s=30.0,
                                     miners=miners)
             pids = [p.pid for p in cluster.procs.values()]
             cpu0 = _children_cpu_s(pids)
             t0 = time.monotonic()
-            tasks = [asyncio.create_task(
-                tenant(f"t{t}", requests_per_tenant))
-                for t in range(tenants)]
-            try:
-                await asyncio.wait_for(asyncio.gather(*tasks), timeout_s)
-                timed_out = False
-            except asyncio.TimeoutError:
-                timed_out = True
+            if drivers <= 1:
+                out = await drive_ring_tenants(
+                    statedir, 0, tenants, requests_per_tenant,
+                    req_nonces, timeout_s)
+                latencies, sheds = out["latencies"], out["sheds"]
+                timed_out = out["timed_out"]
+            else:
+                shards = await _drive_sharded(
+                    statedir, tenants, drivers, requests_per_tenant,
+                    req_nonces, timeout_s, cluster.env)
+                for out in shards:
+                    latencies.extend(out.get("latencies", []))
+                    sheds.extend(out.get("sheds", []))
+                    timed_out = timed_out or out.get("timed_out", False)
             makespan = time.monotonic() - t0
             cpu_s = _children_cpu_s(pids) - cpu0
-            for task in tasks:
-                task.cancel()
         finally:
             cluster.close()
             shutil.rmtree(statedir, ignore_errors=True)
@@ -331,7 +636,7 @@ def run_load_procs(tenants: int = 200, replicas: int = 2,
 
         out = {
             "tenants": tenants, "replicas": replicas, "miners": miners,
-            "topology": "procs",
+            "topology": "procs", "drivers": max(1, drivers),
             "requests": total, "completed": completed,
             "shed_tenants": len(sheds),
             "shed_rate": round(1 - completed / total, 4) if total
@@ -349,6 +654,76 @@ def run_load_procs(tenants: int = 200, replicas: int = 2,
         return out
 
     return asyncio.run(leg())
+
+
+async def _drive_sharded(statedir: str, tenants: int, drivers: int,
+                         requests_per_tenant: int, req_nonces: int,
+                         timeout_s: float, env: dict) -> list:
+    """Spawn ``drivers`` driver subprocesses over equal tenant slices
+    and collect their JSON result lines (ISSUE 13 satellite — the
+    sharded storm driver). A driver that crashes or prints garbage
+    contributes an empty shard (its tenants count as incomplete, which
+    the gates then fail loudly) rather than wedging the parent."""
+    import json
+    import os
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    per = -(-tenants // max(1, drivers))
+    procs = []
+    for d in range(drivers):
+        start = d * per
+        count = min(per, tenants - start)
+        if count <= 0:
+            break
+        procs.append(await asyncio.create_subprocess_exec(
+            sys.executable, "-m",
+            "distributed_bitcoinminer_tpu.apps.loadharness", "driver",
+            statedir, "--start", str(start), "--count", str(count),
+            "--requests-per-tenant", str(requests_per_tenant),
+            "--nonces", str(req_nonces), "--timeout", str(timeout_s),
+            cwd=repo, env=env, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL))
+    outs = []
+    for proc in procs:
+        try:
+            stdout, _ = await asyncio.wait_for(proc.communicate(),
+                                               timeout_s + 30.0)
+        except asyncio.TimeoutError:
+            proc.kill()
+            outs.append({})
+            continue
+        try:
+            outs.append(json.loads(
+                stdout.decode("utf-8").strip().splitlines()[-1]))
+        except (ValueError, IndexError):
+            outs.append({})
+    return outs
+
+
+def driver_main(argv=None) -> int:
+    """``python -m ...apps.loadharness driver <statedir> ...`` — ONE
+    shard of a sharded --procs storm: drive a tenant slice against the
+    advertised ring and print one JSON line (latencies + sheds) for
+    the parent to merge."""
+    import argparse
+    import json
+    import sys
+    argv = sys.argv[1:] if argv is None else argv
+    ap = argparse.ArgumentParser(prog="loadharness driver")
+    ap.add_argument("role", choices=("driver",))
+    ap.add_argument("statedir")
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--count", type=int, required=True)
+    ap.add_argument("--requests-per-tenant", type=int, default=1)
+    ap.add_argument("--nonces", type=int, default=256)
+    ap.add_argument("--timeout", type=float, default=180.0)
+    args = ap.parse_args(argv)
+    out = asyncio.run(drive_ring_tenants(
+        args.statedir, args.start, args.count,
+        args.requests_per_tenant, args.nonces, args.timeout))
+    print(json.dumps(out), flush=True)
+    return 0
 
 
 def load_curve(points, replica_counts=(1, 4), rounds: int = 2,
@@ -384,3 +759,8 @@ def load_curve(points, replica_counts=(1, 4), rounds: int = 2,
             entry[f"r{n}"] = med
         curve.append(entry)
     return {"points": curve, "samples": samples}
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(driver_main())

@@ -536,6 +536,26 @@ class MinerPlane:
             self._lease_event("quarantine_lifted", chunk, miner.conn_id)
             self._dispatch()
 
+    def service_sample(self, chunk: Chunk):
+        """``(service_s, margin_frac)`` of a JUST-POPPED chunk for the
+        self-tuning plane (ISSUE 13), derived from the lease plane's
+        own stamps — service is elapsed since the lease started (the
+        miner was actually computing it, not FIFO-waiting), margin is
+        the unspent fraction of its lease. ``(None, None)`` when the
+        stamps cannot speak honestly: the lease never started, the
+        chunk blew (its elapsed measures the wedge, not the work), it
+        was cancelled, or leases are off (infinite margin)."""
+        if not chunk.lease_started or chunk.lease_blown \
+                or chunk.cancelled or not chunk.assigned_at:
+            return None, None
+        lease_span = chunk.deadline - chunk.assigned_at
+        if not (lease_span > 0) or lease_span == float("inf"):
+            return None, None
+        now = time.monotonic()
+        service = max(0.0, now - chunk.assigned_at)
+        margin = max(0.0, (chunk.deadline - now) / lease_span)
+        return service, margin
+
     def lease_for(self, miner: MinerState, chunk: Chunk) -> float:
         """Lease duration for assigning ``chunk`` to ``miner``: headroom
         over the EWMA-predicted scan time, clamped below; a flat grace when

@@ -488,7 +488,23 @@ class Router:
 
 class MinerAgent:
     """Replica-aware miner wrapper: join the thinnest live slice, rejoin
-    a survivor when the conn dies (module docstring)."""
+    a survivor when the conn dies — or, FASTER, when the membership
+    fences its owner (module docstring).
+
+    Fence-push (ISSUE 13 satellite): the agent used to discover its
+    owner's death only through LSP epoch detection on its own conn
+    (``epoch_limit x epoch_millis`` — the measured ~0.8 s of rejoin
+    dead air). The router already PUBLISHES the fence in
+    ``membership.json`` one missed-beat window after the death; a
+    watcher task polls the membership at the beat cadence and, the
+    moment the owner rid is gone (or wears a fresh incarnation —
+    either way the conn this agent holds is to a fenced incarnation),
+    closes the worker's transport so ``run()`` returns and the rejoin
+    loop re-picks a survivor immediately. Rejoin dead air drops to
+    ~one beat; epoch detection remains the backstop when the router
+    itself is down (``owner_gone`` returns False on a missing
+    membership — no membership is no evidence).
+    """
 
     def __init__(self, statedir: str, params=None,
                  searcher_factory: Optional[Callable] = None,
@@ -501,35 +517,87 @@ class MinerAgent:
             searcher_factory = lambda d, b: HostSearcher(d)  # noqa: E731
         self.factory = searcher_factory
         self.joins = 0
+        self.fence_pushes = 0
+        self._pushed = False
 
-    def _pick(self) -> Optional[str]:
+    def _pick(self) -> Optional[Tuple[int, str, str]]:
+        """``(rid, incarnation, hostport)`` of the thinnest advertised
+        live slice, or None while no membership is published."""
         m = read_membership(self.statedir)
         if m is None or not m.live:
             return None
         counts = {b.rid: b.miners for b in read_beats(self.statedir)}
         rid = min(sorted(m.live), key=lambda r: counts.get(r, 0))
-        return f"127.0.0.1:{m.live[rid]['port']}"
+        entry = m.live[rid]
+        return rid, entry["incarnation"], \
+            f"127.0.0.1:{entry['port']}"
+
+    @staticmethod
+    def owner_gone(m: Optional[Membership], rid: int,
+                   incarnation: str) -> bool:
+        """Fence-push predicate: has the owner this agent joined left
+        the advertised ring? True when the rid is no longer live OR is
+        live under a DIFFERENT incarnation (the joined one was fenced
+        and respawned). A missing membership is no evidence — the
+        router may be restarting; epoch detection stays the backstop."""
+        if m is None:
+            return False
+        entry = m.live.get(rid)
+        return entry is None or entry.get("incarnation") != incarnation
+
+    async def _watch_owner(self, rid: int, incarnation: str,
+                           worker) -> None:
+        """Poll the membership at the beat cadence; on the owner's
+        fence, close the worker's transport so its run loop returns
+        NOW instead of after epoch detection."""
+        period = min(self.backoff_s, health_beat_s())
+        while True:
+            await asyncio.sleep(period)
+            m = await asyncio.to_thread(read_membership, self.statedir)
+            if self.owner_gone(m, rid, incarnation):
+                self.fence_pushes += 1
+                self._pushed = True
+                logger.info(
+                    "miner agent: owner rid %d (%s) fenced — closing "
+                    "conn for immediate rejoin (fence-push #%d)",
+                    rid, incarnation, self.fence_pushes)
+                await worker.close()
+                return
 
     async def run(self) -> None:
         from .miner import MinerWorker
         while True:
-            hostport = self._pick()
-            if hostport is None:
+            picked = self._pick()
+            if picked is None:
                 await asyncio.sleep(self.backoff_s)
                 continue
+            rid, incarnation, hostport = picked
             worker = MinerWorker(hostport, params=self.params,
                                  searcher_factory=self.factory)
+            watcher = None
             try:
                 await worker.join()
                 self.joins += 1
                 logger.info("miner agent joined %s (join #%d)",
                             hostport, self.joins)
-                await worker.run()     # returns when the conn dies
+                watcher = asyncio.get_running_loop().create_task(
+                    self._watch_owner(rid, incarnation, worker))
+                await worker.run()     # returns on conn death OR push
             except LspError as exc:
                 logger.info("miner agent join/run to %s failed: %s",
                             hostport, exc)
             finally:
+                if watcher is not None:
+                    watcher.cancel()
                 await worker.close()
+            if self._pushed:
+                # Fence-push exit: the membership ALREADY advertises a
+                # survivor — re-pick immediately instead of paying the
+                # backoff the push exists to avoid (backoff remains
+                # the spin guard for the no-membership/conn-death
+                # paths, where _pick returning None still sleeps).
+                self._pushed = False
+                continue
             await asyncio.sleep(self.backoff_s)
 
 

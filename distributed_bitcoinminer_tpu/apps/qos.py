@@ -100,6 +100,17 @@ class TokenBucket:
         self._tokens -= n
         return True
 
+    def set_rate(self, rate: float, burst: Optional[float] = None) -> None:
+        """Re-rate a LIVE bucket (the adaptive admission controller,
+        ISSUE 13): accrued tokens are settled at the OLD rate first so
+        an adjustment never retroactively mints or burns credit, then
+        the new rate (and optionally burst) applies from now."""
+        self._refill()
+        self.rate = rate
+        if burst is not None:
+            self.burst = max(1.0, burst)
+            self._tokens = min(self._tokens, self.burst)
+
     @property
     def level(self) -> float:
         if self.rate <= 0:

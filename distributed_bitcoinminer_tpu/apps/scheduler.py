@@ -110,11 +110,12 @@ from ..lsp.server import AsyncServer
 from ..utils import sanitize as _sanitize
 from ..utils import trace as _tracing
 from ..utils._env import int_env as _int_env
-from ..utils.config import CacheParams, CoalesceParams, LeaseParams, \
-    QosParams, StripeParams, coalesce_from_env, qos_from_env, \
-    stripe_from_env
+from ..utils.config import AdaptParams, CacheParams, CoalesceParams, \
+    LeaseParams, QosParams, StripeParams, adapt_from_env, \
+    coalesce_from_env, qos_from_env, stripe_from_env
 from ..utils.metrics import (Registry, RequestTrace, ensure_emitter,
                              registry as process_registry)
+from .adapt import AdaptPlane
 from .miner_plane import Chunk, MinerPlane, MinerState
 from .qos import LAZY_REMOVE
 from .tenant_plane import TenantPlane
@@ -240,6 +241,7 @@ class Scheduler:
                  stripe: Optional[StripeParams] = None,
                  qos: Optional[QosParams] = None,
                  coalesce: Optional[CoalesceParams] = None,
+                 adapt: Optional[AdaptParams] = None,
                  clock=None,
                  result_cache: Optional[ResultCache] = None,
                  recv_batch: Optional[int] = None,
@@ -335,6 +337,36 @@ class Scheduler:
             trace_get=self.tenant_plane.traces.get,
             lease_event=self._on_lease_event,
             dispatch=self._maybe_dispatch, trace_on=self._trace_on)
+        # Self-tuning control plane (ISSUE 13, DBM_ADAPT, default OFF):
+        # env-defaulted like stripe/qos/coalesce so the knob pins the
+        # stock shape through every existing harness. Disabled = None —
+        # every hook below is one attribute test, no controller state
+        # exists anywhere (the DBM_ADAPT=0 parity contract). Seeded
+        # with the LIVE param blocks' statics so an adaptive run starts
+        # at the static configuration and departs only on evidence;
+        # the injected clock is the same one the admission buckets get.
+        adapt = adapt if adapt is not None else adapt_from_env()
+        if adapt.enabled:
+            # Controllers only mount over LIVE knobs (the "never
+            # re-enable what an operator turned off" contract): the
+            # chunk controller's signal and both its knobs' consumers
+            # need the QoS chunked path, the window bound is consulted
+            # only by QoS window grants, and the admission gate sits
+            # inside the qos-enabled arrival path — with the owning
+            # plane off, mounting a controller would tune a dead knob
+            # and report misleading gauges. The 0-disables convention
+            # on chunk_s/small_s (AdaptPlane ctor) carries the flag.
+            from dataclasses import replace as _dc_replace
+            eff = _dc_replace(adapt,
+                              admit=adapt.admit and qos.enabled)
+            self.adapt_plane: Optional[AdaptPlane] = AdaptPlane(
+                eff, self.metrics, clock,
+                chunk_s=qos.chunk_s if qos.enabled else 0.0,
+                small_s=coalesce.small_s
+                if (qos.enabled and coalesce.enabled) else 0.0,
+                trace_on=self._trace_on)
+        else:
+            self.adapt_plane = None
         self._sync_backlog_hook()
 
     # Param blocks live on the planes (single source of truth); these
@@ -604,6 +636,8 @@ class Scheduler:
         if self.lease.enabled:
             self._check_leases()
         self._check_queue_age()
+        if self.adapt_plane is not None:
+            self._apply_adapt()
         if self.qos.enabled:
             # backlog_tenants is exactly the queued conn-id set, read
             # from the per-tenant index — no O(queued-requests) list
@@ -611,6 +645,42 @@ class Scheduler:
             busy = (set(self.tenant_plane.backlog_tenants())
                     | {r.conn_id for r in self._inflight.values()})
             self.tenant_plane.gc(busy)
+
+    def _apply_adapt(self) -> None:
+        """One self-tuning tick (ISSUE 13; rides the sweep): feed the
+        admission controller the oldest queued request's age, then
+        apply whatever knob values the controllers moved — the
+        chunk/stripe seconds track ONE controlled value (both knobs
+        mean "seconds of work per dispatch unit"), the coalescing
+        bound replaces ``small_s``, and the admission rate lives
+        inside the plane's own bucket. Changes go through the param-
+        block property setters, so reconfiguration follows the exact
+        path tests already drive (frozen replace; ``__post_init__``
+        re-validation; backlog-hook re-sync). Bounds of already-
+        activated chunk plans are immutable — a new chunk_s affects
+        only future activations, so no merge invariant can move."""
+        from dataclasses import replace as _replace
+        head = self.tenant_plane.head()
+        age = (time.monotonic() - head.queued_at) if head is not None \
+            else 0.0
+        changes = self.adapt_plane.tick(
+            age, self._counters["results_sent"].value)
+        if not changes:
+            return
+        v = changes.get("chunk_s")
+        if v is not None:
+            # Write the plane's block directly, NOT through the qos
+            # property setter: the setter re-runs _sync_backlog_hook,
+            # whose ring re-seed walks every backlogged tenant — an
+            # O(backlog) scan per adjustment that a chunk_s change
+            # (which cannot alter the lazy flag, the enabled bit, or
+            # ring membership) never needs. The stripe/coalesce
+            # setters are plain assignments either way.
+            self.tenant_plane.qos = _replace(self.qos, chunk_s=v)
+            self.stripe = _replace(self.stripe, chunk_s=v)
+        v = changes.get("small_s")
+        if v is not None:
+            self.coalesce = _replace(self.coalesce, small_s=v)
 
     # ---------------------------------------------------------------- events
 
@@ -621,6 +691,21 @@ class Scheduler:
         if request is None:
             return       # answered from the ResultCache at arrival
         if self.qos.enabled:
+            if self.adapt_plane is not None:
+                # Self-tuning plane (ISSUE 13): the window controller
+                # counts small arrivals (mouse-flood signal), and the
+                # congestion-style admission bucket gates CAPACITY
+                # ahead of the per-tenant fairness buckets below —
+                # shed semantics (conn close, counters) are the stock
+                # shed path either way.
+                if self.adapt_plane.window is not None:
+                    # _qos_small walks the eligible pool — don't pay
+                    # it per arrival just to discard the answer.
+                    self.adapt_plane.observe_arrival(
+                        self._qos_small(request))
+                if not self.adapt_plane.admit():
+                    self._shed(request, "admission")
+                    return
             # Admission (cache replays above never reach here — an
             # already-answered retry must not burn quota, ISSUE 5
             # satellite). A drained bucket sheds the NEW request;
@@ -682,9 +767,16 @@ class Scheduler:
         request.trace.event("enqueue",
                             queue_depth=self.tenant_plane.queue_len())
         self.tenant_plane.enqueue(request)
-        if bound_queue and self.qos.enabled and self.qos.max_queued > 0:
-            while self.tenant_plane.queue_len() > self.qos.max_queued:
-                self._shed(self.tenant_plane.pop_head(), "overload")
+        if bound_queue and self.qos.enabled:
+            bound = self.qos.max_queued
+            if self.adapt_plane is not None:
+                # Congestion depth bound (ISSUE 13): capacity x age
+                # knee, tighter than (or substituting for) the static
+                # cap once a service rate has been measured.
+                bound = self.adapt_plane.effective_max_queued(bound)
+            if bound > 0:
+                while self.tenant_plane.queue_len() > bound:
+                    self._shed(self.tenant_plane.pop_head(), "overload")
         self._maybe_dispatch()
 
     def _on_join(self, conn_id: int) -> None:
@@ -701,6 +793,17 @@ class Scheduler:
             return
         miner, chunk = popped
         curr = self._inflight.get(chunk.job_id)
+        if self.adapt_plane is not None:
+            # Chunk-sizing signal (ISSUE 13): the lease plane's own
+            # stamps (service time + remaining-lease fraction) plus the
+            # Result's span when one rode it — no new instrumentation.
+            # Only chunked-mode grants are `sized` (their size came
+            # from the controlled knob; a mouse's wholesale split did
+            # not — see AdaptPlane.observe_chunk).
+            service_s, margin = self.miner_plane.service_sample(chunk)
+            self.adapt_plane.observe_chunk(
+                service_s, margin, span=msg.span,
+                sized=curr is not None and curr.qos_mode == "chunked")
         if curr is None:
             stale = self.tenant_plane.traces.get(chunk.job_id)
             if stale is not None:
@@ -1385,6 +1488,8 @@ class Scheduler:
             self._tenant_inflight.get(req.conn_id, 0) + 1
         req.started = time.monotonic()
         self.tenant_plane.observe_queue_wait(req.started - req.queued_at)
+        if self.adapt_plane is not None:
+            self.adapt_plane.observe_wait(req.started - req.queued_at)
         self.tenant_plane.traces.register(req.job_id, req.trace)
         if not req.trace.null:
             self.tenant_plane.track_tenant(req.conn_id)
@@ -1490,6 +1595,9 @@ class Scheduler:
         request.started = time.monotonic()
         self.tenant_plane.observe_queue_wait(
             request.started - request.queued_at)
+        if self.adapt_plane is not None:
+            self.adapt_plane.observe_wait(
+                request.started - request.queued_at)
         self.tenant_plane.traces.register(request.job_id, request.trace)
         if not request.trace.null:
             self.tenant_plane.track_tenant(request.conn_id)

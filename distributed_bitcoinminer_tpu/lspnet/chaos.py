@@ -346,7 +346,7 @@ async def run_proc_episode(cluster, ep: ProcEpisode, params,
     """
     import time as _time
     from ..apps.client import submit_with_retry
-    from ..apps.procs import resolve_owner
+    from ..apps.procs import read_beats, read_membership, resolve_owner
     from ..bitcoin.hash import scan_min
     from ..utils.config import RetryParams
     retry = retry or RetryParams(attempts=24, timeout_s=3.0,
@@ -366,7 +366,52 @@ async def run_proc_episode(cluster, ep: ProcEpisode, params,
         cluster.stop_replica(rid)
     else:
         cluster.kill_router()
-    got = await asyncio.wait_for(task, reply_timeout_s)
+    fault_t = _time.monotonic()
+
+    async def measure_rejoin() -> float:
+        """Seconds from the fault until ALL the cluster's miner agents
+        are serving on SURVIVING live replicas — the handoff dead air
+        the fence-push channel (ISSUE 13 satellite) cuts from
+        epoch-detection latency (~0.8 s) to ~one beat past the
+        router's missed-beat window. Requiring the FULL population
+        (``cluster.m``), not just one joined miner, keeps the
+        measurement honest when the victim held an agent while
+        another replica's agent never moved — a bare >=1 would record
+        the router's fence latency and never the displaced agent's
+        rejoin (review finding: the fence-push proof would pass
+        vacuously on seeds whose victim was agent-free)."""
+        want = max(1, getattr(cluster, "m", 1))
+        while True:
+            m = read_membership(cluster.statedir)
+            if m is not None and rid not in m.live:
+                live = {r: v["incarnation"] for r, v in m.live.items()}
+                joined = sum(
+                    b.miners for b in read_beats(cluster.statedir)
+                    if b.rid in live and b.serving
+                    and b.incarnation == live[b.rid])
+                if joined >= want:
+                    return _time.monotonic() - fault_t
+            await asyncio.sleep(0.02)
+
+    rejoin_task = None
+    if ep.kind in ("kill_replica", "stop_replica"):
+        rejoin_task = asyncio.create_task(measure_rejoin())
+    try:
+        got = await asyncio.wait_for(task, reply_timeout_s)
+    except BaseException:
+        # A reply timeout must not orphan the membership poller — it
+        # would keep spinning until loop teardown and bury the real
+        # failure under "Task was destroyed but it is pending".
+        if rejoin_task is not None:
+            rejoin_task.cancel()
+        raise
+    rejoin_s = None
+    if rejoin_task is not None:
+        try:
+            rejoin_s = round(await asyncio.wait_for(
+                rejoin_task, reply_timeout_s), 3)
+        except asyncio.TimeoutError:
+            rejoin_task.cancel()
     want = scan_min(ep.tenant, 0, ep.max_nonce + 1)
     assert got is not None, f"{ep} never answered"
     assert got[:2] == want, (ep, got, want)
@@ -387,7 +432,7 @@ async def run_proc_episode(cluster, ep: ProcEpisode, params,
     else:
         cluster.spawn_replica(rid)
     return {"kind": ep.kind, "victim": victim, "reply": got,
-            "fenced_exit": fenced_exit,
+            "fenced_exit": fenced_exit, "rejoin_s": rejoin_s,
             "elapsed_s": round(_time.monotonic() - t0, 3)}
 
 

@@ -297,6 +297,52 @@ Environment variables:
   path by default). 0 = the stock walk bit-for-bit (tier-1 matrix
   leg). Measured (loadharness, 1 replica): 5k tenants 186 -> 1981
   admitted/s, CPU/request 5.3ms -> 0.5ms.
+- ``DBM_ADAPT`` (default 0): the self-tuning control plane (ISSUE 13;
+  ``apps/adapt.py``). With it on, the scheduler mounts small setpoint
+  controllers that retune the dispatch knobs from already-collected
+  signals: chunk/stripe seconds-of-work driven toward a per-chunk
+  force-latency setpoint (AIMD with hysteresis and hard
+  floors/ceilings, plus a lease-margin guard), the coalescing-window
+  bound widened under mouse floods and collapsed when ``gap_s`` spans
+  show pipeline bubbles, and a congestion-style scheduler-wide
+  admission bucket whose rate tracks the queue-age slope (additive
+  increase on falling age, multiplicative decrease on rising age) so
+  shed rate follows actual service capacity. ``DBM_ADAPT=0`` is
+  bit-for-bit stock: no controller objects exist and every hook is one
+  attribute test (tier-1 knob-off matrix leg pin).
+- ``DBM_ADAPT_TICK_S``: minimum seconds between controller adjustments
+  (default 1.0; the controllers ride the scheduler sweep and
+  rate-limit themselves to this).
+- ``DBM_ADAPT_BAND``: hysteresis dead-band as a fraction of each
+  setpoint (default 0.35) — measurements inside the band adjust
+  nothing, which is what keeps AIMD's sawtooth from becoming churn.
+  The default is wide enough that an honestly-tuned static
+  configuration measures INSIDE it (chunk plans ceil-divide, so
+  steady-state per-chunk force sits at ~0.7-0.9x the target): an
+  adaptive run over healthy traffic changes nothing, and only a real
+  divergence (rate drift, mis-tuned deployment) moves a knob.
+- ``DBM_ADAPT_FORCE_S``: the per-chunk force-latency setpoint the
+  chunk/stripe sizing controller drives toward (default 1.0 second —
+  what the static ``DBM_QOS_CHUNK_S`` default already targets when
+  the rate EWMA is honest, so the controller is CORRECTIVE: it moves
+  only when measurement diverges from the static plan, e.g. after a
+  pool-rate drift the EWMA lags).
+- ``DBM_ADAPT_RATE0``: starting rate (requests/s) of the adaptive
+  admission bucket (default 0 = start OPEN at the controller ceiling —
+  nothing is shed until congestion is actually observed).
+- ``DBM_ADAPT_CHUNK`` / ``DBM_ADAPT_COALESCE`` / ``DBM_ADAPT_ADMIT``
+  (default 1 each): per-controller enables under the master knob, for
+  A/B isolation of one controller at a time.
+- ``DBM_TIER1_ADAPT`` (0 disables): scripts/tier1.sh's adapt leg — the
+  dbmcheck ``adaptive_control`` stability scenario at a >=500 distinct
+  schedule floor plus a mini mice-stampede workload with a
+  completion/p99 gate.
+- ``DBM_BENCH_ADAPT`` (0 disables) / ``DBM_BENCH_ADAPT_ROUNDS``: the
+  bench's ``detail.adapt`` A/B — the three adversarial load-harness
+  workloads (mice stampede, elephant convoy, tenant churn storm) run
+  with the static defaults vs the adaptive controllers, legs
+  interleaved order-swapped per round (default 3) and
+  median-aggregated.
 - ``DBM_HEALTH_BEAT_S`` (default 0.5) / ``DBM_HEALTH_MISS_K``
   (default 3): the multi-process replica tier's health plane
   (apps/health.py + apps/procs.py, ISSUE 12). Every replica process
@@ -583,6 +629,34 @@ class CoalesceParams:
 
 
 @dataclass(frozen=True)
+class AdaptParams:
+    """Self-tuning control-plane knobs (ISSUE 13; ``apps/adapt.py``).
+
+    With ``enabled`` the scheduler mounts an
+    :class:`~..apps.adapt.AdaptPlane`: an AIMD chunk/stripe-seconds
+    controller driving per-chunk force latency toward ``force_s``, a
+    coalescing-window controller (mouse-flood widen / pipeline-bubble
+    collapse), and a congestion-style scheduler-wide admission bucket
+    controlled on the queue-age slope. ``band`` is the hysteresis
+    dead-band (fraction of setpoint); ``tick_s`` rate-limits
+    adjustments; ``rate0`` seeds the admission rate (0 = start open at
+    the controller ceiling). The per-controller flags isolate one
+    controller for A/B work. Hard floors/ceilings live on the
+    controllers themselves (class constants) — no observation sequence
+    can push a knob outside them. ``enabled=False`` (the default)
+    constructs nothing: bit-for-bit stock scheduling.
+    """
+    enabled: bool = False
+    tick_s: float = 1.0
+    band: float = 0.35
+    force_s: float = 1.0
+    rate0: float = 0.0
+    chunk: bool = True
+    coalesce: bool = True
+    admit: bool = True
+
+
+@dataclass(frozen=True)
 class QosParams:
     """Fair-share QoS dispatch knobs (apps/qos.py + apps/scheduler.py).
 
@@ -757,6 +831,20 @@ def qos_from_env() -> QosParams:
                                   d.default_weight),
         weights=tuple(weights),
         lazy=_int_env("DBM_QOS_LAZY", 1) != 0,
+    )
+
+
+def adapt_from_env() -> AdaptParams:
+    d = AdaptParams()
+    return AdaptParams(
+        enabled=_int_env("DBM_ADAPT", 0) != 0,
+        tick_s=max(0.01, _float_env("DBM_ADAPT_TICK_S", d.tick_s)),
+        band=min(0.9, max(0.0, _float_env("DBM_ADAPT_BAND", d.band))),
+        force_s=max(0.01, _float_env("DBM_ADAPT_FORCE_S", d.force_s)),
+        rate0=max(0.0, _float_env("DBM_ADAPT_RATE0", d.rate0)),
+        chunk=_int_env("DBM_ADAPT_CHUNK", 1) != 0,
+        coalesce=_int_env("DBM_ADAPT_COALESCE", 1) != 0,
+        admit=_int_env("DBM_ADAPT_ADMIT", 1) != 0,
     )
 
 

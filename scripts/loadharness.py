@@ -39,12 +39,14 @@ def _series_count() -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--tenants", type=int, default=1000)
-    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--tenants", type=int, default=None,
+                    help="tenant count (default: 1000, or the "
+                         "workload's own population with --workload)")
+    ap.add_argument("--replicas", type=int, default=None)
     ap.add_argument("--miners", type=int, default=4)
-    ap.add_argument("--requests-per-tenant", type=int, default=1)
-    ap.add_argument("--nonces", type=int, default=256)
-    ap.add_argument("--max-queued", type=int, default=4096)
+    ap.add_argument("--requests-per-tenant", type=int, default=None)
+    ap.add_argument("--nonces", type=int, default=None)
+    ap.add_argument("--max-queued", type=int, default=None)
     ap.add_argument("--recv-batch", type=int, default=None)
     ap.add_argument("--trace-sample", type=float, default=None)
     ap.add_argument("--qos-lazy", type=int, choices=(0, 1), default=None,
@@ -54,28 +56,76 @@ def main(argv=None) -> int:
                     help="drive the MULTI-PROCESS topology (real LSP "
                          "sockets, router + replica processes, fake "
                          "miner agents) instead of in-process detnet")
+    ap.add_argument("--drivers", type=int, default=1,
+                    help="shard the --procs storm driver across this "
+                         "many OS processes (ISSUE 13 satellite; one "
+                         "driver event loop tops out around O(500) "
+                         "real UDP conns)")
+    ap.add_argument("--workload", default=None,
+                    choices=("mice_stampede", "tenant_churn",
+                             "elephant_convoy"),
+                    help="run ONE adversarial workload (ISSUE 13) "
+                         "instead of the uniform storm; --adapt "
+                         "picks the leg")
+    ap.add_argument("--adapt", type=int, choices=(0, 1), default=0,
+                    help="with --workload: 1 = the self-tuning "
+                         "controllers, 0 = the static knob defaults")
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--assert-p99", type=float, default=None,
                     help="gate: reply p99 ceiling in seconds")
+    ap.add_argument("--assert-complete", type=float, default=None,
+                    help="gate (adversarial workloads): minimum "
+                         "completed/requests fraction — sheds are the "
+                         "workload there, so the all-non-shed-complete "
+                         "rule is replaced by this floor")
     ap.add_argument("--assert-series", type=int, default=None,
                     help="gate: max process metric series after the run")
     args = ap.parse_args(argv)
 
     from distributed_bitcoinminer_tpu.apps.loadharness import (
-        run_load, run_load_procs)
+        run_adversarial, run_load, run_load_procs)
     before = _series_count()
-    if args.procs:
+    tenants = args.tenants if args.tenants is not None else 1000
+    if args.workload is not None:
+        # The workload SPEC owns replica topology, request counts,
+        # nonce sizes, and the queue bound — a storm flag accepted
+        # here and silently dropped would print JSON that looks like
+        # the requested configuration was measured (review finding).
+        for flag, value in (("--replicas", args.replicas),
+                            ("--requests-per-tenant",
+                             args.requests_per_tenant),
+                            ("--nonces", args.nonces),
+                            ("--max-queued", args.max_queued),
+                            ("--drivers",
+                             args.drivers if args.drivers != 1
+                             else None),
+                            ("--procs", args.procs or None)):
+            if value is not None:
+                ap.error(f"{flag} does not apply to --workload runs "
+                         f"(the workload spec owns it)")
+        # tenants=None keeps the workload's own population; an
+        # explicit --tenants scales it down for smoke-sized runs.
+        leg = run_adversarial(
+            args.workload, adapt=bool(args.adapt),
+            tenants=args.tenants,
+            miners=args.miners, timeout_s=args.timeout)
+    elif args.procs:
         leg = run_load_procs(
-            tenants=args.tenants, replicas=args.replicas,
+            tenants=tenants,
+            replicas=args.replicas if args.replicas is not None else 1,
             miners=args.miners,
-            requests_per_tenant=args.requests_per_tenant,
-            req_nonces=args.nonces, timeout_s=args.timeout)
+            requests_per_tenant=args.requests_per_tenant or 1,
+            req_nonces=args.nonces or 256, drivers=args.drivers,
+            timeout_s=args.timeout)
     else:
         leg = run_load(
-            tenants=args.tenants, replicas=args.replicas,
+            tenants=tenants,
+            replicas=args.replicas if args.replicas is not None else 1,
             miners=args.miners,
-            requests_per_tenant=args.requests_per_tenant,
-            req_nonces=args.nonces, max_queued=args.max_queued,
+            requests_per_tenant=args.requests_per_tenant or 1,
+            req_nonces=args.nonces or 256,
+            max_queued=args.max_queued
+            if args.max_queued is not None else 4096,
             recv_batch=args.recv_batch, trace_sample=args.trace_sample,
             qos_lazy=(None if args.qos_lazy is None
                       else bool(args.qos_lazy)),
@@ -85,14 +135,27 @@ def main(argv=None) -> int:
     print(json.dumps(leg, sort_keys=True), flush=True)
 
     rc = 0
-    expected = leg["requests"] \
-        - leg["shed_tenants"] * args.requests_per_tenant
+    if args.workload is not None:
+        # Adversarial workloads SHED BY DESIGN (admission control is
+        # the thing under test): the no-loss rule is that every
+        # request was either answered or shed with its conn closed,
+        # and --assert-complete floors the answered fraction.
+        expected = leg["requests"] - leg.get("shed_requests", 0)
+    else:
+        expected = leg["requests"] \
+            - leg["shed_tenants"] * (args.requests_per_tenant or 1)
     if leg.get("timed_out"):
         print("LOAD_GATE: storm timed out", file=sys.stderr)
         rc = 1
     if leg["completed"] < expected:
         print(f"LOAD_GATE: only {leg['completed']}/{expected} non-shed "
               f"requests completed", file=sys.stderr)
+        rc = 1
+    if args.assert_complete is not None and leg["requests"] and \
+            leg["completed"] / leg["requests"] < args.assert_complete:
+        print(f"LOAD_GATE: completed fraction "
+              f"{leg['completed'] / leg['requests']:.3f} under the "
+              f"{args.assert_complete} floor", file=sys.stderr)
         rc = 1
     if args.assert_p99 is not None and leg["p99_s"] is not None \
             and leg["p99_s"] > args.assert_p99:

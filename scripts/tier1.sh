@@ -87,6 +87,37 @@ if [ "${DBM_TIER1_LOAD:-1}" != "0" ]; then
     echo "LOAD_LEG_RC=$load_rc"
 fi
 
+# Adapt leg (ISSUE 13): the self-tuning control plane's stability +
+# payoff gate. (a) dbmcheck's adaptive_control scenario alone at a
+# >=500 distinct-schedule floor — the controller-specific invariants
+# (hard clamps, bounded oscillation amplitude) on the virtual clock
+# against drifting miner rates; (b) a mini mice-stampede workload with
+# the controllers ON, gated on completion fraction and reply p99 (the
+# adaptive plane must keep the queue near the service floor — the
+# ceiling catches a runaway controller, not box jitter). No JAX
+# import in either half. DBM_TIER1_ADAPT=0 skips.
+adapt_rc=0
+if [ "${DBM_TIER1_ADAPT:-1}" != "0" ]; then
+    rm -f /tmp/_t1_adapt.log
+    timeout -k 5 150 python scripts/dbmcheck.py \
+        --scenario adaptive_control --seeds 700 2>&1 \
+        | tee /tmp/_t1_adapt.log
+    adapt_rc=${PIPESTATUS[0]}
+    adistinct=$(grep -a '^DBMCHECK_DISTINCT=' /tmp/_t1_adapt.log | tail -1 | cut -d= -f2)
+    if [ "$adapt_rc" -eq 0 ] && [ "${adistinct:-0}" -lt 500 ]; then
+        echo "ADAPT_FLOOR: only ${adistinct:-0} distinct schedules" \
+             "explored (< 500) — treating as failure"
+        adapt_rc=3
+    fi
+    if [ "$adapt_rc" -eq 0 ]; then
+        timeout -k 5 120 python scripts/loadharness.py \
+            --workload mice_stampede --adapt 1 \
+            --assert-complete 0.5 --assert-p99 2.0
+        adapt_rc=$?
+    fi
+    echo "ADAPT_LEG_RC=$adapt_rc"
+fi
+
 # Multi-process smoke leg (ISSUE 12): the REAL process topology on
 # localhost — router + 2 replica processes on their own LSP sockets +
 # 1 miner agent — with a kill -9 of the replica owning an in-flight
@@ -134,16 +165,21 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # trace — stock), DBM_REPLICAS=1 (single-scheduler topology), and
     # the plane-split suite joins the module list. ISSUE 12 addition:
     # DBM_QOS_LAZY=0 pins the STOCK DRR candidate walk (the lazy
-    # ring walk is default-on everywhere else in the gate).
+    # ring walk is default-on everywhere else in the gate). ISSUE 13
+    # addition: DBM_ADAPT=0 pins the static-knob control plane (no
+    # controller objects anywhere — the bit-for-bit stock contract the
+    # adapt suite's parity tests assert), with test_adapt.py in the
+    # module list.
     timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
-        DBM_REPLICAS=1 DBM_QOS_LAZY=0 \
+        DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
         tests/test_apps.py tests/test_qos.py tests/test_batch.py \
         tests/test_trace.py tests/test_plane_split.py \
+        tests/test_adapt.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}
@@ -153,5 +189,6 @@ fi
 [ "$lint_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$lint_rc
 [ "$check_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$check_rc
 [ "$load_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$load_rc
+[ "$adapt_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$adapt_rc
 [ "$procs_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$procs_rc
 exit $rc

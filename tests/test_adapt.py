@@ -1,0 +1,544 @@
+"""Self-tuning control plane units (ISSUE 13, ``apps/adapt.py``).
+
+Covers the controller family the scheduler mounts under ``DBM_ADAPT``:
+AIMD mechanics (convergence to setpoint on a scripted latency series,
+hysteresis dead-band, hard floor/ceiling clamps, the bounded
+proportional probe), the oscillation-amplitude audit the dbmcheck
+``adaptive_control`` scenario runs, each controller's signal semantics
+(lease-margin guard, mouse-flood widen / pipeline-bubble collapse,
+queue-age-slope admission with the service-rate anchor), the live
+token-bucket re-rate, plane plumbing (tick rate-limit, span
+whitelisting, congestion queue bound) — and the ``DBM_ADAPT=0`` parity
+pin the tier-1 knob-off matrix leg re-runs: byte-identical replies,
+zero controller state.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.adapt import (
+    AdaptPlane, AdmissionController, AimdValue, ChunkSizeController,
+    CoalesceWindowController, oscillation_ratio, oscillation_ratios)
+from distributed_bitcoinminer_tpu.apps.qos import TokenBucket
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                          MsgType,
+                                                          new_request,
+                                                          new_result)
+from distributed_bitcoinminer_tpu.utils.config import (AdaptParams,
+                                                       LeaseParams,
+                                                       QosParams,
+                                                       adapt_from_env)
+from distributed_bitcoinminer_tpu.utils.metrics import Registry
+
+MINER_A, MINER_B = 1, 2
+TEN_X, TEN_Y = 10, 11
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------- AimdValue
+
+def test_aimd_hard_clamps_hold_under_any_sequence():
+    clk = FakeClock()
+    v = AimdValue(1.0, floor=0.5, ceil=2.0, add=0.3, clock=clk)
+    for _ in range(50):
+        v.increase()
+    assert v.value == 2.0
+    for _ in range(50):
+        v.decrease()
+    assert v.value == 0.5
+    for _t, x in v.history:
+        assert 0.5 <= x <= 2.0
+
+
+def test_aimd_history_records_only_movement():
+    clk = FakeClock()
+    v = AimdValue(2.0, floor=0.5, ceil=2.0, add=0.3, clock=clk)
+    n0 = len(v.history)
+    assert not v.increase()          # already at the ceiling: no-op
+    assert len(v.history) == n0 and v.adjustments == 0
+    assert v.decrease()
+    assert len(v.history) == n0 + 1 and v.adjustments == 1
+
+
+def test_aimd_proportional_probe_bounds_growth_ratio():
+    """``add_frac`` recovers large values quickly but each step's
+    growth ratio stays <= 1 + add_frac (the oscillation-bound term)."""
+    clk = FakeClock()
+    v = AimdValue(1000.0, floor=1.0, ceil=1e5, add=8.0, add_frac=0.1,
+                  clock=clk)
+    before = v.value
+    v.increase()
+    assert v.value == pytest.approx(before * 1.1)
+    small = AimdValue(10.0, floor=1.0, ceil=1e5, add=8.0, add_frac=0.1,
+                      clock=clk)
+    small.increase()                 # constant term dominates when small
+    assert small.value == pytest.approx(18.0)
+
+
+def test_aimd_decrease_floored_holds_at_anchor():
+    clk = FakeClock()
+    v = AimdValue(100.0, floor=1.0, ceil=1e5, add=8.0, mul=0.5,
+                  clock=clk)
+    assert v.decrease_floored(80.0)
+    assert v.value == 80.0           # cut to the anchor, not through it
+    assert not v.decrease_floored(80.0)   # at the anchor: HOLD
+    assert v.value == 80.0
+    assert v.decrease_floored(None)  # no anchor: plain multiplicative
+    assert v.value == 40.0
+
+
+# ------------------------------------------------------ oscillation_ratio
+
+def test_oscillation_ratio_short_and_monotone_series():
+    assert oscillation_ratio([]) == 1.0
+    assert oscillation_ratio([(0, 5.0), (1, 4.0)]) == 1.0
+    # A pure monotone descent (the open-admission transient) has no
+    # post-transient cycle at all.
+    hist = [(t, 100.0 * 0.7 ** t) for t in range(8)]
+    assert oscillation_ratio(hist) == 1.0
+
+
+def test_oscillation_ratio_skips_transient_measures_sawtooth():
+    values = [100.0, 50.0, 25.0, 20.0, 25.0, 20.0, 25.0]
+    hist = [(t, v) for t, v in enumerate(values)]
+    # The 100 -> 20 descent is the transient; the steady sawtooth's
+    # amplitude is 25/20.
+    assert oscillation_ratio(hist) == pytest.approx(1.25)
+
+
+def test_oscillation_ratio_flags_growing_cycle():
+    values = [1.0, 2.0, 1.0, 4.0, 1.0, 8.0]
+    hist = [(t, v) for t, v in enumerate(values)]
+    assert oscillation_ratio(hist) == pytest.approx(8.0)
+
+
+def test_oscillation_ratios_episode_vs_limit_cycle():
+    """The stability audit's discriminator: ONE wide swing is a
+    congestion episode (descent + recovery ramp), TWO is a limit
+    cycle. A single dip-and-recover history shows exactly one ratio
+    over a 5x bound; a repeated wide sawtooth shows several."""
+    episode = [10.0, 50.0, 20.0, 14.0, 22.0, 30.0, 46.0, 120.0]
+    ratios = oscillation_ratios([(t, v) for t, v in enumerate(episode)])
+    assert sum(1 for r in ratios if r > 5.0) == 1
+    cycle = [1.0, 8.0, 1.0, 8.0, 1.0, 8.0]
+    ratios = oscillation_ratios([(t, v) for t, v in enumerate(cycle)])
+    assert sum(1 for r in ratios if r > 5.0) >= 2
+
+
+# --------------------------------------------------- ChunkSizeController
+
+def _converge_chunk(ctl, slow, steps=40, samples=6):
+    """Run the controller against a proportional plant: executing a
+    chunk planned at ``value`` seconds takes ``value * slow`` wall
+    seconds (a pool ``slow``x slower than the rate EWMA believes)."""
+    for _ in range(steps):
+        for _ in range(samples):
+            ctl.observe(ctl.aimd.value * slow, 1.0)
+        ctl.tick()
+    return ctl.aimd.value * slow     # the latency the plant now shows
+
+
+def test_chunk_controller_converges_to_setpoint_scripted_series():
+    clk = FakeClock()
+    ctl = ChunkSizeController(1.0, setpoint_s=1.0, band=0.2, clock=clk)
+    # Pool 3x slower than planned (ideal value 1/3): AIMD settles into
+    # a bounded sawtooth AROUND the setpoint — the final value sits
+    # within one multiplicative cycle of ideal and the post-transient
+    # oscillation amplitude is bounded by one 0.5x step.
+    lat = _converge_chunk(ctl, slow=3.0)
+    assert 0.7 <= lat <= 1.6
+    assert 0.2 <= ctl.aimd.value <= 0.55
+    assert oscillation_ratio(list(ctl.aimd.history)) <= 2.5
+    # Pool 4x faster than planned: value grows until latency re-enters
+    # the band (additive, so it approaches from below).
+    ctl2 = ChunkSizeController(1.0, setpoint_s=1.0, band=0.2, clock=clk)
+    lat2 = _converge_chunk(ctl2, slow=0.25, steps=80)
+    assert lat2 == pytest.approx(1.0, rel=0.35)
+    assert ctl2.aimd.value > 2.5
+
+
+def test_chunk_controller_dead_band_no_churn():
+    clk = FakeClock()
+    ctl = ChunkSizeController(1.0, setpoint_s=1.0, band=0.35, clock=clk)
+    for lat in (0.9, 1.1, 0.75, 1.3, 1.0):
+        for _ in range(4):
+            ctl.observe(lat, 1.0)
+        assert ctl.tick() is None    # inside the band: nothing moves
+    assert ctl.aimd.adjustments == 0
+
+
+def test_chunk_controller_lease_margin_guard_overrides_latency():
+    clk = FakeClock()
+    ctl = ChunkSizeController(1.0, setpoint_s=1.0, band=0.35, clock=clk)
+    # Latency inside the band, but a chunk finished with only 10% of
+    # its lease left: one stall from a blow — shrink regardless.
+    ctl.observe(1.0, 0.1)
+    assert ctl.tick() == pytest.approx(0.5)
+
+
+def test_chunk_controller_settle_tick_discards_stale_samples():
+    """After an adjustment the next tick is a SETTLE tick: samples
+    still arriving from old-size chunks are drained and the EWMA
+    reset, so measurement lag cannot turn one decrease into a
+    multiplicative cascade (the dbmcheck-caught amplitude violation)."""
+    clk = FakeClock()
+    ctl = ChunkSizeController(1.0, setpoint_s=1.0, band=0.2, clock=clk)
+    ctl.observe(5.0, 1.0)
+    assert ctl.tick() == pytest.approx(0.5)     # honest decrease
+    ctl.observe(5.0, 1.0)                       # STALE old-size sample
+    assert ctl.tick() is None                   # settle: no cascade
+    ctl.observe(5.0, 1.0)                       # still slow, fresh EWMA
+    assert ctl.tick() == pytest.approx(0.25)    # now it may act again
+
+
+def test_chunk_controller_no_samples_no_tick():
+    clk = FakeClock()
+    ctl = ChunkSizeController(1.0, setpoint_s=1.0, band=0.2, clock=clk)
+    assert ctl.tick() is None
+    assert ctl.aimd.adjustments == 0
+
+
+def test_chunk_controller_clamps_under_divergent_plant():
+    clk = FakeClock()
+    ctl = ChunkSizeController(1.0, setpoint_s=1.0, band=0.1, clock=clk)
+    # A plant whose latency is huge regardless of the value (a wedged
+    # pool): the value parks at the FLOOR, never below.
+    for _ in range(60):
+        ctl.observe(50.0, 1.0)
+        ctl.tick()
+    assert ctl.aimd.value == ChunkSizeController.FLOOR_S
+    ctl2 = ChunkSizeController(1.0, setpoint_s=1.0, band=0.1, clock=clk)
+    for _ in range(200):
+        ctl2.observe(1e-4, 1.0)      # instant pool: parks at the CEIL
+        ctl2.tick()
+    assert ctl2.aimd.value == ChunkSizeController.CEIL_S
+
+
+# ----------------------------------------------- CoalesceWindowController
+
+def test_window_controller_mouse_flood_widens():
+    clk = FakeClock()
+    ctl = CoalesceWindowController(0.25, band=0.35, clock=clk)
+    clk.advance(1.0)
+    for _ in range(10):              # 10 small arrivals/s x 0.25s >= 2
+        ctl.observe_arrival(True)
+    ctl.observe_wait(0.2)            # and the queue wait is non-trivial
+    assert ctl.tick() == pytest.approx(0.30)
+
+
+def test_window_controller_no_widen_when_unloaded():
+    clk = FakeClock()
+    ctl = CoalesceWindowController(0.25, band=0.35, clock=clk)
+    clk.advance(1.0)
+    for _ in range(10):
+        ctl.observe_arrival(True)
+    ctl.observe_wait(0.001)          # mice flow but nothing queues
+    assert ctl.tick() is None
+    clk.advance(1.0)
+    ctl.observe_arrival(True)        # trickle, loaded: still no widen
+    ctl.observe_wait(0.5)
+    assert ctl.tick() is None
+
+
+def test_window_controller_gap_bubbles_collapse_and_win():
+    clk = FakeClock()
+    ctl = CoalesceWindowController(0.4, band=0.35, clock=clk)
+    clk.advance(1.0)
+    for _ in range(20):              # flood signal present...
+        ctl.observe_arrival(True)
+    ctl.observe_wait(0.5)
+    ctl.observe_gap(0.5)             # ...but the executor shows bubbles
+    assert ctl.tick() == pytest.approx(0.2)   # collapse wins
+
+
+def test_window_controller_lull_is_not_a_bubble():
+    """Code review (ISSUE 13): gap_s is unbounded idle time — the
+    first chunk after a 60s lull carries the whole lull, which must
+    not seed the bubble EWMA; and with ZERO fresh gap samples a stale
+    EWMA must not keep collapsing the window tick after tick."""
+    clk = FakeClock()
+    ctl = CoalesceWindowController(0.4, band=0.35, clock=clk)
+    clk.advance(1.0)
+    ctl.observe_gap(60.0)            # a lull, filtered at observe
+    assert ctl._gap.value is None
+    assert ctl.tick() is None
+    # One honest bubble sample collapses ONCE; with no further fresh
+    # samples the next ticks do nothing (no stale-EWMA walk to floor).
+    clk.advance(1.0)
+    ctl.observe_gap(0.5)
+    assert ctl.tick() == pytest.approx(0.2)
+    for _ in range(5):
+        clk.advance(1.0)
+        assert ctl.tick() is None
+    assert ctl.aimd.value == pytest.approx(0.2)
+
+
+# ------------------------------------------------- AdmissionController
+
+def test_admission_starts_open_and_descends_on_rising_age():
+    clk = FakeClock()
+    ctl = AdmissionController(0.0, clock=clk)
+    assert ctl.aimd.value == AdmissionController.RATE_CEIL
+    assert ctl.tick(0.5) is None     # first sample only seeds the slope
+    got = ctl.tick(0.8)              # rising, past MIN_AGE_S: decrease
+    assert got == pytest.approx(AdmissionController.RATE_CEIL * 0.7)
+
+
+def test_admission_additive_increase_on_falling_or_young_age():
+    clk = FakeClock()
+    ctl = AdmissionController(50.0, clock=clk)
+    ctl.tick(0.8)
+    up = ctl.tick(0.6)               # falling age
+    assert up == pytest.approx(50.0 + 8.0)
+    ctl2 = AdmissionController(50.0, clock=clk)
+    ctl2.tick(0.05)
+    up2 = ctl2.tick(0.1)             # rising but UNDER the age floor:
+    assert up2 == pytest.approx(58.0)   # underloaded, keep probing
+
+
+def test_admission_service_rate_anchors_the_decrease():
+    clk = FakeClock()
+    ctl = AdmissionController(100.0, clock=clk)
+    ctl.observe_service_rate(90.0)   # the pool demonstrably serves 90/s
+    ctl.tick(1.0)
+    assert ctl.tick(2.0) == pytest.approx(70.0)    # 0.7x, above anchor
+    assert ctl.tick(2.5) is None     # settle tick after the adjustment
+    assert ctl.tick(3.0) == pytest.approx(63.0)    # cut TO the anchor
+    ctl.tick(3.5)                                  # settle
+    assert ctl.tick(4.0) is None                   # at the anchor: hold
+    assert ctl.aimd.value == pytest.approx(63.0)
+
+
+def test_admission_settle_tick_damps_cascade():
+    """One adjustment per two ticks: the queue age needs a tick to
+    respond to the new rate before the slope means anything — a
+    monotone rising-age run may halve the rate at most every other
+    tick (cascade depth bounded by the lag rule)."""
+    clk = FakeClock()
+    ctl = AdmissionController(1000.0, clock=clk)
+    ages = [1.0, 1.2, 1.4, 1.6, 1.8, 2.0]
+    changes = [ctl.tick(a) for a in ages]
+    assert changes[1] is not None and changes[2] is None
+    assert changes[3] is not None and changes[4] is None
+    assert ctl.aimd.value == pytest.approx(1000.0 * 0.7 ** 3)
+
+
+def test_admission_queue_bound_is_capacity_times_age_knee():
+    clk = FakeClock()
+    ctl = AdmissionController(0.0, clock=clk)
+    assert ctl.queue_bound() is None          # no service rate observed
+    ctl.observe_service_rate(100.0)
+    assert ctl.queue_bound() == 30            # 100/s x 0.3s knee
+    ctl2 = AdmissionController(0.0, clock=clk)
+    ctl2.observe_service_rate(3.0)
+    assert ctl2.queue_bound() == AdmissionController.QUEUE_MIN
+
+
+def test_admission_shed_counted_when_bucket_empty():
+    clk = FakeClock()
+    ctl = AdmissionController(4.0, clock=clk)
+    granted = sum(1 for _ in range(100) if ctl.admit())
+    assert granted < 100 and ctl.shed == 100 - granted
+
+
+def test_token_bucket_set_rate_settles_at_old_rate_first():
+    clk = FakeClock()
+    bucket = TokenBucket(10.0, 10.0, clk)
+    for _ in range(10):
+        assert bucket.take(1.0)
+    assert not bucket.take(1.0)      # drained
+    clk.advance(1.0)                 # 1s accrues 10 at the OLD rate
+    bucket.set_rate(1000.0, burst=1000.0)
+    got = sum(1 for _ in range(1000) if bucket.take(1.0))
+    assert got == 10                 # re-rating minted nothing
+
+
+# ------------------------------------------------------------ AdaptPlane
+
+def _plane(clk, **kw):
+    params = kw.pop("params", AdaptParams(enabled=True, tick_s=1.0))
+    return AdaptPlane(params, Registry(), clk, **kw)
+
+
+def test_plane_tick_rate_limited_and_applies_changes():
+    clk = FakeClock()
+    plane = _plane(clk)
+    plane.chunk.observe(50.0, 1.0)   # way above setpoint: wants shrink
+    assert plane.tick(0.0) == {}     # tick_s not elapsed: rate-limited
+    clk.advance(1.1)
+    out = plane.tick(0.0)
+    assert out.get("chunk_s") == pytest.approx(0.5)
+    assert plane.state()["chunk_adjustments"] == 1
+
+
+def test_plane_span_whitelisting_rejects_non_numerics():
+    clk = FakeClock()
+    plane = _plane(clk)
+    plane.observe_chunk(None, None,
+                        span={"force_s": True, "gap_s": "bad"})
+    assert plane.chunk._samples == 0          # bool is not a latency
+    assert plane.window._gap.value is None
+    plane.observe_chunk(None, None, span={"force_s": 0.4, "gap_s": 0.1})
+    assert plane.chunk._samples == 1
+    assert plane.window._gap.value == pytest.approx(0.1)
+
+
+def test_plane_unsized_chunks_do_not_feed_the_sizing_loop():
+    """A mouse's wholesale split is small because the REQUEST is small —
+    its latency must not walk the chunk knob (module docstring)."""
+    clk = FakeClock()
+    plane = _plane(clk)
+    plane.observe_chunk(0.001, 1.0, sized=False)
+    assert plane.chunk._samples == 0
+    plane.observe_chunk(0.001, 1.0, sized=True)
+    assert plane.chunk._samples == 1
+
+
+def test_plane_effective_max_queued_semantics():
+    clk = FakeClock()
+    plane = _plane(clk)
+    assert plane.effective_max_queued(256) == 256   # no srv rate yet
+    plane.admission.observe_service_rate(100.0)
+    assert plane.effective_max_queued(256) == 30    # congestion knee
+    assert plane.effective_max_queued(16) == 16     # static is tighter
+    assert plane.effective_max_queued(0) == 30      # 0 = unbounded stock
+
+
+def test_plane_statically_disabled_knob_stays_disabled():
+    """chunk_s/small_s <= 0 is the repo 0-disables convention: the
+    controllers tune live knobs, they never re-enable one an operator
+    turned off."""
+    clk = FakeClock()
+    plane = _plane(clk, chunk_s=0.0, small_s=0.0)
+    assert plane.chunk is None and plane.window is None
+    assert plane.admission is not None
+
+
+def test_plane_histories_expose_clamps_for_the_audit():
+    clk = FakeClock()
+    plane = _plane(clk)
+    hist = plane.histories()
+    assert set(hist) == {"chunk", "window", "admit"}
+    floor, ceil, points = hist["chunk"]
+    assert (floor, ceil) == (ChunkSizeController.FLOOR_S,
+                             ChunkSizeController.CEIL_S)
+    assert len(points) == 1           # the seeded starting value
+
+
+# ------------------------------------------------- DBM_ADAPT=0 parity
+
+class FakeServer:
+    def __init__(self):
+        self.writes = []
+        self.closed = []
+
+    def write(self, conn_id, payload):
+        self.writes.append((conn_id, Message.from_json(payload)))
+
+    def close_conn(self, conn_id):
+        self.closed.append(conn_id)
+
+
+def _drive(sched):
+    sched._on_join(MINER_A)
+    sched._on_join(MINER_B)
+    sched._pool_rate = 100.0
+    sched._on_request(TEN_X, new_request("alpha", 0, 999))
+    sched._on_request(TEN_Y, new_request("beta", 0, 499))
+    sched._on_request(TEN_X, new_request("gamma", 0, 99))
+    for _ in range(400):
+        popped = None
+        for m in sched.miners:
+            if m.pending:
+                popped = m.pending[0]
+                sched._on_result(m.conn_id,
+                                 new_result(1_000_000 + popped.lower,
+                                            popped.lower))
+                break
+        if popped is None:
+            break
+
+
+def test_adapt_off_is_bit_for_bit_stock(monkeypatch):
+    """The tier-1 matrix-leg pin: DBM_ADAPT unset/0 builds NO plane, no
+    adapt metric series exist, and every write the scheduler emits is
+    identical to one built with the explicit disabled block."""
+    monkeypatch.delenv("DBM_ADAPT", raising=False)
+    assert not adapt_from_env().enabled
+    env_sched = Scheduler(FakeServer(), lease=LeaseParams(),
+                          qos=QosParams())           # adapt from env
+    off_sched = Scheduler(FakeServer(), lease=LeaseParams(),
+                          qos=QosParams(),
+                          adapt=AdaptParams(enabled=False))
+    assert env_sched.adapt_plane is None
+    assert off_sched.adapt_plane is None
+    _drive(env_sched)
+    _drive(off_sched)
+    assert [(c, m.to_json()) for c, m in env_sched.server.writes] == \
+        [(c, m.to_json()) for c, m in off_sched.server.writes]
+    snap = env_sched.metrics.snapshot()
+    for family in snap.values():
+        if isinstance(family, dict):
+            assert not any(k.startswith("adapt") for k in family), family
+
+
+def test_adapt_never_re_enables_disabled_planes():
+    """Code review (ISSUE 13): controllers mount only over LIVE knobs.
+    With QoS off there is no chunked path, no window grant, and no
+    admission gate — DBM_ADAPT=1 must not tune those dead knobs (or
+    report gauges for them); with only coalescing off, the window
+    controller alone stays unmounted."""
+    qos_off = Scheduler(FakeServer(), lease=LeaseParams(),
+                        qos=QosParams(enabled=False),
+                        adapt=AdaptParams(enabled=True))
+    plane = qos_off.adapt_plane
+    assert plane is not None
+    assert plane.chunk is None and plane.window is None \
+        and plane.admission is None
+    # Unmounted controllers register NO series: a permanent
+    # adapt_admit_rate=0.0 for a controller that does not exist reads
+    # as "admission fully closed" to an operator.
+    snap = qos_off.metrics.snapshot()
+    for family in snap.values():
+        if isinstance(family, dict):
+            assert not any(k.startswith("adapt") for k in family), \
+                family
+    from distributed_bitcoinminer_tpu.utils.config import CoalesceParams
+    co_off = Scheduler(FakeServer(), lease=LeaseParams(),
+                       qos=QosParams(),
+                       coalesce=CoalesceParams(enabled=False),
+                       adapt=AdaptParams(enabled=True))
+    plane = co_off.adapt_plane
+    assert plane.window is None
+    assert plane.chunk is not None and plane.admission is not None
+
+
+def test_adapt_on_quiescent_controllers_replies_identical():
+    """Default-on safety shape: with the plane MOUNTED but no tick
+    elapsed (tick_s huge) and the admission bucket open, the scripted
+    drive's writes are byte-identical to the off run — the observe
+    hooks are pure measurement."""
+    on = Scheduler(FakeServer(), lease=LeaseParams(), qos=QosParams(),
+                   adapt=AdaptParams(enabled=True, tick_s=1e9))
+    off = Scheduler(FakeServer(), lease=LeaseParams(), qos=QosParams(),
+                    adapt=AdaptParams(enabled=False))
+    assert on.adapt_plane is not None
+    _drive(on)
+    _drive(off)
+    assert [(c, m.to_json()) for c, m in on.server.writes] == \
+        [(c, m.to_json()) for c, m in off.server.writes]
+    state = on.adapt_plane.state()
+    assert state["chunk_adjustments"] == 0
+    assert state["admit_shed"] == 0

@@ -14,6 +14,7 @@ Invariants asserted (module docstring of lspnet/chaos.py):
 """
 
 import asyncio
+import statistics
 import time
 
 import pytest
@@ -466,6 +467,65 @@ def test_proc_storm_sigkill_twenty_seeds_exactly_once(tmp_path):
         finally:
             cluster.close()
         assert len(records) == 20
+        assert all(r["reply"] is not None for r in records)
+        # Fence-push handoff (ISSUE 13 satellite): every episode's
+        # displaced miner agent was back serving a survivor within the
+        # beat-driven window (router detection 3x0.15s + one-beat
+        # watcher poll + join), never parked on a long epoch wait —
+        # the canary bound is generous for a loaded box, and the
+        # discriminating slow-epoch proof lives in
+        # test_proc_storm_fence_push_beats_epoch_detection.
+        rejoins = [r["rejoin_s"] for r in records]
+        assert all(rj is not None for rj in rejoins), rejoins
+        assert statistics.median(rejoins) <= 1.5, rejoins
+    asyncio.run(scenario())
+
+
+def test_proc_storm_fence_push_beats_epoch_detection(tmp_path):
+    """THE discriminating handoff proof (ISSUE 13 satellite): cluster
+    processes run with SLOW LSP epochs (8 x 1s — conn-death detection
+    alone would park the displaced agent for ~8s) but the normal fast
+    beat cadence. A sub-2.5s rejoin is therefore only reachable
+    through the fence-push channel: router fences at ~3 missed beats,
+    the agent's membership watcher fires within one beat and closes
+    its own transport instead of waiting out the epoch. TWO agents
+    (thinnest-slice join puts one on each replica) make every seed
+    displace an agent — measure_rejoin waits for the FULL population
+    on survivors, so no seed can pass on router fence latency alone."""
+    from distributed_bitcoinminer_tpu.apps.procs import ProcCluster
+    from distributed_bitcoinminer_tpu.lspnet.chaos import (
+        generate_proc_storm, run_proc_episode)
+    env = dict(PROC_ENV, DBM_EPOCH_MILLIS="1000", DBM_EPOCH_LIMIT="8")
+
+    async def scenario():
+        cluster = ProcCluster(str(tmp_path), replicas=2, miners=2,
+                              env=env)
+        cluster.start()
+        records = []
+        try:
+            await cluster.wait_live(2, timeout_s=30.0, miners=2)
+            # One unasserted WARMUP episode: the very first kill can
+            # race the agents' initial join/settle cycle (observed
+            # once: an 8.8s first-episode rejoin that never recurs),
+            # and this test is about the steady-state handoff path.
+            (warm,) = generate_proc_storm(99, 1,
+                                          kinds=("kill_replica",))
+            await run_proc_episode(cluster, warm, proc_params())
+            await cluster.wait_live(2, timeout_s=30.0, miners=2)
+            for seed in range(100, 105):
+                (ep,) = generate_proc_storm(
+                    seed, 1, kinds=("kill_replica",))
+                records.append(await run_proc_episode(
+                    cluster, ep, proc_params()))
+                await cluster.wait_live(2, timeout_s=30.0, miners=2)
+        finally:
+            cluster.close()
+        rejoins = [r["rejoin_s"] for r in records]
+        assert all(rj is not None for rj in rejoins), rejoins
+        # A broken fence-push parks EVERY episode on the ~8s epoch
+        # wait; tolerate at most one load-jitter outlier.
+        fast = [rj for rj in rejoins if rj <= 2.5]
+        assert len(fast) >= len(rejoins) - 1, rejoins
         assert all(r["reply"] is not None for r in records)
     asyncio.run(scenario())
 

@@ -864,3 +864,59 @@ def test_spool_rotation_and_fenced_gc(tmp_path):
     b.put(("own", 0, 9, 0), (9, 9))
     assert gc_fenced_spools(d, m) == 0
     assert any(n.startswith("cache_1_") for n in os.listdir(d))
+
+
+# ----------------------------------- fence-push + sharded driver (ISSUE 13)
+
+
+def test_miner_agent_owner_gone_predicate():
+    """Fence-push (ISSUE 13 satellite): the agent's watcher fires when
+    its owner's rid leaves the advertised ring OR returns under a fresh
+    incarnation; a MISSING membership is no evidence (router restart —
+    epoch detection stays the backstop)."""
+    from distributed_bitcoinminer_tpu.apps.health import Membership
+    from distributed_bitcoinminer_tpu.apps.procs import MinerAgent
+    m = Membership()
+    m.admit(_beat(0, 1, inc="i0", port=7000))
+    assert not MinerAgent.owner_gone(m, 0, "i0")    # owner still live
+    assert MinerAgent.owner_gone(None, 0, "i0") is False   # no evidence
+    assert MinerAgent.owner_gone(m, 1, "i1")        # never admitted
+    m.declare_dead(0)
+    assert MinerAgent.owner_gone(m, 0, "i0")        # fenced: gone
+    m.admit(_beat(0, 1, inc="i0b", port=7000))      # respawned fresh
+    assert MinerAgent.owner_gone(m, 0, "i0")        # old conn is fenced
+    assert not MinerAgent.owner_gone(m, 0, "i0b")   # new one is the owner
+
+
+def test_adversarial_workloads_complete_and_ab_shape():
+    """The ISSUE 13 adversarial generators produce the measurement
+    shape detail.adapt consumes, on a small geometry: every request is
+    answered or shed with its conn closed, and the adaptive leg carries
+    its controllers' final state."""
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        WORKLOADS, run_adversarial)
+    assert set(WORKLOADS) == {"mice_stampede", "tenant_churn",
+                              "elephant_convoy"}
+    leg = run_adversarial("mice_stampede", adapt=False, tenants=60,
+                          duration_s=0.5, timeout_s=60.0)
+    assert leg["completed"] + leg["shed_requests"] >= leg["requests"]
+    assert not leg.get("timed_out")
+    leg = run_adversarial("tenant_churn", adapt=True, tenants=60,
+                          duration_s=0.5, timeout_s=60.0)
+    assert leg["completed"] + leg["shed_requests"] >= leg["requests"]
+    assert "adapt_state" in leg and "admit_rate" in leg["adapt_state"]
+
+
+def test_sharded_driver_merges_slices(tmp_path):
+    """drive_ring_tenants is the shared unit of a (possibly sharded)
+    --procs storm: with no membership published every tenant in the
+    slice resolves no owner and is reported shed — the parent's merge
+    accounting sees the whole slice either way."""
+    import asyncio
+    from distributed_bitcoinminer_tpu.apps.loadharness import \
+        drive_ring_tenants
+    out = asyncio.run(drive_ring_tenants(str(tmp_path), 0, 5, 2, 64,
+                                         timeout_s=10.0))
+    assert out["latencies"] == []
+    assert sorted(out["sheds"]) == [2] * 5          # 5 tenants x 2 reqs
+    assert not out["timed_out"]
