@@ -870,6 +870,206 @@ def _adapt_probe() -> dict:
     return adversarial_ab(rounds=rounds)
 
 
+def _mesh_mixed_pool() -> dict:
+    """Heterogeneous-pool storm (ISSUE 14, ``detail.mesh.mixed_pool``):
+    one 100x rate-skewed "mesh" miner (its EWMA seeded by the rate-hint
+    JOIN, never pinned) next to two host-tier miners under the REAL
+    scheduler on detnet; a chunked elephant plus mice drive grants
+    across the skew. Records per-miner GRANT SHARE (nonces written)
+    against the final rate-EWMA ratio — the acceptance rule is share
+    tracking the EWMA ratio within 25% for the dominant tier, with no
+    tier-aware placement code anywhere (the DRR/capacity planes do it).
+    """
+    import asyncio
+
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    from distributed_bitcoinminer_tpu.bitcoin.message import (
+        Message, MsgType, new_join, new_request)
+    from distributed_bitcoinminer_tpu.bitcoin.message import new_result
+    from distributed_bitcoinminer_tpu.lspnet.detnet import DetServer
+    from distributed_bitcoinminer_tpu.utils.config import (
+        AdaptParams, CoalesceParams, LeaseParams, QosParams,
+        StripeParams)
+
+    RATES = {"mesh": 200_000.0, "host_a": 2_000.0, "host_b": 2_000.0}
+    ELEPHANT = 150_000
+    granted: dict = {}
+
+    async def run() -> dict:
+        server = DetServer()
+        sched = Scheduler(
+            server,
+            lease=LeaseParams(grace_s=5.0, floor_s=2.0, tick_s=0.1,
+                              queue_alarm_s=30.0),
+            qos=QosParams(enabled=True, chunk_s=0.05, max_chunks=256,
+                          depth=2, wholesale_s=0.2),
+            stripe=StripeParams(enabled=False),
+            coalesce=CoalesceParams(enabled=False),
+            adapt=AdaptParams(enabled=False))
+        stask = asyncio.create_task(sched.run())
+        miner_tasks = []
+
+        async def miner(name: str, rate: float, hint: float) -> None:
+            chan = server.connect()
+            chan.write(new_join(rate=int(hint)).to_json())
+            try:
+                while True:
+                    msg = Message.from_json(await chan.read())
+                    if msg.type != MsgType.REQUEST:
+                        continue
+                    size = msg.upper - msg.lower + 1
+                    granted[name] = granted.get(name, 0) + size
+                    await asyncio.sleep(size / rate)
+                    # Deterministic non-oracle hash (loadharness idiom):
+                    # the probe measures PLACEMENT, not merges.
+                    chan.write(new_result(
+                        (1 << 50) + msg.lower, msg.lower).to_json())
+            except Exception:   # noqa: BLE001 — conn closed at teardown
+                return
+
+        # The wide miner announces itself via the rate-hint JOIN; the
+        # host tier warms through the pinned pool rate below.
+        miner_tasks.append(asyncio.create_task(
+            miner("mesh", RATES["mesh"], RATES["mesh"])))
+        for name in ("host_a", "host_b"):
+            miner_tasks.append(asyncio.create_task(
+                miner(name, RATES[name], 0)))
+        for _ in range(200):
+            if len(sched.miners) == 3:
+                break
+            await asyncio.sleep(0.01)
+        # Host tier warmed to its measured rate, pool pinned at the
+        # majority tier; the mesh miner's EWMA stays on its JOIN hint.
+        sched.miner_plane.pin_rates(RATES["host_a"])
+
+        async def client(data: str, upper: int) -> None:
+            chan = server.connect()
+            chan.write(new_request(data, 0, upper).to_json())
+            while True:
+                msg = Message.from_json(await chan.read())
+                if msg.type == MsgType.RESULT:
+                    await chan.close()
+                    return
+
+        jobs = [asyncio.create_task(client("mesh elephant",
+                                           ELEPHANT - 1))]
+        for j in range(4):
+            jobs.append(asyncio.create_task(
+                client(f"mesh mouse {j}", 499)))
+        await asyncio.wait_for(asyncio.gather(*jobs), 120)
+        ewmas = {}
+        for m in sched.miners:
+            ewmas[m.conn_id] = m.rate_ewma or 0.0
+        for t in miner_tasks:
+            t.cancel()
+        stask.cancel()
+        total = sum(granted.values()) or 1
+        rate_total = sum(RATES.values())
+        rows = {}
+        for name, rate in RATES.items():
+            share = granted.get(name, 0) / total
+            expect = rate / rate_total
+            rows[name] = {
+                "rate_nps": rate,
+                "granted_nonces": granted.get(name, 0),
+                "grant_share": round(share, 4),
+                "rate_share": round(expect, 4),
+                "tracking_error": round(abs(share - expect) / expect, 4)
+                if expect else None,
+            }
+        return {
+            "elephant_nonces": ELEPHANT,
+            "tiers": rows,
+            "leases_blown": sched.stats["leases_blown"],
+            # The acceptance gate: the wide tier's grant share tracks
+            # its rate share within 25%.
+            "share_tracks_rate_25pct":
+                rows["mesh"]["tracking_error"] is not None
+                and rows["mesh"]["tracking_error"] <= 0.25,
+        }
+
+    return asyncio.run(run())
+
+
+def _mesh_probe() -> dict:
+    """Mesh-plane probe (ISSUE 14, ``detail.mesh``) — ALSO the
+    ``MULTICHIP_r06.json`` artifact schema (``schema: mesh_scaling_v1``)
+    the chip chain records on real devices.
+
+    Per-device-count scaling sweep (1/2/4/8, capped at the available
+    device count): nonces/s of the carry-chained whole-mesh span,
+    device launches per span, host transfers per span (must be 1 — the
+    one-pair-per-span contract), and host-crossing BYTES per span (the
+    20-byte carry). On CPU the virtual devices share physical cores, so
+    the CPU curve proves overhead/correctness, not speedup — the
+    per-core efficiency field is what the chip run populates. Plus the
+    heterogeneous mixed-pool storm (:func:`_mesh_mixed_pool`).
+    ``DBM_BENCH_MESH=0`` skips.
+    """
+    import jax
+
+    from distributed_bitcoinminer_tpu.models import MeshNonceSearcher
+    from distributed_bitcoinminer_tpu.models.miner_model import \
+        _MET_LAUNCHES
+    from distributed_bitcoinminer_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    batch = (1 << 12) if platform == "cpu" else (1 << 20)
+    data = "bench mesh"
+    lower = 102_400_000                 # aligned, single 10^9 block
+    span = batch * 64
+    sweep = []
+    counts = [n for n in (1, 2, 4, 8) if n <= len(devices)]
+    for n in counts:
+        s = MeshNonceSearcher(data, batch=batch, mesh=make_mesh(n))
+        upper = lower + span - 1
+        s.search(lower, upper)          # warm: one compile per count
+        fetches = [0]
+        orig_get = jax.device_get
+
+        def counting_get(x, _f=fetches):
+            _f[0] += 1
+            return orig_get(x)
+
+        launches0 = _MET_LAUNCHES.value
+        jax.device_get = counting_get
+        t0 = time.perf_counter()
+        reps = 0
+        try:
+            while time.perf_counter() - t0 < 1.0:
+                s.search(lower, upper)
+                reps += 1
+        finally:
+            jax.device_get = orig_get
+        secs = time.perf_counter() - t0
+        launches_timed = _MET_LAUNCHES.value - launches0
+        handle = s.dispatch(lower, upper)
+        nbytes = int(getattr(handle, "nbytes", 0))
+        s.finalize(handle, lower)
+        sweep.append({
+            "n_devices": n,
+            "nps": round(span * reps / secs, 1),
+            "dispatches_per_span": round(launches_timed / reps, 3),
+            "host_transfers_per_span": round(fetches[0] / reps, 3),
+            "host_bytes_per_span": nbytes,
+        })
+    base = sweep[0]["nps"] if sweep else 0.0
+    for row in sweep:
+        row["efficiency_per_core"] = (
+            round(row["nps"] / (base * row["n_devices"]), 3)
+            if base else None)
+    return {
+        "schema": "mesh_scaling_v1",
+        "platform": platform,
+        "devices_available": len(devices),
+        "batch": batch,
+        "span_nonces": span,
+        "sweep": sweep,
+        "mixed_pool": _mesh_mixed_pool(),
+    }
+
+
 def main() -> int:
     from distributed_bitcoinminer_tpu.utils.config import probe_backend
     from distributed_bitcoinminer_tpu.utils.metrics import ensure_emitter
@@ -1171,6 +1371,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             adapt_detail = {"adapt": {"error": repr(exc)[:300]}}
 
+    # Mesh plane (ISSUE 14): per-device-count scaling sweep + the
+    # heterogeneous mixed-pool storm. The same dict is the
+    # MULTICHIP_r06.json artifact schema. DBM_BENCH_MESH=0 skips it.
+    mesh_detail = {}
+    if _str_env("DBM_BENCH_MESH", "1") != "0":
+        try:
+            mesh_detail = {"mesh": _mesh_probe()}
+        except Exception as exc:  # noqa: BLE001
+            mesh_detail = {"mesh": {"error": repr(exc)[:300]}}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
@@ -1204,6 +1414,7 @@ def main() -> int:
         **batch_detail,
         **load_detail,
         **adapt_detail,
+        **mesh_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
         # (midstate cache behavior, until-tier degradations) stay

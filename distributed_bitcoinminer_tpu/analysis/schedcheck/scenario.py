@@ -167,23 +167,28 @@ class FakeMiner:
       shape);
     - ``stock=True``: drops the difficulty target like a reference Go
       miner (answers the chunk arg-min, echoes no target) — the WEAK
-      merge shape.
+      merge shape;
+    - ``rate_hint``: nonces/s sent on the Join's Rate extension (the
+      ISSUE 14 rate-hint path — the scheduler seeds this miner's EWMA
+      from it instead of warming through traffic).
     """
 
     def __init__(self, ctx: "Ctx", name: str,
                  delay_fn: Optional[Callable[[int], float]] = None,
-                 wedge_after: Optional[int] = None, stock: bool = False):
+                 wedge_after: Optional[int] = None, stock: bool = False,
+                 rate_hint: float = 0.0):
         self.ctx = ctx
         self.name = name
         self.delay_fn = delay_fn or (lambda size: 0.0)
         self.wedge_after = wedge_after
         self.stock = stock
+        self.rate_hint = rate_hint
         self.chan = ctx.server.connect()
         self.answered = 0
 
     async def run(self) -> None:
         import asyncio
-        self.chan.write(new_join().to_json())
+        self.chan.write(new_join(rate=int(self.rate_hint)).to_json())
         while True:
             try:
                 payload = await self.chan.read()

@@ -700,6 +700,124 @@ class AdaptiveControl(Scenario):
         return out
 
 
+# ------------------------------------------------------------ wide_miner
+
+
+class WideMiner(Scenario):
+    """ISSUE 14 heterogeneous pool: one 100x rate-skewed "mesh" miner
+    (joins with the rate-hint JOIN — the scheduler seeds its EWMA from
+    the wire, no artificial pin) next to two slow host-tier miners,
+    under the REAL scheduler with QoS chunking, striping, and leases
+    all live on the virtual clock. A chunked elephant plus mice trains
+    drive grants across the skewed pool.
+
+    Invariants on top of the generic pack (exactly-once oracle-exact
+    replies, accounting balance, span closure):
+
+    - **No blown-lease storm from rate skew**: every lease is sized
+      from the answering miner's OWN rate (hint-seeded for the fast
+      miner, measured for the slow ones), so honest miners at 100x
+      different speeds must blow ZERO leases however the schedule
+      interleaves.
+    - **Plans stay inside clamps**: total Requests written to miners
+      is bounded by the chunk-plan cap + stripe depth per request — a
+      hint- or skew-driven mis-sizing that shatters a request into a
+      chunk storm fails here.
+    - **Rate-aware placement**: the fast miner ends the storm having
+      been granted at least as many nonces as either slow miner —
+      share follows the rate EWMAs through the existing DRR/capacity
+      planes, with no tier-aware code anywhere.
+    """
+
+    name = "wide_miner"
+
+    FAST_RATE = 100_000.0
+    SLOW_RATE = 1_000.0
+
+    def build(self, ctx: Ctx) -> None:
+        rng = ctx.rng
+        sched = _make_sched(ctx, lease=LeaseParams(
+            grace_s=5.0, factor=4.0, floor_s=2.0, tick_s=0.1,
+            queue_alarm_s=30.0), qos=QosParams(
+            enabled=True, chunk_s=0.2, max_chunks=32, depth=2,
+            wholesale_s=0.5),
+            stripe=StripeParams(enabled=True, chunk_s=0.3, depth=3))
+        # m0: the wide miner — 100x the host tier, EWMA seeded from its
+        # JOIN rate hint (the wire path under test). m1/m2: host tier.
+        self.fast = ctx.add_miner(
+            "m0", rate_hint=self.FAST_RATE,
+            delay_fn=lambda size, r=_fork(rng):
+                size / self.FAST_RATE * r.uniform(0.8, 1.2))
+        self.slow = [ctx.add_miner(
+            f"m{i}",
+            delay_fn=lambda size, r=_fork(rng):
+                size / self.SLOW_RATE * r.uniform(0.8, 1.2))
+            for i in (1, 2)]
+
+        async def warm():
+            # Slow miners warm to their measured tier; the POOL rate is
+            # pinned at the slow tier (the hint may have seeded it when
+            # the fast miner joined an empty pool) so elephant chunk
+            # plans are sized for the majority tier — the fast miner's
+            # PER-MINER hint is what the skew-handling must ride.
+            while ctx.sched is None or len(ctx.sched.miners) < 3:
+                await asyncio.sleep(0.01)
+            ctx.sched.miner_plane.pin_rates(self.SLOW_RATE)
+        ctx.spawn(warm())
+        # Elephant: chunked at the pinned 3x-slow-tier pool estimate
+        # (8000 > wholesale_s * rate * n = 1500).
+        self.n_requests = 1
+        ctx.add_client("elephant", [
+            Req(rng.choice(_DATA), 0, rng.choice((7999, 9999)),
+                pre_delay=0.5)])
+        for t, n in (("mice_a", rng.choice((2, 3))), ("mice_b", 2)):
+            reqs = [Req(f"{rng.choice(_DATA)}#{t}{j}", 0,
+                        rng.choice((99, 149)),
+                        pre_delay=0.5 + rng.uniform(0.0, 1.5))
+                    for j in range(n)]
+            self.n_requests += n
+            ctx.add_client(t, reqs)
+
+    def _granted_nonces(self, ctx: Ctx, conn_id: int) -> int:
+        total = 0
+        for payload in ctx.server.sent_to(conn_id):
+            msg = Message.from_json(payload)
+            if msg.type == MsgType.REQUEST:
+                total += msg.upper - msg.lower + 1
+        return total
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        stats = ctx.sched.stats
+        if stats["leases_blown"]:
+            out.append(
+                f"rate skew blew {stats['leases_blown']} lease(s) — "
+                f"per-miner rate sizing (hint-seeded for the wide "
+                f"miner) must keep honest miners inside their leases")
+        # Chunk/stripe plans inside clamps: per request at most
+        # max_chunks QoS chunks OR stripe.depth chunks per miner share,
+        # plus nothing re-issued (leases never blow here).
+        n_req = sum(1 for conn in
+                    [self.fast.chan.conn_id]
+                    + [m.chan.conn_id for m in self.slow]
+                    for payload in ctx.server.sent_to(conn)
+                    if Message.from_json(payload).type == MsgType.REQUEST)
+        bound = self.n_requests * max(32, 3 * 3)
+        if n_req > bound:
+            out.append(f"chunk storm: {n_req} miner Requests for "
+                       f"{self.n_requests} client requests "
+                       f"(clamp bound {bound})")
+        fast_n = self._granted_nonces(ctx, self.fast.chan.conn_id)
+        for m in self.slow:
+            slow_n = self._granted_nonces(ctx, m.chan.conn_id)
+            if fast_n < slow_n:
+                out.append(
+                    f"rate-aware placement inverted: 100x miner got "
+                    f"{fast_n} nonces, slow miner {m.name} got {slow_n}")
+        return out
+
+
 # -------------------------------------------------------- health_takeover
 
 class _ProcView:
@@ -1020,6 +1138,7 @@ SCENARIOS = {
     "batched_dispatch": BatchedDispatch,
     "difficulty_prefix": DifficultyPrefix,
     "plane_split": PlaneSplit,
+    "wide_miner": WideMiner,
     "replica_takeover": ReplicaTakeover,
     "adaptive_control": AdaptiveControl,
     "health_takeover": HealthTakeover,

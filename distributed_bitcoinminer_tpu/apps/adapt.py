@@ -216,7 +216,27 @@ class AimdValue:
 
 class ChunkSizeController:
     """Drive the chunk/stripe seconds-of-work knob toward a per-chunk
-    force-latency setpoint (module docstring, controller 1)."""
+    force-latency setpoint (module docstring, controller 1).
+
+    Per-miner mode (ISSUE 14 satellite, ``DBM_ADAPT_PER_MINER``,
+    default off): in a HETEROGENEOUS pool a 100x rate skew means one
+    pool-wide seconds-of-work value cannot hit both tiers' setpoints —
+    the mesh miner's chunks force in milliseconds while the host tier's
+    force in seconds, and the blended EWMA tunes for neither. With
+    ``per_miner`` the controller ALSO keys force-latency samples by
+    miner conn, and once the pool's rate EWMAs diverge past
+    ``PER_MINER_RATIO`` (:meth:`note_rate_ratio` — fed from the miner
+    plane's own EWMAs each tick) it forks a per-miner AIMD value
+    (seeded from the pool-wide value) per sampled miner and runs the
+    identical setpoint/settle logic per miner
+    (:meth:`tick_miners`). The per-miner values drive the STRIPE
+    planner through ``MinerPlane.chunk_s_overrides``; the pool-wide
+    value keeps driving the (miner-agnostic) QoS chunk plan. While the
+    pool is NOT diverged the per-miner state only accumulates samples
+    — one knob is enough, and forking it would just add noise."""
+
+    #: Rate-EWMA max/min ratio past which per-miner setpoints fork.
+    PER_MINER_RATIO = 4.0
 
     #: Hard clamps on seconds-of-work per chunk. The floor keeps a
     #: mispriced pool from shattering requests into confetti (and the
@@ -232,22 +252,31 @@ class ChunkSizeController:
     MARGIN_FLOOR = 0.25
 
     def __init__(self, value: float, setpoint_s: float, band: float,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 per_miner: bool = False):
         self.setpoint_s = setpoint_s
         self.band = band
+        self._clock = clock
+        self.per_miner = per_miner
         self.aimd = AimdValue(value, self.FLOOR_S, self.CEIL_S,
                               self.ADD_S, clock=clock)
         self._latency = _Ewma()
         self._min_margin: Optional[float] = None
         self._samples = 0
         self._settle = False
+        self._miners: Dict[int, dict] = {}
+        self._diverged = False
+        self._unfork_pending = False
 
     def observe(self, service_s: Optional[float],
                 margin_frac: Optional[float],
-                force_s: Optional[float] = None) -> None:
+                force_s: Optional[float] = None,
+                miner: Optional[int] = None) -> None:
         """One answered chunk: miner-side ``force_s`` span when it rode
         the Result, else the scheduler-side service time the lease plane
-        stamped; plus the chunk's remaining-lease fraction."""
+        stamped; plus the chunk's remaining-lease fraction. ``miner``
+        (the answering conn id) keys the per-miner sample stream when
+        per-miner mode is on."""
         lat = force_s if force_s is not None else service_s
         if lat is not None and lat >= 0:
             self._latency.observe(lat)
@@ -255,6 +284,94 @@ class ChunkSizeController:
         if margin_frac is not None:
             self._min_margin = margin_frac if self._min_margin is None \
                 else min(self._min_margin, margin_frac)
+        if self.per_miner and miner is not None:
+            st = self._miners.get(miner)
+            if st is None:
+                st = self._miners[miner] = {
+                    "lat": _Ewma(), "n": 0, "margin": None,
+                    "aimd": None, "settle": False}
+            if lat is not None and lat >= 0:
+                st["lat"].observe(lat)
+                st["n"] += 1
+            if margin_frac is not None:
+                st["margin"] = margin_frac if st["margin"] is None \
+                    else min(st["margin"], margin_frac)
+
+    def note_rate_ratio(self, ratio: Optional[float]) -> None:
+        """Current pool rate-EWMA max/min ratio (None when fewer than
+        two measured miners): the divergence gate for per-miner
+        forking."""
+        if self.per_miner:
+            self._diverged = (ratio is not None
+                              and ratio > self.PER_MINER_RATIO)
+
+    def forget_miner(self, miner: int) -> None:
+        """Retire a dropped miner's sample stream + forked value (conn
+        churn must not grow the map without bound)."""
+        self._miners.pop(miner, None)
+
+    def unfork_pending(self) -> bool:
+        """True ONCE after the pool re-converges with forked values
+        live: the caller must clear its per-miner overrides so the
+        pool-wide knob governs again (a stale fork would shadow it
+        forever — code review)."""
+        out = self._unfork_pending
+        self._unfork_pending = False
+        return out
+
+    def tick_miners(self) -> Dict[int, float]:
+        """Per-miner adjustment pass: ``{conn: new_chunk_s}`` for every
+        miner whose forked value moved this tick; empty while the pool
+        is not diverged (pool-wide value governs alone). Same AIMD +
+        hysteresis + margin guard + SETTLE-tick logic as the pool-wide
+        :meth:`tick`, per miner. While NOT diverged, each tick DRAINS
+        the per-miner sample accumulators (a later fork must decide
+        from fresh post-divergence samples, not latency/margin history
+        taken under long-gone chunk sizes — the same stale-sample rule
+        the pool-wide settle tick enforces) and retires any forked
+        values (flagging :meth:`unfork_pending`)."""
+        if not (self.per_miner and self._diverged):
+            for st in self._miners.values():
+                if st["n"] or st["margin"] is not None:
+                    st["lat"] = _Ewma()
+                    st["n"] = 0
+                    st["margin"] = None
+                if st["aimd"] is not None:
+                    st["aimd"] = None
+                    st["settle"] = False
+                    self._unfork_pending = True
+            return {}
+        out: Dict[int, float] = {}
+        for conn, st in self._miners.items():
+            if not st["n"]:
+                continue
+            lat = st["lat"].value
+            margin = st["margin"]
+            st["n"] = 0
+            st["margin"] = None
+            if st["settle"]:
+                st["settle"] = False
+                st["lat"] = _Ewma()
+                continue
+            if st["aimd"] is None:
+                # Forked at first divergence, seeded from the pool-wide
+                # value so the per-miner walk starts where the pool is.
+                st["aimd"] = AimdValue(self.aimd.value, self.FLOOR_S,
+                                       self.CEIL_S, self.ADD_S,
+                                       clock=self._clock)
+            changed = None
+            if (margin is not None and margin < self.MARGIN_FLOOR) or \
+                    lat > self.setpoint_s * (1 + self.band):
+                if st["aimd"].decrease():
+                    changed = st["aimd"].value
+            elif lat < self.setpoint_s * (1 - self.band):
+                if st["aimd"].increase():
+                    changed = st["aimd"].value
+            if changed is not None:
+                st["settle"] = True
+                st["lat"] = _Ewma()
+                out[conn] = changed
+        return out
 
     def tick(self) -> Optional[float]:
         """One adjustment interval; returns the new value or None.
@@ -498,7 +615,8 @@ class AdaptPlane:
         # 0-disables convention) stays disabled: the controllers tune
         # live knobs, they never re-enable what an operator turned off.
         self.chunk = (ChunkSizeController(
-            chunk_s, params.force_s, params.band, clock)
+            chunk_s, params.force_s, params.band, clock,
+            per_miner=params.per_miner)
             if params.chunk and chunk_s > 0 else None)
         self.window = (CoalesceWindowController(
             small_s, params.band, clock)
@@ -534,7 +652,8 @@ class AdaptPlane:
     def observe_chunk(self, service_s: Optional[float],
                       margin_frac: Optional[float],
                       span: Optional[dict] = None,
-                      sized: bool = True) -> None:
+                      sized: bool = True,
+                      miner: Optional[int] = None) -> None:
         """One popped chunk: scheduler-side service/margin plus the
         Result's span extension when it carried one (force_s feeds the
         chunk controller, gap_s the window controller). Span values are
@@ -558,9 +677,15 @@ class AdaptPlane:
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 gap_s = float(v)
         if self.chunk is not None and sized:
-            self.chunk.observe(service_s, margin_frac, force_s)
+            self.chunk.observe(service_s, margin_frac, force_s,
+                               miner=miner)
         if self.window is not None and gap_s is not None:
             self.window.observe_gap(gap_s)
+
+    def forget_miner(self, miner: int) -> None:
+        """Miner dropped: retire its per-miner controller state."""
+        if self.chunk is not None:
+            self.chunk.forget_miner(miner)
 
     def observe_arrival(self, small: bool) -> None:
         if self.window is not None:
@@ -596,13 +721,17 @@ class AdaptPlane:
     # ------------------------------------------------------------- ticks
 
     def tick(self, queue_age_s: float,
-             served_total: Optional[int] = None) -> Dict[str, float]:
+             served_total: Optional[int] = None,
+             rate_ratio: Optional[float] = None):
         """One sweep tick: rate-limited to ``params.tick_s``; returns
         the changed knob values for the scheduler to apply (empty dict
         = nothing moved). ``served_total`` is the scheduler's
         cumulative ``results_sent`` counter — the plane differentiates
         it into the service-rate anchor the admission controller
-        floors itself on."""
+        floors itself on. ``rate_ratio`` is the pool's rate-EWMA
+        max/min ratio (None below two measured miners) — the per-miner
+        chunk controller's divergence gate; per-miner changes come
+        back under the ``chunk_s_miner`` key as ``{conn: value}``."""
         now = self._clock()
         if now - self._last_apply < self.params.tick_s:
             return {}
@@ -613,13 +742,20 @@ class AdaptPlane:
                 self.admission.observe_service_rate(
                     (served_total - self._served_prev) / dt)
             self._served_prev = served_total
-        out: Dict[str, float] = {}
+        out: Dict[str, object] = {}
         if self.chunk is not None:
+            self.chunk.note_rate_ratio(rate_ratio)
             v = self.chunk.tick()
             if v is not None:
                 out["chunk_s"] = v
                 self._g_chunk.set(v)
                 self._c_adjust["chunk"].inc()
+            per = self.chunk.tick_miners()
+            if per:
+                out["chunk_s_miner"] = per
+                self._c_adjust["chunk"].inc(len(per))
+            if self.chunk.unfork_pending():
+                out["chunk_s_miner_clear"] = True
         if self.window is not None:
             v = self.window.tick()
             if v is not None:
@@ -633,8 +769,11 @@ class AdaptPlane:
                 self._c_adjust["admit"].inc()
                 out["admit_rate"] = v   # informational: applied in-plane
         if out and self._trace_on:
-            _tracing.flight("adapt", **{k: round(v, 6)
-                                        for k, v in out.items()})
+            _tracing.flight("adapt", **{
+                k: (round(v, 6) if isinstance(v, float)
+                    else {m: round(x, 6) for m, x in v.items()}
+                    if isinstance(v, dict) else v)
+                for k, v in out.items()})
         return out
 
     # ----------------------------------------------------------- queries

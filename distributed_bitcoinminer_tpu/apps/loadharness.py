@@ -140,7 +140,14 @@ def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
             1, max_queued // max(1, replicas)), **qos_kw)
         lease = LeaseParams(grace_s=120.0, floor_s=60.0,
                             queue_alarm_s=0.0)
+        # Adapt pinned OFF: this harness measures the REPLICA plane at
+        # known static knobs (BENCH_r06 comparability; the tier-1
+        # mini-load gate's completion bar assumes no admission
+        # controller) — the static-vs-adaptive A/B lives in
+        # run_adversarial, and DBM_ADAPT=1 is the production default
+        # since ISSUE 14.
         kw = dict(lease=lease, cache=CacheParams(enabled=False), qos=qos,
+                  adapt=AdaptParams(enabled=False),
                   recv_batch=recv_batch, trace_sample=trace_sample)
         if replicas > 1:
             coord = ReplicaSet(server, replicas, **kw)
@@ -592,7 +599,10 @@ def run_load_procs(tenants: int = 200, replicas: int = 2,
         statedir = tempfile.mkdtemp(prefix="dbm_loadprocs_")
         env = {"DBM_HEALTH_BEAT_S": "0.25", "DBM_HEALTH_MISS_K": "3",
                "DBM_EPOCH_MILLIS": "500", "DBM_EPOCH_LIMIT": "8",
-               "DBM_TRACE_SAMPLE": "0.01"}
+               "DBM_TRACE_SAMPLE": "0.01",
+               # Replica-plane measurement at static knobs (see the
+               # in-process legs' adapt pin above).
+               "DBM_ADAPT": "0"}
         cluster = ProcCluster(statedir, replicas=replicas, miners=miners,
                               env=env, fake_miners=True)
         cluster.start()

@@ -217,7 +217,10 @@ def default_searcher_factory(data: str, batch: Optional[int] = None,
                              tier: Optional[str] = None):
     """Pick the widest available compute plane for ``data``.
 
-    Multi-device -> mesh-sharded search; single device -> plain chunked scan;
+    Multi-device -> the ISSUE 14 mesh plane (carry-chained whole-mesh
+    spans, one host pair per span; ``DBM_MESH=0`` restores the round-3
+    sharded model — per-sub partials, stock local-device sharding
+    byte-for-byte); single device -> plain chunked scan;
     ``DBM_COMPUTE=host`` -> pure-host scan (no JAX), for boxes without
     accelerators and for process-level tests. ``tier`` pins the device
     kernel (jnp | pallas); None reads the environment default.
@@ -229,7 +232,8 @@ def default_searcher_factory(data: str, batch: Optional[int] = None,
 
     import jax
 
-    from ..models import NonceSearcher, ShardedNonceSearcher
+    from ..models import (MeshNonceSearcher, NonceSearcher,
+                          ShardedNonceSearcher)
     from ..parallel import make_mesh
     from ..utils.config import apply_jax_platform_env, jax_devices_robust
 
@@ -238,8 +242,9 @@ def default_searcher_factory(data: str, batch: Optional[int] = None,
     if batch is None:
         batch = (1 << 20) if devices[0].platform != "cpu" else (1 << 12)
     if len(devices) > 1:
-        return ShardedNonceSearcher(data, batch=batch, mesh=make_mesh(),
-                                    tier=tier)
+        cls = (MeshNonceSearcher if _int_env("DBM_MESH", 1) != 0
+               else ShardedNonceSearcher)
+        return cls(data, batch=batch, mesh=make_mesh(), tier=tier)
     return NonceSearcher(data, batch=batch, tier=tier)
 
 
@@ -271,7 +276,8 @@ class MinerWorker:
                  pipeline_depth: Optional[int] = None,
                  coalesce: Optional[bool] = None,
                  coalesce_lanes: Optional[int] = None,
-                 coalesce_max: Optional[int] = None):
+                 coalesce_max: Optional[int] = None,
+                 rate_hint: Optional[float] = None):
         self.hostport = hostport
         self.params = params
         self.searcher_factory = searcher_factory
@@ -299,6 +305,13 @@ class MinerWorker:
                              else _int_env("DBM_COALESCE_MAX", 1 << 20))
         if self.coalesce_max <= 0:
             self.coalesce = False    # repo 0-disables convention
+        # Rate-hint JOIN (ISSUE 14): a measured nonces/s figure sent on
+        # the Join so the scheduler's per-miner rate EWMA starts warm —
+        # a cold 1B-nps mesh must not warm up through mouse-sized
+        # chunks. None/0 = no hint (stock Join bytes). _run_miner
+        # resolves DBM_RATE_HINT (a number, or "probe" for a measured
+        # startup probe) and passes the value here.
+        self.rate_hint = max(0.0, rate_hint or 0.0)
         self._window = _ThroughputWindow()
         ensure_emitter()   # DBM_METRICS_INTERVAL_S-driven; 0 = no-op
         # Runtime sanitizer (ISSUE 7): DBM_SANITIZE=1 installs the
@@ -316,9 +329,11 @@ class MinerWorker:
         self._trace_last_done = 0.0   # previous chunk's finish stamp
 
     async def join(self) -> None:
-        """Connect and send Join (ref: miner.go:24-34)."""
+        """Connect and send Join (ref: miner.go:24-34). With a rate
+        hint the Join carries the Rate extension; hint-less Joins keep
+        reference-identical bytes (wire-compat pin: tests/test_mesh)."""
         self.client = await new_async_client(self.hostport, self.params)
-        self.client.write(new_join().to_json())
+        self.client.write(new_join(rate=int(self.rate_hint)).to_json())
 
     async def run(self) -> None:
         """Serve Requests until the connection dies (silent exit, like
@@ -913,6 +928,40 @@ def _probe_and_pin(cfg):
     return cfg
 
 
+def measure_rate_hint(searcher, probe_nonces: int = 1 << 17) -> float:
+    """Measured startup throughput probe (nonces/s) for the rate-hint
+    JOIN: one warm pass (pays compile + midstate build), one timed pass
+    over an adjacent same-pow2 window (same jit signature). Both
+    windows sit inside one aligned 10^9 block so the geometry matches
+    steady-state serving. Returns 0.0 on any failure — no hint beats a
+    made-up one."""
+    base = 100_000_000
+    try:
+        searcher.search(base, base + probe_nonces - 1)
+        t0 = time.monotonic()
+        searcher.search(base + probe_nonces, base + 2 * probe_nonces - 1)
+        return probe_nonces / max(time.monotonic() - t0, 1e-6)
+    except Exception:
+        logger.exception("rate-hint probe failed; joining without a hint")
+        return 0.0
+
+
+def _resolve_rate_hint(factory, batch) -> float:
+    """``DBM_RATE_HINT`` semantics: unset/0 = no hint; a number = the
+    operator's measured figure (e.g. a chip-chain BENCH artifact);
+    ``probe`` = measure here with :func:`measure_rate_hint` (runs on
+    the caller's worker thread — searcher construction touches JAX
+    backend init, the loop-block class)."""
+    from ..utils._env import str_env
+    raw = str_env("DBM_RATE_HINT", "0")
+    if raw.strip().lower() == "probe":
+        return measure_rate_hint(factory("dbm rate probe", batch))
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 0.0
+
+
 async def _run_miner(hostport: str) -> int:
     from ..utils import from_env
     from ..utils.config import apply_jax_platform_env
@@ -937,8 +986,13 @@ async def _run_miner(hostport: str) -> int:
         factory = lambda data, batch: PodSearcher(data, batch)  # noqa: E731
     else:
         factory = lambda data, batch: cfg.make_searcher(data)   # noqa: E731
+    # Rate-hint JOIN (ISSUE 14): resolved off-loop — the "probe" mode
+    # constructs a searcher (JAX backend init) and runs two timed spans.
+    rate_hint = await asyncio.to_thread(_resolve_rate_hint, factory,
+                                        cfg.batch)
     worker = MinerWorker(hostport, params=cfg.params,
-                         searcher_factory=factory, batch=cfg.batch)
+                         searcher_factory=factory, batch=cfg.batch,
+                         rate_hint=rate_hint)
     try:
         try:
             await worker.join()

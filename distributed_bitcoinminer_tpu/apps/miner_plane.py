@@ -110,6 +110,11 @@ class MinerState:
     rate_ewma: Optional[float] = None
     blown_streak: int = 0
     quarantined: bool = False
+    # Rate-hint JOIN (ISSUE 14): True while rate_ewma holds the miner's
+    # OWN (bounded, decaying) claim rather than an observed sample; the
+    # first real throughput window REPLACES the hint instead of
+    # blending with it.
+    rate_hinted: bool = False
     # Windowed throughput sampling (ISSUE 5; see observe_result): the
     # wall-clock window currently accumulating answered nonces. Per-pop
     # size/elapsed sampling is a lie under the pipelined miner — a
@@ -148,6 +153,14 @@ class MinerPlane:
     #: accounting, the scheduler-side analog of the miner's
     #: _ThroughputWindow from ISSUE 4).
     RATE_WINDOW_S = 0.5
+    #: Rate-hint JOIN bounds (ISSUE 14): the seeded EWMA is clamped to
+    #: the cap (no miner may claim more than ~1T nonces/s — a v4 pod is
+    #: ~10^11) and DECAYED by this factor per sweep until a real
+    #: throughput window confirms or replaces it, so a stale or
+    #: overclaimed hint bleeds away instead of oversizing stripe plans
+    #: forever on a miner that never answers.
+    RATE_HINT_CAP = 1e12
+    RATE_HINT_DECAY = 0.98
 
     def __init__(self, metrics: Registry, count: Callable[..., None],
                  lease: LeaseParams, stripe: StripeParams,
@@ -170,6 +183,14 @@ class MinerPlane:
         self._by_conn: dict[int, MinerState] = {}   # O(1) lookup (ISSUE 11)
         self.parked: list[Chunk] = []           # chunks of dropped miners
         self.pool_rate: Optional[float] = None  # pool-wide throughput EWMA
+        #: True while pool_rate holds only a JOIN hint (ISSUE 14): the
+        #: first real window sample REPLACES it, and it decays like the
+        #: per-miner hint until then.
+        self._pool_hinted = False
+        #: Per-miner chunk-seconds overrides (ISSUE 14 satellite: the
+        #: DBM_ADAPT_PER_MINER setpoints). Consulted by stripe_chunks;
+        #: written by the scheduler's adapt apply; retired on drop.
+        self.chunk_s_overrides: dict[int, float] = {}
         self._next_coalesce_id = 0
         self._pool_size = metrics.gauge("pool_size")
         self._pool_quarantined = metrics.gauge("pool_quarantined")
@@ -190,10 +211,26 @@ class MinerPlane:
     def find_miner(self, conn_id: int) -> Optional[MinerState]:
         return self._by_conn.get(conn_id)
 
-    def on_join(self, conn_id: int) -> MinerState:
+    def on_join(self, conn_id: int, rate_hint: float = 0.0) -> MinerState:
         """A joining miner immediately absorbs one parked chunk, if any
-        (ref: server.go:222-244)."""
+        (ref: server.go:222-244). ``rate_hint`` (nonces/s, 0 = none —
+        every stock miner) seeds the rate EWMA BOUNDED at
+        ``RATE_HINT_CAP`` and flagged unconfirmed, so lease sizing and
+        stripe plans treat a cold 1B-nps mesh as wide from its first
+        chunk — the hint is seeded before the parked-chunk absorption
+        below so even that first lease is sized from it."""
         miner = MinerState(conn_id=conn_id)
+        if rate_hint > 0:
+            miner.rate_ewma = min(float(rate_hint), self.RATE_HINT_CAP)
+            miner.rate_hinted = True
+            self.metrics.gauge("miner_rate_nps",
+                               miner=str(conn_id)).set(miner.rate_ewma)
+            if self.pool_rate is None:
+                # An empty pool's first hinted miner IS the pool; a
+                # warm pool's EWMA is measurement and outranks claims.
+                self.pool_rate = miner.rate_ewma
+                self._pool_hinted = True
+                self.metrics.gauge("pool_rate_nps").set(self.pool_rate)
         chunk = self.next_parked()
         if chunk is not None:
             self.assign_chunk(miner, chunk, kind="parked")
@@ -228,6 +265,7 @@ class MinerPlane:
         if miner is None:
             return None
         self.miners.remove(miner)
+        self.chunk_s_overrides.pop(conn_id, None)
         self.update_pool_gauges()
         # Retire the dead conn-id's labeled series: stale values must
         # not linger in snapshots, and reconnect churn (every rejoin
@@ -235,6 +273,7 @@ class MinerPlane:
         # bound over a long server life.
         self.metrics.remove("miner_rate_nps", miner=str(conn_id))
         self.metrics.remove("lease_remaining_s", miner=str(conn_id))
+        self.metrics.remove("adapt_chunk_s_miner", miner=str(conn_id))
         return miner
 
     def recover(self, miner: MinerState) -> None:
@@ -396,7 +435,13 @@ class MinerPlane:
             else self.pool_rate
         if rate is None or rate <= 0:
             return 1
-        target = max(1, int(rate * self.stripe.chunk_s))
+        # Per-miner setpoint override (DBM_ADAPT_PER_MINER) over the
+        # pool-wide knob: in a 100x-skewed heterogeneous pool one
+        # seconds-of-work value cannot hit both tiers' force-latency
+        # setpoints.
+        chunk_s = self.chunk_s_overrides.get(miner.conn_id,
+                                             self.stripe.chunk_s)
+        target = max(1, int(rate * chunk_s))
         return max(1, min(self.stripe.depth, -(-share // target)))
 
     def observe_stripe(self, n_chunks: int) -> None:
@@ -519,6 +564,15 @@ class MinerPlane:
             if elapsed >= self.RATE_WINDOW_S:
                 rate = miner.win_nonces / elapsed
                 miner.win_t0, miner.win_nonces = now, 0
+                # A JOIN rate hint is a CLAIM: the first real window
+                # sample replaces it outright (blending a 100x-off
+                # claim in would poison the EWMA for many windows).
+                if miner.rate_hinted:
+                    miner.rate_hinted = False
+                    miner.rate_ewma = None
+                if self._pool_hinted:
+                    self._pool_hinted = False
+                    self.pool_rate = None
                 miner.rate_ewma = rate if miner.rate_ewma is None else \
                     alpha * rate + (1 - alpha) * miner.rate_ewma
                 self.pool_rate = rate if self.pool_rate is None else \
@@ -535,6 +589,52 @@ class MinerPlane:
             self.update_pool_gauges()
             self._lease_event("quarantine_lifted", chunk, miner.conn_id)
             self._dispatch()
+
+    def decay_rate_hints(self) -> None:
+        """One sweep tick of unconfirmed rate-hint decay (ISSUE 14):
+        hinted EWMAs bleed toward zero until a real throughput window
+        confirms a measured rate — a stale/overclaimed hint on a miner
+        that never answers must stop inflating stripe plans and leases
+        within a bounded horizon (half-life ~34 ticks at 0.98)."""
+        for m in self.miners:
+            if m.rate_hinted and m.rate_ewma:
+                m.rate_ewma *= self.RATE_HINT_DECAY
+                self.metrics.gauge("miner_rate_nps",
+                                   miner=str(m.conn_id)).set(m.rate_ewma)
+        if self._pool_hinted and self.pool_rate:
+            self.pool_rate *= self.RATE_HINT_DECAY
+            self.metrics.gauge("pool_rate_nps").set(self.pool_rate)
+
+    def set_chunk_s_override(self, conn_id: int, chunk_s: float) -> None:
+        """Per-miner chunk-seconds setpoint (ISSUE 14 satellite,
+        ``DBM_ADAPT_PER_MINER``): the adapt plane's per-miner chunk
+        controller writes its value here; :meth:`stripe_chunks` sizes
+        that miner's stripe chunks from it instead of the pool-wide
+        knob. Gauge retired with the miner (:meth:`drop_miner`)."""
+        self.chunk_s_overrides[conn_id] = chunk_s
+        self.metrics.gauge("adapt_chunk_s_miner",
+                           miner=str(conn_id)).set(chunk_s)
+
+    def clear_chunk_s_overrides(self) -> None:
+        """The pool re-converged (adapt un-fork): every per-miner
+        setpoint retires — a stale fork must not shadow the live
+        pool-wide knob — and the labeled gauges go with them."""
+        for conn_id in self.chunk_s_overrides:
+            self.metrics.remove("adapt_chunk_s_miner",
+                                miner=str(conn_id))
+        self.chunk_s_overrides.clear()
+
+    def pin_rates(self, rate: float, include_hinted: bool = False) -> None:
+        """Test/bench/scenario helper: pin every (by default un-hinted)
+        miner's rate EWMA and the POOL rate to ``rate``, clearing the
+        pool's hint flag — the one blessed way to warm a harness pool
+        without reaching into the hint bookkeeping (the rate-hint JOIN
+        path stays live for hinted miners)."""
+        for m in self.miners:
+            if include_hinted or not m.rate_hinted:
+                m.rate_ewma = rate
+        self.pool_rate = rate
+        self._pool_hinted = False
 
     def service_sample(self, chunk: Chunk):
         """``(service_s, margin_frac)`` of a JUST-POPPED chunk for the

@@ -125,7 +125,8 @@ class ReplicaSet:
 
     def __init__(self, server, n: Optional[int] = None, *,
                  lease=None, cache: Optional[CacheParams] = None,
-                 stripe=None, qos=None, coalesce=None, clock=None,
+                 stripe=None, qos=None, coalesce=None, adapt=None,
+                 clock=None,
                  recv_batch: Optional[int] = None,
                  trace_sample: Optional[float] = None):
         self.server = server
@@ -138,7 +139,7 @@ class ReplicaSet:
         for rid in range(self.n):
             sched = Scheduler(
                 server, lease=lease, cache=cache, stripe=stripe, qos=qos,
-                coalesce=coalesce, clock=clock,
+                coalesce=coalesce, adapt=adapt, clock=clock,
                 result_cache=self.shared_cache, recv_batch=recv_batch,
                 trace_sample=trace_sample)
             sched._next_job_id = rid * self.JOB_ID_STRIDE
@@ -236,7 +237,7 @@ class ReplicaSet:
             rid = min(self.live,
                       key=lambda r: len(self.replicas[r].miners))
             self._miner_owner[conn_id] = rid
-            self.replicas[rid]._on_join(conn_id)
+            self.replicas[rid]._on_join(conn_id, msg)
         elif msg.type == MsgType.RESULT:
             rid = self._miner_owner.get(conn_id)
             if rid is not None and rid in self.live:

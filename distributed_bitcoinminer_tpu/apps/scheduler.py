@@ -614,7 +614,7 @@ class Scheduler:
         except ValueError:
             return
         if msg.type == MsgType.JOIN:
-            self._on_join(conn_id)
+            self._on_join(conn_id, msg)
         elif msg.type == MsgType.REQUEST:
             self._on_request(conn_id, msg)
         elif msg.type == MsgType.RESULT:
@@ -635,6 +635,7 @@ class Scheduler:
         so the replica tier can drive each replica's sweep."""
         if self.lease.enabled:
             self._check_leases()
+        self.miner_plane.decay_rate_hints()
         self._check_queue_age()
         if self.adapt_plane is not None:
             self._apply_adapt()
@@ -663,10 +664,35 @@ class Scheduler:
         head = self.tenant_plane.head()
         age = (time.monotonic() - head.queued_at) if head is not None \
             else 0.0
+        # Pool rate divergence (ISSUE 14, DBM_ADAPT_PER_MINER): the
+        # per-miner chunk setpoints fork only once MEASURED rate EWMAs
+        # spread past the controller's ratio gate — hinted (unconfirmed)
+        # claims are excluded, or a miner could fork the pool off a
+        # wire claim before any Result confirms it (code review); the
+        # O(miners) scan is also skipped entirely when no per-miner
+        # controller is mounted.
+        ratio = None
+        chunk_ctl = self.adapt_plane.chunk
+        if chunk_ctl is not None and chunk_ctl.per_miner:
+            ewmas = [m.rate_ewma for m in self.miner_plane.miners
+                     if m.rate_ewma and not m.rate_hinted]
+            if len(ewmas) >= 2:
+                ratio = max(ewmas) / max(min(ewmas), 1e-9)
         changes = self.adapt_plane.tick(
-            age, self._counters["results_sent"].value)
+            age, self._counters["results_sent"].value, rate_ratio=ratio)
         if not changes:
             return
+        if changes.get("chunk_s_miner_clear"):
+            # The pool re-converged: the forks retired, and the stale
+            # overrides must stop shadowing the live pool-wide knob.
+            self.miner_plane.clear_chunk_s_overrides()
+        per = changes.get("chunk_s_miner")
+        if per:
+            # Per-miner stripe setpoints land on the miner plane's
+            # override map (gauge + drop-retirement live there).
+            for conn, v in per.items():
+                if self.miner_plane.find_miner(conn) is not None:
+                    self.miner_plane.set_chunk_s_override(conn, v)
         v = changes.get("chunk_s")
         if v is not None:
             # Write the plane's block directly, NOT through the qos
@@ -779,10 +805,17 @@ class Scheduler:
                     self._shed(self.tenant_plane.pop_head(), "overload")
         self._maybe_dispatch()
 
-    def _on_join(self, conn_id: int) -> None:
+    def _on_join(self, conn_id: int, msg: Optional[Message] = None) -> None:
+        """``msg`` carries the optional Rate hint (ISSUE 14); callers on
+        the pre-split surface (tests, embedded drivers) may omit it —
+        a hint-less join is the stock path bit-for-bit."""
         if self._owner is not None:
             self._owner.assert_here()
-        self.miner_plane.on_join(conn_id)
+        rate_hint = float(msg.rate) if msg is not None else 0.0
+        self.miner_plane.on_join(conn_id, rate_hint=rate_hint)
+        if rate_hint > 0:
+            logger.info("miner %d joined with rate hint %.3g nonces/s",
+                        conn_id, rate_hint)
         self._maybe_dispatch()
 
     def _on_result(self, conn_id: int, msg: Message) -> None:
@@ -803,7 +836,8 @@ class Scheduler:
             service_s, margin = self.miner_plane.service_sample(chunk)
             self.adapt_plane.observe_chunk(
                 service_s, margin, span=msg.span,
-                sized=curr is not None and curr.qos_mode == "chunked")
+                sized=curr is not None and curr.qos_mode == "chunked",
+                miner=conn_id)
         if curr is None:
             stale = self.tenant_plane.traces.get(chunk.job_id)
             if stale is not None:
@@ -878,6 +912,8 @@ class Scheduler:
         if miner is not None:
             logger.info("miner %d dropped", conn_id)
             self.miner_plane.drop_miner(conn_id)
+            if self.adapt_plane is not None:
+                self.adapt_plane.forget_miner(conn_id)
             # Export-track retirement (ISSUE 10): same churn rule as the
             # labeled series — a dead conn id's track must free its slot
             # under the cardinality bound.

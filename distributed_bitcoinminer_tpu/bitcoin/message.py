@@ -56,12 +56,15 @@ class Message:
     nonce: int = 0
     target: int = 0   # extension; 0 = absent (stock bytes)
     span: dict = None  # trace extension; None = absent (stock bytes)
+    rate: int = 0     # JOIN rate-hint extension; 0 = absent (stock bytes)
 
     def to_json(self) -> bytes:
         tail = f',"Target":{self.target}' if self.target else ""
         if self.span:
             tail += ',"Span":%s' % json.dumps(
                 self.span, sort_keys=True, separators=(",", ":"))
+        if self.rate:
+            tail += f',"Rate":{self.rate}'
         return (
             '{"Type":%d,"Data":%s,"Lower":%d,"Upper":%d,"Hash":%d,"Nonce":%d%s}'
             % (int(self.type), _go_json_string(self.data), self.lower, self.upper,
@@ -107,6 +110,14 @@ class Message:
         span = obj.get("Span")
         if not isinstance(span, dict):
             span = None
+        # Rate is a scheduling HINT (ISSUE 14 rate-hint JOIN): like Span,
+        # a malformed value from a hostile or buggy peer drops to 0 (no
+        # hint) rather than killing a JOIN that is otherwise valid — the
+        # scheduler treats an unhinted miner exactly like a stock one.
+        rate = obj.get("Rate", 0)
+        if isinstance(rate, bool) or not isinstance(rate, int) \
+                or not 0 <= rate < (1 << 64):
+            rate = 0
         return cls(
             type=MsgType(type_value),
             data=obj.get("Data", ""),
@@ -116,6 +127,7 @@ class Message:
             nonce=u64("Nonce"),
             target=u64("Target"),
             span=span,
+            rate=rate,
         )
 
     def __str__(self) -> str:
@@ -127,8 +139,14 @@ class Message:
         return "[Join]"
 
 
-def new_join() -> Message:
-    return Message(type=MsgType.JOIN)
+def new_join(rate: int = 0) -> Message:
+    """``rate``: measured throughput hint in nonces/s (ISSUE 14 mesh
+    plane) — a cold 1B-nps pod announces its width at JOIN so the
+    scheduler's rate EWMA starts warm instead of feeding it mouse-sized
+    chunks. 0 (the default, and every stock miner) serializes to
+    reference-identical bytes; the hint is advisory and bounded/decayed
+    scheduler-side until real Results confirm it."""
+    return Message(type=MsgType.JOIN, rate=rate)
 
 
 def new_request(data: str, lower: int, upper: int, target: int = 0) -> Message:

@@ -1,6 +1,6 @@
 """Model layer: the flagship nonce-search program and its host orchestration."""
 
 from .miner_model import NonceSearcher
-from .sharded import ShardedNonceSearcher
+from .sharded import MeshNonceSearcher, ShardedNonceSearcher
 
-__all__ = ["NonceSearcher", "ShardedNonceSearcher"]
+__all__ = ["NonceSearcher", "ShardedNonceSearcher", "MeshNonceSearcher"]

@@ -255,20 +255,17 @@ class NonceSearcher:
         blocks do (ascending, strict-less, earliest nonce on ties).
 
         One helper shared by every dispatch path (single-device + mesh,
-        argmin + difficulty) so the sizing rule can't drift between them.
+        argmin + difficulty) so the sizing rule can't drift between
+        them; the decomposition itself is ``parallel.partition.
+        pow2_subs`` — ONE copy of the pow2 policy for this path and the
+        mesh plane's window chains alike.
         """
+        from ..parallel.partition import pow2_subs
         per = per_step if per_step is not None else self.batch
         i0 = (plan.lo_i // self.batch) * self.batch
         span = plan.hi_i - i0 + 1
         n = (span + per - 1) // per
-        subs = []
-        start = i0
-        while n > 0:
-            p = 1 << (n.bit_length() - 1)  # largest pow2 <= n
-            subs.append((start, p))
-            start += p * per
-            n -= p
-        return subs
+        return [(i0 + off * per, p) for off, p in pow2_subs(n)]
 
     def search_block(self, plan: _BlockPlan) -> list:
         """Dispatch one block as pow2 sub-dispatches; returns a list of
@@ -415,7 +412,16 @@ class NonceSearcher:
             for plan in s.plan(lower, upper):
                 hoist_keys = (frozenset(plan.hoist_ops)
                               if plan.hoist is not None else None)
-                for i0, nbatches in s._sub_dispatches(plan):
+                # per_step pinned to the SINGLE-device step: the segmin
+                # launch scans nbatches*batch lanes per row, so a
+                # subclass whose default _sub_dispatches sizes steps for
+                # a WIDER plane (the mesh models' batch*n_devices) would
+                # hand this path under-covering rows — observed as wrong
+                # argmins when the coalescer batched sharded searchers
+                # on a multi-device box (ISSUE 14 regression fix, pinned
+                # by tests/test_mesh.py::test_sharded_dispatch_batch_covers).
+                for i0, nbatches in s._sub_dispatches(plan,
+                                                      per_step=s.batch):
                     gkey = (plan.rem, plan.k, plan.template.shape[0],
                             nbatches, hoist_keys)
                     groups.setdefault(gkey, []).append((ei, s, plan, i0))

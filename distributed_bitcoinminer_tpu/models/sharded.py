@@ -11,11 +11,16 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..bitcoin.hash import MAX_U64
 from ..parallel.mesh_search import (device_spans, make_mesh,
+                                    mesh_carry_init, mesh_search_span,
+                                    mesh_search_span_until,
+                                    mesh_until_carry_init,
                                     sharded_search_span,
                                     sharded_search_span_until)
+from ..parallel.partition import device_windows, pow2_subs
 from ..utils.trace import observe_launch as _observe_launch
-from .miner_model import NonceSearcher
+from .miner_model import _MET_LAUNCHES, NonceSearcher
 
 
 class ShardedNonceSearcher(NonceSearcher):
@@ -94,3 +99,148 @@ class ShardedNonceSearcher(NonceSearcher):
                 raise
             self._degrade_until("sharded pallas until tier")
             return self._until_sub(plan, i0, nbatches, t_hi, t_lo)
+
+
+class MeshNonceSearcher(ShardedNonceSearcher):
+    """The ISSUE 14 mesh plane: one whole-mesh span dispatch, ONE
+    ``(hash, nonce)`` pair crossing the host.
+
+    Differences from :class:`ShardedNonceSearcher` (which it replaces
+    as the multi-device default under ``DBM_MESH=1``):
+
+    - **Per-core stripe windows**: each 10^k block's valid lane window
+      is cut into ``n_devices`` contiguous EVEN windows (the scheduler
+      stripe-plan shape applied inside the miner,
+      ``parallel.partition.device_windows``) instead of fixed
+      batch-aligned device spans masked by a global window — so every
+      core's VALID work stays balanced to within one lane batch, where
+      a tail-of-block window previously left leading devices hashing
+      fully masked lanes.
+    - **Carry-chained launches**: the running best rides ON DEVICE as a
+      replicated carry vector threaded through every pow2 sub and every
+      block (``parallel.mesh_search.mesh_search_span``); the on-device
+      lexicographic min-hash all-reduce folds each launch's mesh-merged
+      candidate — with the block base already combined into a GLOBAL
+      64-bit nonce — into it. ``finalize`` fetches the final carry ONCE:
+      exactly one (hash, nonce) result crosses the host per span,
+      however many blocks/subs the span decomposes into (today's tier
+      fetches one partial triple per sub).
+    - **Operand placement by rule table**: every launch's operands
+      travel as one named pytree placed by
+      ``parallel.partition.MESH_PARTITION_RULES``.
+
+    The two-phase ``dispatch``/``finalize`` contract is unchanged
+    (``dispatch`` returns the final carry handle with every launch
+    enqueued asynchronously; ``finalize`` forces it), so the miner
+    pipeline overlaps whole-mesh spans exactly like before. The
+    coalescer's ``dispatch_batch`` is inherited: coalesced mice ride
+    the single-device segmin path (correct, narrower — mice on a pod
+    are not what the pod is for).
+    """
+
+    def _mesh_block(self, plan, carry, t_hi=None, t_lo=None,
+                    tier: str | None = None):
+        """Chain one block's pow2 sub-launches onto ``carry`` over the
+        per-core stripe windows; returns the new carry (unforced)."""
+        tier = tier if tier is not None else self.tier
+        i0_d, lo_d, hi_d, steps = device_windows(
+            plan.lo_i, plan.hi_i, self.n_devices, self.batch)
+        until = t_hi is not None
+        base = {"base_hi": np.uint32(plan.base >> 32),
+                "base_lo": np.uint32(plan.base & 0xFFFFFFFF)}
+        for off, p in pow2_subs(steps):
+            _MET_LAUNCHES.inc()
+            ops = {"carry": carry,
+                   "midstate": np.asarray(plan.midstate, dtype=np.uint32),
+                   "template": plan.template,
+                   "i0_d": i0_d + np.uint32(off * self.batch),
+                   "lo_d": lo_d, "hi_d": hi_d, **base}
+            if plan.hoist_ops is not None:
+                ops["hoist"] = plan.hoist_ops
+            if until:
+                ops["target_hi"] = t_hi
+                ops["target_lo"] = t_lo
+                with _observe_launch(("mesh_search_span_until", tier,
+                                      plan.rem, plan.k, self.batch, p,
+                                      self.n_devices)):
+                    carry = mesh_search_span_until(
+                        ops, mesh=self.mesh, rem=plan.rem, k=plan.k,
+                        batch=self.batch, nbatches=p,
+                        tier=tier)  # dbmlint: ok[jit-static] two-valued jnp|pallas set (ctor-validated), resolved per block for the sticky until degradation
+            else:
+                with _observe_launch(("mesh_search_span", tier,
+                                      plan.rem, plan.k, self.batch, p,
+                                      self.n_devices)):
+                    carry = mesh_search_span(
+                        ops, mesh=self.mesh, rem=plan.rem, k=plan.k,
+                        batch=self.batch, nbatches=p,
+                        tier=tier)  # dbmlint: ok[jit-static] two-valued jnp|pallas set (ctor-validated), resolved per block for the sticky until degradation
+        return carry
+
+    def dispatch(self, lower: int, upper: int):
+        """Enqueue the whole span as one carry chain; the handle is the
+        final carry (a single replicated device value)."""
+        if lower > upper:
+            raise ValueError("empty range")
+        carry = mesh_carry_init()
+        for plan in self.plan(lower, upper):
+            carry = self._mesh_block(plan, carry)
+        return carry
+
+    def finalize(self, handle, lower: int) -> tuple[int, int]:
+        """ONE host fetch per span: the 5-word carry. The ``seen`` word
+        mirrors finalize's seen-flag (a real all-ones hash is kept; an
+        all-sentinel span — impossible for a non-empty range — answers
+        like an empty scan)."""
+        import jax
+
+        v = jax.device_get(handle)
+        if not int(v[4]):
+            return (MAX_U64, lower)
+        return ((int(v[0]) << 32) | int(v[1]),
+                (int(v[2]) << 32) | int(v[3]))
+
+    def search(self, lower: int, upper: int) -> tuple[int, int]:
+        return self.finalize(self.dispatch(lower, upper), lower)
+
+    def search_until(self, lower: int, upper: int,
+                     target: int) -> tuple[int, int, bool]:
+        """Difficulty mode on the carry chain: one fetch per BLOCK (the
+        inter-block early exit — a hit skips every later block's scan
+        entirely), with the per-device in-kernel early exit inside each
+        launch. Within a block all subs chain before the fetch, so the
+        first-hit rule rides the carry's min-qualifying-nonce merge
+        rather than fetch order. Same sticky pallas->jnp degradation as
+        the sharded model: a failing Mosaic until kernel recomputes the
+        block on the jnp tier from the block-start carry (idempotent
+        re-scan)."""
+        import jax
+
+        if lower > upper:
+            raise ValueError("empty range")
+        t_hi = np.uint32(target >> 32)
+        t_lo = np.uint32(target & 0xFFFFFFFF)
+        carry = mesh_until_carry_init()
+        v = None
+        for plan in self.plan(lower, upper):
+            block_start = carry
+            tier = "jnp" if self._until_degraded else self.tier
+            try:
+                carry = self._mesh_block(plan, carry, t_hi, t_lo,
+                                         tier=tier)
+                v = jax.device_get(carry)
+            except Exception:
+                if tier != "pallas":
+                    raise
+                self._degrade_until("mesh pallas until tier")
+                carry = self._mesh_block(plan, block_start, t_hi, t_lo,
+                                         tier="jnp")
+                v = jax.device_get(carry)
+            if int(v[0]):
+                from ..bitcoin.hash import hash_op
+                f_nonce = (int(v[1]) << 32) | int(v[2])
+                return (hash_op(self.data, f_nonce), f_nonce, True)
+        if v is not None and int(v[7]):
+            return ((int(v[3]) << 32) | int(v[4]),
+                    (int(v[5]) << 32) | int(v[6]), False)
+        return (MAX_U64, lower, False)

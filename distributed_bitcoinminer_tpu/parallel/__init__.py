@@ -5,16 +5,28 @@ range (ref: bitcoin/server/server.go:165-205). Here that axis is sharded at
 two nested levels: across LSP-registered miners (scheduler, unchanged
 protocol) and across TPU cores inside one miner via ``shard_map`` over a 1-D
 ``jax.sharding.Mesh`` with a staged-pmin lexicographic-min merge on ICI.
+Since ISSUE 14, operand placement is declared by the partition-rule table
+(``partition.py``, fmengine style) and the mesh plane chains a replicated
+on-device carry through every launch so one whole-mesh span crosses the
+host as exactly one (hash, nonce) pair.
 """
 
-from .mesh_search import (AXIS, device_spans, make_mesh, sharded_search_span,
+from .mesh_search import (AXIS, device_spans, make_mesh, mesh_carry_init,
+                          mesh_search_span, mesh_search_span_until,
+                          mesh_until_carry_init, sharded_search_span,
                           sharded_search_span_until)
 from .multihost import (PodSearcher, broadcast_job, broadcast_stop,
                         global_mesh, initialize_multihost, is_lsp_owner,
                         run_follower)
+from .partition import (MESH_PARTITION_RULES, device_windows,
+                        match_partition_rules, mesh_specs, pow2_subs)
 
 __all__ = ["AXIS", "device_spans", "make_mesh", "sharded_search_span",
            "sharded_search_span_until",
+           "mesh_search_span", "mesh_search_span_until",
+           "mesh_carry_init", "mesh_until_carry_init",
+           "MESH_PARTITION_RULES", "match_partition_rules", "mesh_specs",
+           "device_windows", "pow2_subs",
            "PodSearcher", "broadcast_job", "broadcast_stop",
            "global_mesh", "initialize_multihost", "is_lsp_owner",
            "run_follower"]

@@ -45,10 +45,14 @@ def shard_map(f, **kw):
     return _shard_map(f, **kw, **_SHARD_MAP_KW)
 
 from ..ops.search import span_scan_body, span_until_body
+from .partition import AXIS, device_windows, mesh_specs, pow2_subs
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
 
-AXIS = "d"
+__all__ = ["AXIS", "make_mesh", "device_spans", "device_windows",
+           "pow2_subs", "sharded_search_span", "sharded_search_span_until",
+           "mesh_search_span", "mesh_search_span_until",
+           "mesh_carry_init", "mesh_until_carry_init"]
 
 
 def _pmin_lex_argmin(b_hi, b_lo, b_idx):
@@ -95,17 +99,18 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, hoist=None, *,
 
     Returns replicated (best_hi, best_lo, best_i) uint32 scalars.
     """
-    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
-    template = jnp.asarray(template, dtype=jnp.uint32)
-    hoist_in = () if hoist is None else (hoist,)
+    operands = {"midstate": jnp.asarray(midstate, dtype=jnp.uint32),
+                "template": jnp.asarray(template, dtype=jnp.uint32),
+                "i0_d": jnp.asarray(i0_d, dtype=jnp.uint32),
+                "lo_i": jnp.uint32(lo_i), "hi_i": jnp.uint32(hi_i)}
+    if hoist is not None:
+        operands["hoist"] = hoist
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(), P(), P(AXIS), P(), P()) + ((P(),) if hoist_in
-                                                 else ()),
-        out_specs=(P(), P(), P()))
-    def body(midstate, template, i0, lo_i, hi_i, *hoist_in):
-        hoist = hoist_in[0] if hoist_in else None
+        in_specs=(mesh_specs(operands),), out_specs=(P(), P(), P()))
+    def body(ops):
+        hoist = ops.get("hoist")
         # The pallas tier runs everywhere since round 3: through Mosaic on
         # the chip, through the Mosaic TPU simulator (InterpretParams) on
         # the CPU test mesh — the wrapper derives interpret mode from the
@@ -117,19 +122,20 @@ def sharded_search_span(midstate, template, i0_d, lo_i, hi_i, hoist=None, *,
         if tier == "pallas":
             from ..ops.sha256_pallas import pallas_argmin
             hi_h, lo_h, idx = pallas_argmin(
-                midstate, template, i0[0], lo_i, hi_i,
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_i"], ops["hi_i"],
                 rem=rem, k=k, total=batch * nbatches,
                 platform=mesh.devices.flat[0].platform, vma=(AXIS,),
                 hoist=hoist)
         else:
             hi_h, lo_h, idx = span_scan_body(
-                midstate, template, i0[0], lo_i, hi_i,
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_i"], ops["hi_i"],
                 rem=rem, k=k, batch=batch, nbatches=nbatches,
                 vary_axes=(AXIS,), hoist=hoist)
         return _pmin_lex_argmin(hi_h, lo_h, idx)
 
-    return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
-                jnp.uint32(lo_i), jnp.uint32(hi_i), *hoist_in)
+    return body(operands)
 
 
 @functools.partial(
@@ -160,27 +166,34 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
     recomputed by the model layer from the host oracle when ``found`` —
     models.miner_model._until_block).
     """
-    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
-    template = jnp.asarray(template, dtype=jnp.uint32)
-    hoist_in = () if hoist is None else (hoist,)
+    operands = {"midstate": jnp.asarray(midstate, dtype=jnp.uint32),
+                "template": jnp.asarray(template, dtype=jnp.uint32),
+                "i0_d": jnp.asarray(i0_d, dtype=jnp.uint32),
+                "lo_i": jnp.uint32(lo_i), "hi_i": jnp.uint32(hi_i),
+                "target_hi": jnp.uint32(target_hi),
+                "target_lo": jnp.uint32(target_lo)}
+    if hoist is not None:
+        operands["hoist"] = hoist
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(), P(), P(AXIS), P(), P(), P(), P()) + (
-            (P(),) if hoist_in else ()),
-        out_specs=(P(),) * 5)
-    def body(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *hoist_in):
-        hoist = hoist_in[0] if hoist_in else None
+        in_specs=(mesh_specs(operands),), out_specs=(P(),) * 5)
+    def body(ops):
+        hoist = ops.get("hoist")
         if tier == "pallas":
             from ..ops.sha256_pallas import pallas_until
             found, f_idx, b_hi, b_lo, b_idx = pallas_until(
-                midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_i"], ops["hi_i"], ops["target_hi"],
+                ops["target_lo"],
                 rem=rem, k=k, total=batch * nbatches,
                 platform=mesh.devices.flat[0].platform, vma=(AXIS,),
                 hoist=hoist)
         else:
             found, f_idx, b_hi, b_lo, b_idx = span_until_body(
-                midstate, template, i0[0], lo_i, hi_i, t_hi, t_lo,
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_i"], ops["hi_i"], ops["target_hi"],
+                ops["target_lo"],
                 rem=rem, k=k, batch=batch, nbatches=nbatches,
                 vary_axes=(AXIS,), hoist=hoist)
         # First qualifying nonce globally = min of per-device first hits
@@ -192,9 +205,7 @@ def sharded_search_span_until(midstate, template, i0_d, lo_i, hi_i,
         # hit, in which case every device scanned its full span).
         return g_found, g_idx, *_pmin_lex_argmin(b_hi, b_lo, b_idx)
 
-    return body(midstate, template, jnp.asarray(i0_d, dtype=jnp.uint32),
-                jnp.uint32(lo_i), jnp.uint32(hi_i),
-                jnp.uint32(target_hi), jnp.uint32(target_lo), *hoist_in)
+    return body(operands)
 
 
 def device_spans(i0: int, n_devices: int, batch: int, nbatches: int) -> np.ndarray:
@@ -202,3 +213,180 @@ def device_spans(i0: int, n_devices: int, batch: int, nbatches: int) -> np.ndarr
     per = batch * nbatches
     return (np.uint32(i0) +
             np.arange(n_devices, dtype=np.uint32) * np.uint32(per))
+
+
+# --------------------------------------------------------------------------
+# ISSUE 14 mesh plane: carry-chained whole-span dispatch.
+#
+# The round-3 entries above return one replicated triple PER SUB-DISPATCH;
+# a whole chunk's pow2 sub-dispatches (and its several 10^k blocks) then
+# merge on the HOST — one device fetch per partial. The carry-chained
+# entries below keep the running best ON DEVICE: each launch folds its
+# mesh-merged candidate into a replicated carry vector it received as an
+# operand, so a whole-mesh SPAN — however many blocks and pow2 subs it
+# decomposes into — sends exactly ONE (hash, nonce) result to the host,
+# fetched once at finalize (models/sharded.MeshNonceSearcher). The carry
+# holds the GLOBAL 64-bit nonce (block base folded in on device), so the
+# chain crosses block boundaries.
+#
+# Merge rule: full lexicographic strict-less on (hash, nonce) among seen
+# candidates — exactly "the minimal hash, earliest nonce on ties", which
+# is what finalize's ascending strict-less-on-hash walk computes. The
+# full lex (not hash-only) matters here because the per-core stripe
+# windows interleave lane coverage across chained subs: device 0's
+# second sub covers LOWER nonces than device 1's first, so chain order
+# is not nonce order and the tie-break must be explicit.
+
+#: Carry layouts (uint32 words).
+#: argmin: [hash_hi, hash_lo, nonce_hi, nonce_lo, seen]
+#: until:  [found, f_nonce_hi, f_nonce_lo] + the argmin layout.
+CARRY_WORDS = 5
+UNTIL_CARRY_WORDS = 8
+
+
+def mesh_carry_init() -> np.ndarray:
+    """Neutral argmin carry: nothing seen yet."""
+    return np.array([0xFFFFFFFF] * 4 + [0], dtype=np.uint32)
+
+
+def mesh_until_carry_init() -> np.ndarray:
+    """Neutral difficulty carry: no hit, nothing seen."""
+    return np.array([0, 0xFFFFFFFF, 0xFFFFFFFF]
+                    + [0xFFFFFFFF] * 4 + [0], dtype=np.uint32)
+
+
+def _lex_less(a, b):
+    """Strict lexicographic ``a < b`` over matching leading words of two
+    uint32 vectors (element 0 most significant)."""
+    out = a[-1] < b[-1]
+    for i in range(len(a) - 2, -1, -1):
+        out = (a[i] < b[i]) | ((a[i] == b[i]) & out)
+    return out
+
+
+def _global_nonce(base_hi, base_lo, idx):
+    """64-bit ``base + idx`` as a (hi, lo) uint32 pair (idx < 2^32; the
+    unsigned-add wrap test carries into the high word)."""
+    n_lo = base_lo + idx
+    return base_hi + (n_lo < idx).astype(jnp.uint32), n_lo
+
+
+def _scan_windows(ops, *, mesh, rem, k, batch, nbatches, tier):
+    """Shared per-device window scan of the carry-chained bodies."""
+    hoist = ops.get("hoist")
+    if tier == "pallas":
+        from ..ops.sha256_pallas import pallas_argmin
+        return pallas_argmin(
+            ops["midstate"], ops["template"], ops["i0_d"][0],
+            ops["lo_d"][0], ops["hi_d"][0],
+            rem=rem, k=k, total=batch * nbatches,
+            platform=mesh.devices.flat[0].platform, vma=(AXIS,),
+            hoist=hoist)
+    return span_scan_body(
+        ops["midstate"], ops["template"], ops["i0_d"][0],
+        ops["lo_d"][0], ops["hi_d"][0],
+        rem=rem, k=k, batch=batch, nbatches=nbatches,
+        vary_axes=(AXIS,), hoist=hoist)
+
+
+def _fold_argmin(carry, m_hi, m_lo, m_idx, base_hi, base_lo):
+    """Fold one launch's mesh-merged candidate into the argmin carry."""
+    valid = ~((m_hi == _MAX_U32) & (m_lo == _MAX_U32)
+              & (m_idx == _MAX_U32))
+    n_hi, n_lo = _global_nonce(base_hi, base_lo, m_idx)
+    cand = jnp.stack([m_hi, m_lo, n_hi, n_lo])
+    prev = carry[:4]
+    better = valid & ((carry[4] == 0) | _lex_less(cand, prev))
+    best = jnp.where(better, cand, prev)
+    seen = jnp.where(better, jnp.uint32(1), carry[4])
+    return jnp.concatenate([best, seen[None]])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "rem", "k", "batch", "nbatches", "tier"))
+def mesh_search_span(operands, *, mesh: Mesh, rem: int, k: int,
+                     batch: int, nbatches: int, tier: str = "jnp"):
+    """One carry-chained whole-mesh launch over per-core stripe windows.
+
+    ``operands`` is the NAMED pytree the partition-rule table places
+    (``parallel/partition.py``): ``carry`` (5-word running best,
+    replicated), ``midstate``/``template``/``base_hi``/``base_lo``/
+    optional ``hoist`` (replicated), and the per-device stripe windows
+    ``i0_d``/``lo_d``/``hi_d`` (device-sharded). Returns the UPDATED
+    replicated carry — a device value the caller threads into the next
+    launch (or fetches once per span).
+    """
+    specs = mesh_specs(operands)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(specs,),
+                       out_specs=P())
+    def body(ops):
+        hi_h, lo_h, idx = _scan_windows(
+            ops, mesh=mesh, rem=rem, k=k, batch=batch,
+            nbatches=nbatches, tier=tier)
+        m_hi, m_lo, m_idx = _pmin_lex_argmin(hi_h, lo_h, idx)
+        return _fold_argmin(ops["carry"], m_hi, m_lo, m_idx,
+                            ops["base_hi"], ops["base_lo"])
+
+    return body(operands)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "rem", "k", "batch", "nbatches", "tier"))
+def mesh_search_span_until(operands, *, mesh: Mesh, rem: int, k: int,
+                           batch: int, nbatches: int, tier: str = "jnp"):
+    """Carry-chained difficulty launch: like :func:`mesh_search_span`
+    plus the first-hit plane. ``operands`` additionally carries
+    ``target_hi``/``target_lo`` (replicated) and the 8-word until carry.
+
+    First-hit merge: the globally first qualifying nonce is the MINIMUM
+    qualifying nonce — each device's until body reports its window's
+    first hit, the mesh ``pmin`` takes the lowest lane, and the carry
+    keeps the lex-lower 64-bit qualifying nonce across chained launches
+    (chain order is not nonce order under the interleaved stripe
+    windows, so the min — not first-write-wins — is the correct rule).
+    The argmin fallback folds exactly like :func:`mesh_search_span`.
+    """
+    specs = mesh_specs(operands)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(specs,),
+                       out_specs=P())
+    def body(ops):
+        hoist = ops.get("hoist")
+        if tier == "pallas":
+            from ..ops.sha256_pallas import pallas_until
+            found, f_idx, b_hi, b_lo, b_idx = pallas_until(
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_d"][0], ops["hi_d"][0],
+                ops["target_hi"], ops["target_lo"],
+                rem=rem, k=k, total=batch * nbatches,
+                platform=mesh.devices.flat[0].platform, vma=(AXIS,),
+                hoist=hoist)
+        else:
+            found, f_idx, b_hi, b_lo, b_idx = span_until_body(
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_d"][0], ops["hi_d"][0],
+                ops["target_hi"], ops["target_lo"],
+                rem=rem, k=k, batch=batch, nbatches=nbatches,
+                vary_axes=(AXIS,), hoist=hoist)
+        carry = ops["carry"]
+        # First-hit plane: min qualifying lane across the mesh, then the
+        # lex-min qualifying 64-bit nonce across the chain.
+        g_idx = jax.lax.pmin(f_idx, AXIS)
+        cand_found = g_idx != _MAX_U32
+        f_hi, f_lo = _global_nonce(ops["base_hi"], ops["base_lo"], g_idx)
+        fcand = jnp.stack([f_hi, f_lo])
+        prev_f = carry[1:3]
+        f_better = cand_found & ((carry[0] == 0)
+                                 | _lex_less(fcand, prev_f))
+        new_f = jnp.where(f_better, fcand, prev_f)
+        new_found = jnp.maximum(carry[0], cand_found.astype(jnp.uint32))
+        # Argmin fallback plane (answers when the whole span misses).
+        m_hi, m_lo, m_idx = _pmin_lex_argmin(b_hi, b_lo, b_idx)
+        tail = _fold_argmin(carry[3:], m_hi, m_lo, m_idx,
+                            ops["base_hi"], ops["base_lo"])
+        return jnp.concatenate([new_found[None], new_f, tail])
+
+    return body(operands)

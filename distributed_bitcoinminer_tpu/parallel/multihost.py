@@ -186,14 +186,25 @@ def _receive_job():
     return data, lower, upper, target
 
 
+def _pod_searcher_cls():
+    """The pod's per-host program: the ISSUE 14 mesh plane by default
+    (``DBM_MESH=1`` — carry-chained spans, one host pair per span on the
+    owner), the round-3 sharded model under ``DBM_MESH=0``. ONE knob
+    read shared by owner and followers: the pod is lockstep SPMD, so
+    both sides must lower the identical program (deployments export the
+    knob identically across hosts, like every other pod knob)."""
+    from ..models import MeshNonceSearcher, ShardedNonceSearcher
+    return (MeshNonceSearcher if _int_env("DBM_MESH", 1) != 0
+            else ShardedNonceSearcher)
+
+
 class PodSearcher:
     """Owner-side searcher: broadcast the job, then run the global-mesh
     sharded search that every host executes in lockstep."""
 
     def __init__(self, data: str, batch: Optional[int] = None):
-        from ..models import ShardedNonceSearcher
         self.data = data
-        self.inner = ShardedNonceSearcher(
+        self.inner = _pod_searcher_cls()(
             data, batch=batch or (1 << 20), mesh=global_mesh())
 
     def search(self, lower: int, upper: int):
@@ -231,10 +242,10 @@ def run_follower(batch: Optional[int] = None,
     follower (exit 17) when it expires.
     """
     from ..apps.miner import MinerWorker
-    from ..models import ShardedNonceSearcher
     if cache_size is None:
         cache_size = MinerWorker.SEARCHER_CACHE_SIZE
-    searchers: OrderedDict[str, ShardedNonceSearcher] = OrderedDict()
+    searcher_cls = _pod_searcher_cls()
+    searchers: OrderedDict[str, object] = OrderedDict()
     mesh = global_mesh()
     # A malformed knob falls back silently (the _env contract): a typo
     # must not crash the follower and wedge the pod.
@@ -248,8 +259,7 @@ def run_follower(batch: Optional[int] = None,
         data, lower, upper, target = job
         s = searchers.get(data)
         if s is None:
-            s = ShardedNonceSearcher(data, batch=batch or (1 << 20),
-                                     mesh=mesh)
+            s = searcher_cls(data, batch=batch or (1 << 20), mesh=mesh)
             searchers[data] = s
             while len(searchers) > cache_size:
                 searchers.popitem(last=False)

@@ -1,0 +1,154 @@
+"""Declarative partition-rule table for the mesh plane (ISSUE 14).
+
+The mesh entry points used to declare operand placement as positional
+``in_specs`` tuples hand-maintained per call site — adding one operand
+(the ISSUE 14 carry, the per-device stripe windows) meant re-counting
+three tuples in two functions and hoping they stayed aligned with the
+argument order. This module replaces that with the fmengine idiom
+(SNIPPETS.md §1, ``match_partition_rules``): operands travel as ONE
+NAMED pytree, and a regex rule table maps each leaf's '/'-joined name
+to its :class:`~jax.sharding.PartitionSpec`. The table is the single
+declaration of how the mesh plane lands data:
+
+- **replicated** (``P()``): the midstate, tail template, hoist
+  precompute, block base, difficulty target, and the running carry —
+  every device holds the same value; XLA ships it once.
+- **device-sharded** (``P(AXIS)``): the per-device stripe windows
+  (``i0_d`` / ``lo_d`` / ``hi_d``) — one scalar per device, the
+  contiguous window that device scans.
+
+Scalars (0-d leaves) are never partitioned, exactly like the fmengine
+rule. An operand with no matching rule is a hard error: a silently
+replicated sharded operand (or vice versa) is a correctness bug, not a
+default.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+#: The 1-D mesh axis every rule refers to (kept in one place with the
+#: rules; ``mesh_search`` re-exports it).
+AXIS = "d"
+
+#: The mesh plane's rule table: ``(name_regex, PartitionSpec)`` pairs,
+#: first match wins. Names are '/'-joined paths through the operand
+#: pytree (``hoist/cw`` etc. for the hoist operand dict).
+MESH_PARTITION_RULES = (
+    # Per-device stripe windows: one entry per device on the mesh axis.
+    (r"^(i0|lo|hi)_d$", P(AXIS)),
+    # Everything else the span scan consumes is replicated: the carry,
+    # midstate, template, block base words, difficulty target words,
+    # and every hoist precompute leaf.
+    (r"^carry$", P()),
+    (r"^(midstate|template)$", P()),
+    (r"^base_(hi|lo)$", P()),
+    (r"^target_(hi|lo)$", P()),
+    (r"^hoist(/.+)?$", P()),
+)
+
+
+def named_tree_map(fn, tree, sep: str = "/", _prefix: str = ""):
+    """Map ``fn(name, leaf)`` over a dict pytree, names '/'-joined.
+
+    Only dicts recurse (the operand trees here are dicts of arrays /
+    dicts); every other value is a leaf. Key order is preserved, so the
+    result structure matches the input structure exactly — what lets
+    the caller hand the result to ``shard_map`` as the in_specs pytree
+    for the matching operand argument.
+    """
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            name = _prefix + k
+            if isinstance(v, dict):
+                out[k] = named_tree_map(fn, v, sep=sep, _prefix=name + sep)
+            else:
+                out[k] = fn(name, v)
+        return out
+    return fn(_prefix.rstrip(sep), tree)
+
+
+def match_partition_rules(rules, operands: dict):
+    """PartitionSpec pytree for a named operand pytree (fmengine style).
+
+    ``rules`` is ``((regex, spec), ...)``; first match wins. 0-d /
+    size-1 leaves are never partitioned (``P()``) regardless of rules —
+    the fmengine scalar rule. A leaf matching no rule raises: partition
+    placement is a declared contract, not a default.
+    """
+    def spec_for(name, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches operand {name!r}")
+    return named_tree_map(spec_for, operands)
+
+
+def mesh_specs(operands: dict):
+    """The mesh plane's specs for one operand dict (rule table above)."""
+    return match_partition_rules(MESH_PARTITION_RULES, operands)
+
+
+def device_windows(lo_i: int, hi_i: int, n_devices: int,
+                   batch: int):
+    """Per-core stripe windows: cut the valid lane window ``[lo_i,
+    hi_i]`` into ``n_devices`` CONTIGUOUS ascending equal-ish windows
+    (the scheduler's stripe-plan shape, applied inside one miner), and
+    align each device's scan start down to its batch boundary.
+
+    Returns ``(i0_d, lo_d, hi_d, nbatches)`` — three ``(n,)`` uint32
+    arrays plus the per-device step count that covers the WIDEST
+    aligned window (every device runs the same static step count;
+    narrower/empty windows mask). Why this beats the round-1-style
+    fixed per-device spans with a global window: a window occupying the
+    tail of its 10^k block left the leading devices hashing fully
+    MASKED lanes (masked lanes still burn compute) — even windows keep
+    every core's VALID work balanced within one lane-batch.
+
+    Trailing devices of a narrow window get an EMPTY window
+    (``lo > hi``): every lane masks to the sentinel, which never wins
+    the merge.
+    """
+    span = hi_i - lo_i + 1
+    if span <= 0:
+        raise ValueError("empty window")
+    per = -(-span // n_devices)           # ceil: lanes per device
+    i0_d = np.zeros(n_devices, dtype=np.uint32)
+    lo_d = np.ones(n_devices, dtype=np.uint32)
+    hi_d = np.zeros(n_devices, dtype=np.uint32)   # lo>hi == empty
+    steps = 1
+    for d in range(n_devices):
+        lo = lo_i + d * per
+        if lo > hi_i:
+            continue                      # empty window, stays masked
+        hi = min(lo + per - 1, hi_i)
+        i0 = (lo // batch) * batch        # aligned scan start
+        lo_d[d] = lo
+        hi_d[d] = hi
+        i0_d[d] = i0
+        steps = max(steps, -(-(hi - i0 + 1) // batch))
+    return i0_d, lo_d, hi_d, steps
+
+
+def pow2_subs(nbatches: int) -> list:
+    """Descending-pow2 decomposition of a step count: ``(offset_steps,
+    pow2_steps)`` pairs covering exactly ``nbatches`` steps. Same
+    rationale as ``NonceSearcher._sub_dispatches`` — the step count is
+    a static jit argument, so it must stay within the bounded pow2
+    value set or every odd-sized window mints a fresh compile."""
+    subs = []
+    off = 0
+    n = nbatches
+    while n > 0:
+        p = 1 << (n.bit_length() - 1)
+        subs.append((off, p))
+        off += p
+        n -= p
+    return subs

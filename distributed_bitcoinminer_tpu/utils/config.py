@@ -226,6 +226,24 @@ Environment variables:
   accelerator probe (default 120; 0 skips — apps/miner
   _pin_platform_if_backend_wedged). On probe failure the miner pins
   itself to CPU instead of hanging in backend init.
+- ``DBM_MESH`` (default 1): the ISSUE 14 mesh plane. Multi-device
+  boxes serve through ``models.MeshNonceSearcher`` — per-core stripe
+  windows cut by the partition-rule table
+  (``parallel/partition.py``), carry-chained whole-mesh launches with
+  the on-device lexicographic min-hash all-reduce, and exactly ONE
+  (hash, nonce) pair crossing the host per span. ``DBM_MESH=0``
+  restores the round-3 ``ShardedNonceSearcher`` (per-sub partials,
+  stock local-device sharding) byte-for-byte — the tier-1 matrix leg
+  pins it. The pod path (``parallel/multihost.PodSearcher`` and its
+  followers) reads the same knob, which must agree across hosts.
+- ``DBM_RATE_HINT`` (default 0 = no hint): the miner's JOIN rate hint
+  in nonces/s. A number is sent as the Join's ``Rate`` extension so
+  the scheduler seeds that miner's throughput EWMA warm (bounded at
+  1e12, decayed ~2%/sweep until real Results confirm it — a cold
+  1B-nps mesh must not warm up through mouse-sized chunks);
+  ``probe`` measures it at startup with two timed spans
+  (apps/miner.measure_rate_hint). Hint-less Joins keep
+  reference-identical bytes and stock scheduling.
 - ``DBM_COORDINATOR`` / ``DBM_NUM_PROCS`` / ``DBM_PROC_ID``: multi-host
   pod mode (parallel/multihost.initialize_multihost): the
   jax.distributed coordinator address and process geometry; unset =
@@ -297,7 +315,8 @@ Environment variables:
   path by default). 0 = the stock walk bit-for-bit (tier-1 matrix
   leg). Measured (loadharness, 1 replica): 5k tenants 186 -> 1981
   admitted/s, CPU/request 5.3ms -> 0.5ms.
-- ``DBM_ADAPT`` (default 0): the self-tuning control plane (ISSUE 13;
+- ``DBM_ADAPT`` (default 1 since ISSUE 14 — the ISSUE 13 soak PR ran
+  clean, so the self-tuning control plane is ON by default;
   ``apps/adapt.py``). With it on, the scheduler mounts small setpoint
   controllers that retune the dispatch knobs from already-collected
   signals: chunk/stripe seconds-of-work driven toward a per-chunk
@@ -309,7 +328,15 @@ Environment variables:
   increase on falling age, multiplicative decrease on rising age) so
   shed rate follows actual service capacity. ``DBM_ADAPT=0`` is
   bit-for-bit stock: no controller objects exist and every hook is one
-  attribute test (tier-1 knob-off matrix leg pin).
+  attribute test (kept pinned in the tier-1 knob-off matrix leg).
+- ``DBM_ADAPT_PER_MINER`` (default 0): per-miner chunk setpoints under
+  the adapt plane (ISSUE 14 satellite). The chunk-size controller ALSO
+  keys force-latency samples by answering miner conn, and once the
+  pool's rate EWMAs diverge past 4x (a heterogeneous pool — host tier
+  next to a mesh miner) it forks a per-miner AIMD value per sampled
+  miner; the per-miner values size that miner's STRIPE chunks
+  (``MinerPlane.chunk_s_overrides``, ``adapt_chunk_s_miner`` gauge)
+  while the pool-wide value keeps driving the QoS chunk plan.
 - ``DBM_ADAPT_TICK_S``: minimum seconds between controller adjustments
   (default 1.0; the controllers ride the scheduler sweep and
   rate-limit themselves to this).
@@ -370,6 +397,18 @@ Environment variables:
   in-process-vs-multi-process comparison leg — 2 in-process replicas
   vs the real 2-process topology (loadharness ``--procs``) at equal
   tenant count.
+- ``DBM_TIER1_MESH`` (0 disables): scripts/tier1.sh's mesh smoke leg
+  (scripts/meshsmoke.py): an 8-virtual-device CPU mesh miner serving
+  one elephant through a real localhost LSP stack — reply must be
+  oracle-exact with exactly one device launch and one host fetch per
+  whole-mesh span.
+- ``DBM_BENCH_MESH`` (0 disables): ``bench.py detail.mesh`` — the
+  mesh plane's per-device-count scaling sweep (1/2/4/8 virtual
+  devices on CPU: nonces/s, device launches per span, host-crossing
+  bytes per span) plus a mixed-pool storm (one 100x rate-skewed fake
+  miner under the real scheduler) recording per-tier grant share vs
+  rate-EWMA ratio; the same dict is the ``MULTICHIP_r06.json``
+  artifact schema the chip chain records on real devices.
 - ``DBM_TIER1_LOAD`` (0 disables): scripts/tier1.sh's mini-load leg —
   a bounded ~500-tenant storm through the split scheduler on detnet
   (scripts/loadharness.py) gating completion, a generous reply-p99
@@ -643,10 +682,13 @@ class AdaptParams:
     the controller ceiling). The per-controller flags isolate one
     controller for A/B work. Hard floors/ceilings live on the
     controllers themselves (class constants) — no observation sequence
-    can push a knob outside them. ``enabled=False`` (the default)
-    constructs nothing: bit-for-bit stock scheduling.
+    can push a knob outside them. ``enabled=False`` constructs
+    nothing: bit-for-bit stock scheduling (the default was False for
+    the ISSUE 13 soak PR; ON since ISSUE 14 after the soak ran clean).
+    ``per_miner`` (default False) forks per-miner chunk setpoints once
+    the pool's rate EWMAs diverge >4x (``DBM_ADAPT_PER_MINER``).
     """
-    enabled: bool = False
+    enabled: bool = True
     tick_s: float = 1.0
     band: float = 0.35
     force_s: float = 1.0
@@ -654,6 +696,7 @@ class AdaptParams:
     chunk: bool = True
     coalesce: bool = True
     admit: bool = True
+    per_miner: bool = False
 
 
 @dataclass(frozen=True)
@@ -837,7 +880,7 @@ def qos_from_env() -> QosParams:
 def adapt_from_env() -> AdaptParams:
     d = AdaptParams()
     return AdaptParams(
-        enabled=_int_env("DBM_ADAPT", 0) != 0,
+        enabled=_int_env("DBM_ADAPT", 1) != 0,
         tick_s=max(0.01, _float_env("DBM_ADAPT_TICK_S", d.tick_s)),
         band=min(0.9, max(0.0, _float_env("DBM_ADAPT_BAND", d.band))),
         force_s=max(0.01, _float_env("DBM_ADAPT_FORCE_S", d.force_s)),
@@ -845,6 +888,7 @@ def adapt_from_env() -> AdaptParams:
         chunk=_int_env("DBM_ADAPT_CHUNK", 1) != 0,
         coalesce=_int_env("DBM_ADAPT_COALESCE", 1) != 0,
         admit=_int_env("DBM_ADAPT_ADMIT", 1) != 0,
+        per_miner=_int_env("DBM_ADAPT_PER_MINER", 0) != 0,
     )
 
 

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Tier-1 mesh smoke leg (ISSUE 14; ``DBM_TIER1_MESH=0`` skips it in
+scripts/tier1.sh).
+
+An 8-virtual-device CPU mesh (the ``test_multihost.py`` precedent:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``) registers as ONE
+miner against an embedded scheduler over a REAL localhost UDP LSP stack.
+The miner measures a startup rate hint (apps/miner.measure_rate_hint)
+and joins with it; one elephant request is then served through the
+carry-chained mesh plane. Asserted:
+
+- the reply is ORACLE-EXACT (host scan_min);
+- the JOIN rate hint seeded the scheduler's per-miner EWMA pre-traffic;
+- the whole-mesh span cost exactly ONE device launch (the elephant's
+  geometry packs into a single pow2 sub) and exactly ONE host fetch
+  (``jax.device_get``) — the "one (hash, nonce) pair crosses the host
+  per span" contract.
+
+Exit 0 on success, 1 on any violation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Virtual 8-device CPU mesh BEFORE any jax import (conftest precedent).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DBM_HOIST_DEEP", "0")   # cheap-to-compile window
+os.environ.setdefault("DBM_METRICS_INTERVAL_S", "0")
+
+#: Elephant geometry: one aligned window whose per-device stripe packs
+#: into a SINGLE pow2 launch. lower is batch-aligned; the miner scans
+#: upper INCLUSIVE (the reference quirk), so the span is
+#: ``upper - lower + 2`` lanes = 8 devices x 14336 lanes, and
+#: 14336 + the worst per-device misalignment (2048) = 4 x 4096 steps —
+#: exactly one pow2 sub, one launch.
+BATCH = 4096
+LOWER = 102_400_000                    # multiple of BATCH
+SPAN = 8 * 14336                       # 114688 lanes scanned
+UPPER = LOWER + SPAN - 2               # client-visible inclusive upper
+DATA = "meshsmoke elephant"
+
+
+async def smoke() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_bitcoinminer_tpu.apps.miner import (MinerWorker,
+                                                         measure_rate_hint)
+    from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                              MsgType,
+                                                              new_request)
+    from distributed_bitcoinminer_tpu.lsp.client import new_async_client
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min
+    from distributed_bitcoinminer_tpu.lsp.params import Params
+    from distributed_bitcoinminer_tpu.lsp.server import new_async_server
+    from distributed_bitcoinminer_tpu.models import MeshNonceSearcher
+    from distributed_bitcoinminer_tpu.models.miner_model import \
+        _MET_LAUNCHES
+    from distributed_bitcoinminer_tpu.parallel import make_mesh
+    from distributed_bitcoinminer_tpu.utils.config import (LeaseParams,
+                                                           host_cache_dir)
+
+    jax.config.update("jax_compilation_cache_dir", host_cache_dir(_REPO))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    if len(jax.devices()) != 8:
+        print(f"MESHSMOKE: expected 8 virtual devices, got "
+              f"{len(jax.devices())}", file=sys.stderr)
+        return 1
+    mesh = make_mesh()
+
+    def factory(data, batch=None):
+        s = MeshNonceSearcher(data, batch=BATCH, mesh=mesh)
+        if not isinstance(s, MeshNonceSearcher):
+            raise AssertionError("factory must build the mesh plane")
+        return s
+
+    params = Params(epoch_limit=5, epoch_millis=500, window_size=8,
+                    max_backoff_interval=2)
+    server = await new_async_server(0, params)
+    # A cold signature's first jit compile can take tens of seconds on
+    # this box; the floor keeps the (hint-shortened) lease from blowing
+    # under the compiler rather than under compute.
+    sched = Scheduler(server, lease=LeaseParams(grace_s=240.0,
+                                                floor_s=240.0))
+    sched_task = asyncio.create_task(sched.run())
+    worker = None
+    try:
+        # Measured rate hint (the DBM_RATE_HINT=probe path, run
+        # in-process so the smoke sees the same searcher class).
+        hint = await asyncio.to_thread(
+            measure_rate_hint, factory("meshsmoke probe"))
+        if hint <= 0:
+            print("MESHSMOKE: rate probe measured nothing",
+                  file=sys.stderr)
+            return 1
+        worker = MinerWorker(f"127.0.0.1:{server.port}", params=params,
+                             searcher_factory=factory, rate_hint=hint)
+        await worker.join()
+        worker_task = asyncio.create_task(worker.run())
+        for _ in range(100):
+            if sched.miners:
+                break
+            await asyncio.sleep(0.05)
+        if not sched.miners:
+            print("MESHSMOKE: miner never joined", file=sys.stderr)
+            return 1
+        m = sched.miners[0]
+        if not (m.rate_hinted and m.rate_ewma and m.rate_ewma > 0):
+            print(f"MESHSMOKE: rate hint did not seed the EWMA "
+                  f"(ewma={m.rate_ewma}, hinted={m.rate_hinted})",
+                  file=sys.stderr)
+            return 1
+
+        # Count launches + host fetches across the elephant span.
+        fetches = []
+        orig_get = jax.device_get
+
+        def counting_get(x):
+            fetches.append(1)
+            return orig_get(x)
+
+        launches0 = _MET_LAUNCHES.value
+        jax.device_get = counting_get
+        t0 = time.monotonic()
+        try:
+            # Raw Request (apps.client.submit always starts at nonce 0;
+            # the smoke's one-launch geometry needs the aligned LOWER).
+            cli = await new_async_client(f"127.0.0.1:{server.port}",
+                                         params)
+            cli.write(new_request(DATA, LOWER, UPPER).to_json())
+            payload = await asyncio.wait_for(cli.read(), 300)
+            await cli.close()
+            msg = Message.from_json(payload)
+            got = ((msg.hash, msg.nonce)
+                   if msg.type == MsgType.RESULT else None)
+        finally:
+            jax.device_get = orig_get
+        launches = _MET_LAUNCHES.value - launches0
+        want = scan_min(DATA, LOWER, UPPER + 1)
+        if got != want:
+            print(f"MESHSMOKE: reply {got} != oracle {want}",
+                  file=sys.stderr)
+            return 1
+        if launches != 1:
+            print(f"MESHSMOKE: whole-mesh span cost {launches} device "
+                  f"launches (expected exactly 1)", file=sys.stderr)
+            return 1
+        if len(fetches) != 1:
+            print(f"MESHSMOKE: {len(fetches)} host fetches for one "
+                  f"mesh span (expected exactly 1 — the one-pair-per-"
+                  f"span contract)", file=sys.stderr)
+            return 1
+        print(f"MESHSMOKE: OK — oracle-exact over {SPAN} lanes, "
+              f"1 launch / 1 host fetch per span, rate hint "
+              f"{hint:.3g} nps seeded the EWMA "
+              f"({time.monotonic() - t0:.1f}s serve)")
+        worker_task.cancel()
+        return 0
+    finally:
+        if worker is not None:
+            await worker.close()
+        sched_task.cancel()
+        await server.close()
+
+
+def main() -> int:
+    return asyncio.run(smoke())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,19 @@ if [ "${DBM_TIER1_ADAPT:-1}" != "0" ]; then
     echo "ADAPT_LEG_RC=$adapt_rc"
 fi
 
+# Mesh smoke leg (ISSUE 14): an 8-virtual-device CPU mesh registers as
+# ONE miner (measured rate-hint JOIN) against an embedded scheduler
+# over real localhost LSP; one elephant must come back oracle-exact
+# with exactly ONE device launch and ONE host fetch per whole-mesh
+# span (the carry-chained one-pair-per-span contract).
+# DBM_TIER1_MESH=0 skips.
+mesh_rc=0
+if [ "${DBM_TIER1_MESH:-1}" != "0" ]; then
+    timeout -k 5 420 python scripts/meshsmoke.py
+    mesh_rc=$?
+    echo "MESH_LEG_RC=$mesh_rc"
+fi
+
 # Multi-process smoke leg (ISSUE 12): the REAL process topology on
 # localhost — router + 2 replica processes on their own LSP sockets +
 # 1 miner agent — with a kill -9 of the replica owning an in-flight
@@ -170,10 +183,14 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # controller objects anywhere — the bit-for-bit stock contract the
     # adapt suite's parity tests assert), with test_adapt.py in the
     # module list.
+    # ISSUE 14 additions: DBM_MESH=0 pins the round-3 local-device
+    # sharding model (per-sub partials — the stock multi-device plane)
+    # and DBM_ADAPT=0 now pins the flipped default (the plane is ON in
+    # the main leg since the ISSUE 13 soak ran clean).
     timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
-        DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 \
+        DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 DBM_MESH=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
@@ -190,5 +207,6 @@ fi
 [ "$check_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$check_rc
 [ "$load_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$load_rc
 [ "$adapt_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$adapt_rc
+[ "$mesh_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$mesh_rc
 [ "$procs_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$procs_rc
 exit $rc

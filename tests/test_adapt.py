@@ -472,10 +472,14 @@ def _drive(sched):
 
 
 def test_adapt_off_is_bit_for_bit_stock(monkeypatch):
-    """The tier-1 matrix-leg pin: DBM_ADAPT unset/0 builds NO plane, no
+    """The tier-1 matrix-leg pin: DBM_ADAPT=0 builds NO plane, no
     adapt metric series exist, and every write the scheduler emits is
-    identical to one built with the explicit disabled block."""
+    identical to one built with the explicit disabled block. (Unset =
+    DEFAULT ON since ISSUE 14 — the ISSUE 13 soak ran clean — so the
+    off contract is now pinned through the explicit 0.)"""
     monkeypatch.delenv("DBM_ADAPT", raising=False)
+    assert adapt_from_env().enabled          # the ISSUE 14 default flip
+    monkeypatch.setenv("DBM_ADAPT", "0")
     assert not adapt_from_env().enabled
     env_sched = Scheduler(FakeServer(), lease=LeaseParams(),
                           qos=QosParams())           # adapt from env
@@ -542,3 +546,200 @@ def test_adapt_on_quiescent_controllers_replies_identical():
     state = on.adapt_plane.state()
     assert state["chunk_adjustments"] == 0
     assert state["admit_shed"] == 0
+
+
+# ------------------------------------------- per-miner setpoints (ISSUE 14)
+
+def _per_miner_ctl(clk):
+    return ChunkSizeController(1.0, setpoint_s=1.0, band=0.25, clock=clk,
+                               per_miner=True)
+
+
+def test_per_miner_forks_only_after_divergence():
+    """The DBM_ADAPT_PER_MINER gate: per-miner values exist only once
+    the pool's rate EWMAs diverge past the 4x ratio — a homogeneous
+    pool keeps the single pool-wide knob (forking it adds noise)."""
+    clk = FakeClock()
+    ctl = _per_miner_ctl(clk)
+    for _ in range(4):
+        ctl.observe(None, 0.9, force_s=0.05, miner=MINER_A)
+        ctl.observe(None, 0.9, force_s=3.0, miner=MINER_B)
+    ctl.note_rate_ratio(2.0)                 # below the 4x gate
+    assert ctl.tick_miners() == {}
+    ctl.note_rate_ratio(None)                # < 2 measured miners
+    assert ctl.tick_miners() == {}
+    for _ in range(4):
+        ctl.observe(None, 0.9, force_s=0.05, miner=MINER_A)
+        ctl.observe(None, 0.9, force_s=3.0, miner=MINER_B)
+    ctl.note_rate_ratio(100.0)               # heterogeneous pool
+    per = ctl.tick_miners()
+    assert set(per) == {MINER_A, MINER_B}
+
+
+def test_per_miner_values_move_independently():
+    """In a skewed pool the fast miner's chunk seconds walk UP (its
+    chunks force far under the setpoint) while the slow miner's walk
+    DOWN — the exact split one pool-wide value cannot express."""
+    clk = FakeClock()
+    ctl = _per_miner_ctl(clk)
+    ctl.note_rate_ratio(100.0)
+    values = {MINER_A: [], MINER_B: []}
+    for _ in range(12):
+        for _ in range(3):
+            ctl.observe(None, 0.9, force_s=0.05, miner=MINER_A)
+            ctl.observe(None, 0.9, force_s=3.0, miner=MINER_B)
+        clk.advance(1.0)
+        for conn, v in ctl.tick_miners().items():
+            values[conn].append(v)
+    assert values[MINER_A] and values[MINER_A][-1] > 1.0
+    assert values[MINER_B] and values[MINER_B][-1] < 1.0
+    # Hard clamps hold per miner too.
+    assert all(ctl.FLOOR_S <= v <= ctl.CEIL_S
+               for vs in values.values() for v in vs)
+
+
+def test_per_miner_settle_tick_and_forget():
+    """Each per-miner loop takes the same settle tick as the pool-wide
+    one (stale old-size samples must not cascade), and a dropped miner's
+    state retires."""
+    clk = FakeClock()
+    ctl = _per_miner_ctl(clk)
+    ctl.note_rate_ratio(10.0)
+    for _ in range(3):
+        ctl.observe(None, 0.9, force_s=3.0, miner=MINER_A)
+    assert MINER_A in ctl.tick_miners()      # decrease fires
+    for _ in range(3):
+        ctl.observe(None, 0.9, force_s=3.0, miner=MINER_A)
+    assert ctl.tick_miners() == {}           # settle tick: no move
+    ctl.forget_miner(MINER_A)
+    assert MINER_A not in ctl._miners
+
+
+def test_per_miner_off_keeps_no_state():
+    """Default-off parity: per_miner=False accumulates nothing and
+    tick_miners is always empty, whatever is observed."""
+    clk = FakeClock()
+    ctl = ChunkSizeController(1.0, setpoint_s=1.0, band=0.25, clock=clk)
+    ctl.observe(None, 0.9, force_s=3.0, miner=MINER_A)
+    ctl.note_rate_ratio(1000.0)
+    assert ctl.tick_miners() == {}
+    assert ctl._miners == {}
+
+
+def test_per_miner_plane_applies_stripe_overrides():
+    """End-to-end through the scheduler: with DBM_ADAPT_PER_MINER the
+    per-miner values land on MinerPlane.chunk_s_overrides (the stripe
+    planner's per-miner knob) and retire when the miner drops."""
+    import time as _time
+    sched = Scheduler(FakeServer(), lease=LeaseParams(),
+                      qos=QosParams(),
+                      adapt=AdaptParams(enabled=True, tick_s=0.0,
+                                        per_miner=True))
+    plane = sched.adapt_plane
+    assert plane is not None and plane.chunk.per_miner
+    sched._on_join(MINER_A)
+    sched._on_join(MINER_B)
+    ma = sched._find_miner(MINER_A)
+    mb = sched._find_miner(MINER_B)
+    ma.rate_ewma, mb.rate_ewma = 100_000.0, 1_000.0   # 100x skew
+    for _ in range(3):
+        plane.observe_chunk(None, 0.9, span={"force_s": 0.05},
+                            sized=True, miner=MINER_A)
+        plane.observe_chunk(None, 0.9, span={"force_s": 3.0},
+                            sized=True, miner=MINER_B)
+    _time.sleep(0.01)
+    sched._apply_adapt()
+    assert MINER_A in sched.miner_plane.chunk_s_overrides
+    assert MINER_B in sched.miner_plane.chunk_s_overrides
+    # The forks seed from the (possibly just-adjusted) pool-wide value,
+    # so pin the SPLIT, not absolutes: the fast miner's seconds walk up
+    # relative to the slow miner's from the very first per-miner tick.
+    assert sched.miner_plane.chunk_s_overrides[MINER_A] > \
+        sched.miner_plane.chunk_s_overrides[MINER_B]
+    gauges = sched.metrics.snapshot()["gauges"]
+    assert any(k.startswith("adapt_chunk_s_miner") for k in gauges), \
+        sorted(gauges)
+    sched._on_drop(MINER_B)
+    assert MINER_B not in sched.miner_plane.chunk_s_overrides
+    assert MINER_B not in plane.chunk._miners
+    gauges = sched.metrics.snapshot()["gauges"]
+    assert not any(k.startswith("adapt_chunk_s_miner")
+                   and f"miner={MINER_B}" in k for k in gauges), \
+        sorted(gauges)
+
+
+def test_per_miner_unforks_on_reconvergence_and_drains_stale_samples():
+    """Code review (ISSUE 14): (a) pre-divergence samples are drained
+    every tick, so the first diverged decision runs on FRESH samples,
+    not latency/margin history from long-gone chunk sizes; (b) when
+    the pool re-converges the forks retire and ``unfork_pending``
+    fires exactly once (the scheduler's cue to clear its overrides —
+    a stale fork must not shadow the pool-wide knob forever)."""
+    clk = FakeClock()
+    ctl = _per_miner_ctl(clk)
+    # An ancient near-lease-blow sample that must NOT drive the first
+    # diverged tick.
+    ctl.observe(None, 0.05, force_s=9.0, miner=MINER_A)
+    ctl.note_rate_ratio(1.0)
+    assert ctl.tick_miners() == {}        # drained, not banked
+    ctl.note_rate_ratio(100.0)
+    assert ctl.tick_miners() == {}        # no post-divergence samples
+    for _ in range(3):
+        ctl.observe(None, 0.9, force_s=0.05, miner=MINER_A)
+    per = ctl.tick_miners()
+    assert MINER_A in per and per[MINER_A] > ctl.aimd.value * 0.99
+    # Re-convergence retires the fork and signals the clear ONCE.
+    ctl.note_rate_ratio(1.5)
+    assert ctl.tick_miners() == {}
+    assert ctl._miners[MINER_A]["aimd"] is None
+    assert ctl.unfork_pending()
+    assert not ctl.unfork_pending()
+
+
+def test_per_miner_scheduler_clears_overrides_on_reconvergence():
+    sched = Scheduler(FakeServer(), lease=LeaseParams(),
+                      qos=QosParams(),
+                      adapt=AdaptParams(enabled=True, tick_s=0.0,
+                                        per_miner=True))
+    plane = sched.adapt_plane
+    sched._on_join(MINER_A)
+    sched._on_join(MINER_B)
+    sched._find_miner(MINER_A).rate_ewma = 100_000.0
+    sched._find_miner(MINER_B).rate_ewma = 1_000.0
+    for _ in range(3):
+        plane.observe_chunk(None, 0.9, span={"force_s": 0.05},
+                            sized=True, miner=MINER_A)
+        plane.observe_chunk(None, 0.9, span={"force_s": 3.0},
+                            sized=True, miner=MINER_B)
+    sched._apply_adapt()
+    assert sched.miner_plane.chunk_s_overrides
+    # Rates converge: the next tick clears every override + its gauge.
+    sched._find_miner(MINER_A).rate_ewma = 1_100.0
+    sched._apply_adapt()
+    assert sched.miner_plane.chunk_s_overrides == {}
+    gauges = sched.metrics.snapshot()["gauges"]
+    assert not any(k.startswith("adapt_chunk_s_miner") for k in gauges)
+
+
+def test_per_miner_gate_ignores_unconfirmed_hints():
+    """Code review (ISSUE 14): the divergence gate reads MEASURED
+    EWMAs only — a miner's own (unconfirmed) JOIN claim must not fork
+    the pool."""
+    from distributed_bitcoinminer_tpu.bitcoin.message import new_join
+    sched = Scheduler(FakeServer(), lease=LeaseParams(),
+                      qos=QosParams(),
+                      adapt=AdaptParams(enabled=True, tick_s=0.0,
+                                        per_miner=True))
+    plane = sched.adapt_plane
+    sched._on_join(MINER_A, Message.from_json(
+        new_join(rate=10 ** 12).to_json()))       # unconfirmed claim
+    sched._on_join(MINER_B)
+    sched._find_miner(MINER_B).rate_ewma = 1_000.0
+    for _ in range(3):
+        plane.observe_chunk(None, 0.9, span={"force_s": 0.05},
+                            sized=True, miner=MINER_A)
+        plane.observe_chunk(None, 0.9, span={"force_s": 3.0},
+                            sized=True, miner=MINER_B)
+    sched._apply_adapt()
+    assert sched.miner_plane.chunk_s_overrides == {}
+    assert not plane.chunk._diverged

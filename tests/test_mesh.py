@@ -1,0 +1,352 @@
+"""ISSUE 14 mesh plane: carry-chained whole-mesh spans, the partition-
+rule table, the one-pair-per-span host-crossing contract, the
+``DBM_MESH=0`` parity pin, and the rate-hint JOIN (wire bytes + EWMA
+seeding/decay/confirmation).
+
+The acceptance grid: mesh-tier spans bit-exact vs the single-device
+oracle across rem x k x device-count — including difficulty/until mode
+— with exactly ONE ``(hash, nonce)`` pair crossing the host per
+whole-mesh span (device-transfer + launch-count pins).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op, scan_min
+from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                          new_join)
+from distributed_bitcoinminer_tpu.models import (MeshNonceSearcher,
+                                                 NonceSearcher,
+                                                 ShardedNonceSearcher)
+from distributed_bitcoinminer_tpu.parallel import make_mesh
+from distributed_bitcoinminer_tpu.parallel.partition import (
+    MESH_PARTITION_RULES, device_windows, match_partition_rules,
+    pow2_subs)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_mesh()
+
+
+# ------------------------------------------------------ partition table
+
+def test_partition_rules_place_windows_sharded_rest_replicated():
+    from jax.sharding import PartitionSpec as P
+    ops = {"carry": np.zeros(5, np.uint32),
+           "midstate": np.zeros(8, np.uint32),
+           "template": np.zeros((2, 16), np.uint32),
+           "base_hi": np.uint32(0), "base_lo": np.uint32(0),
+           "i0_d": np.zeros(8, np.uint32),
+           "lo_d": np.zeros(8, np.uint32),
+           "hi_d": np.zeros(8, np.uint32),
+           "hoist": {"cw": np.zeros((2, 16), np.uint32),
+                     "deep": np.zeros(8, np.uint32)}}
+    specs = match_partition_rules(MESH_PARTITION_RULES, ops)
+    assert specs["i0_d"] == P("d")
+    assert specs["lo_d"] == P("d") and specs["hi_d"] == P("d")
+    assert specs["carry"] == P() and specs["template"] == P()
+    assert specs["hoist"]["cw"] == P() and specs["hoist"]["deep"] == P()
+    # Scalars are never partitioned regardless of rules.
+    assert specs["base_hi"] == P()
+
+
+def test_partition_rules_unmatched_operand_is_an_error():
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(MESH_PARTITION_RULES,
+                              {"mystery": np.zeros(8, np.uint32)})
+
+
+def test_device_windows_contiguous_even_and_covering():
+    for lo, hi, n, batch in ((1003, 2987, 8, 128), (0, 99, 8, 64),
+                             (500, 505, 4, 64), (7, 7, 2, 64)):
+        i0_d, lo_d, hi_d, steps = device_windows(lo, hi, n, batch)
+        lanes = []
+        for d in range(n):
+            if lo_d[d] > hi_d[d]:
+                continue             # empty trailing window
+            lanes.extend(range(int(lo_d[d]), int(hi_d[d]) + 1))
+            # Aligned start covers the window within the step count.
+            assert int(i0_d[d]) % batch == 0 or int(i0_d[d]) == 0
+            assert int(i0_d[d]) <= int(lo_d[d])
+            assert int(hi_d[d]) - int(i0_d[d]) + 1 <= steps * batch
+        assert lanes == list(range(lo, hi + 1))   # exact cover, ordered
+    assert pow2_subs(5) == [(0, 4), (4, 1)]
+    assert pow2_subs(1) == [(0, 1)]
+    assert sum(p for _o, p in pow2_subs(13)) == 13
+
+
+# --------------------------------------------------- oracle bit-exactness
+
+#: rem varies with the message (prefix length), k/blocks with the range,
+#: device counts across the mesh widths; until mode rides the same grid.
+#: One batch size for every device test in this module: jit signatures
+#: are keyed on (mesh, rem, k, batch, nbatches), so sharing the batch
+#: keeps the compile surface — the dominant cost on a CPU box — shared
+#: across tests (the full cross product runs under the slow marker).
+GRID_DATA = ("cmu440", "a much longer mesh message")
+GRID_RANGES = ((0, 4095),            # digit classes 1..4, many blocks
+               (990, 10350),         # 10^k block boundary crossing
+               (123456, 131071))     # single class, unaligned
+BATCH = 128
+
+
+def _assert_grid(data, n_devices, ranges):
+    mesh = make_mesh(n_devices)
+    m = MeshNonceSearcher(data, batch=BATCH, mesh=mesh)
+    single = NonceSearcher(data, batch=BATCH)
+    for lo, hi in ranges:
+        got = m.search(lo, hi)
+        assert got == single.search(lo, hi)
+        assert got == scan_min(data, lo, hi)
+
+
+@pytest.mark.parametrize("n_devices", (1, 8))
+def test_mesh_span_bit_exact_grid(n_devices):
+    _assert_grid(GRID_DATA[0], n_devices, GRID_RANGES)
+
+
+def test_mesh_span_bit_exact_other_rem():
+    _assert_grid(GRID_DATA[1], 8, GRID_RANGES[:1])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_devices", (2, 4))
+@pytest.mark.parametrize("data", GRID_DATA)
+def test_mesh_span_bit_exact_full_grid(n_devices, data):
+    _assert_grid(data, n_devices, GRID_RANGES)
+
+
+def test_mesh_span_matches_sharded_four_devices():
+    data = "cmu440"
+    m = MeshNonceSearcher(data, batch=BATCH, mesh=make_mesh(4))
+    s = ShardedNonceSearcher(data, batch=BATCH, mesh=make_mesh(4))
+    for lo, hi in ((50, 2049), (1357, 1868)):
+        assert m.search(lo, hi) == s.search(lo, hi) == scan_min(data, lo,
+                                                                hi)
+
+
+def _assert_until(data, n_devices, targets=3):
+    mesh = make_mesh(n_devices)
+    m = MeshNonceSearcher(data, batch=BATCH, mesh=mesh)
+    single = NonceSearcher(data, batch=BATCH)
+    lo, hi = 1000, 1000 + 128 * 8 - 1
+    hashes = {n: hash_op(data, n) for n in range(lo, hi + 1)}
+    # Hit only late in the window (exercises the min-qualifying merge
+    # across interleaved stripe windows), plus the no-hit argmin
+    # fallback, plus a first-lane hit.
+    cases = (min(h for n, h in hashes.items()
+                 if n >= lo + 128 * 6) + 1,
+             min(hashes.values()),         # unreachable: argmin
+             hashes[lo] + 1)               # immediate first hit
+    for target in cases[:targets]:
+        assert m.search_until(lo, hi, target) == \
+            single.search_until(lo, hi, target)
+
+
+def test_mesh_until_bit_exact(mesh8):
+    _assert_until("shardun", 8)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_devices", (1, 4))
+def test_mesh_until_bit_exact_other_counts(n_devices):
+    _assert_until("shardun", n_devices)
+
+
+def test_mesh_until_multi_block_early_exit():
+    data = "cmu440"
+    m = MeshNonceSearcher(data, batch=BATCH, mesh=make_mesh(8))
+    single = NonceSearcher(data, batch=BATCH)
+    lo, hi = 990, 10350
+    q = 1500
+    target = hash_op(data, q) + 1
+    assert m.search_until(lo, hi, target) == \
+        single.search_until(lo, hi, target)
+
+
+# ------------------------------------------- one pair per span (pinned)
+
+def test_mesh_span_single_host_transfer_and_launch_count(monkeypatch,
+                                                         mesh8):
+    """THE host-crossing contract: a whole-mesh argmin span — however
+    many blocks/pow2 subs it decomposes into — costs exactly ONE
+    ``jax.device_get`` of the 5-word (20-byte) carry, and the launch
+    count equals the pow2-sub total of its blocks (one chained launch
+    each, no per-sub partials)."""
+    from distributed_bitcoinminer_tpu.models.miner_model import \
+        _MET_LAUNCHES
+    data = "cmu440"
+    m = MeshNonceSearcher(data, batch=BATCH, mesh=mesh8)
+    calls = []
+    orig = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: calls.append(1) or orig(x))
+    for lo, hi in ((0, 4095), (990, 10350)):
+        # Expected launches: sum of pow2 subs over the span's blocks.
+        want_launches = 0
+        for plan in m.plan(lo, hi):
+            _i0, _lo, _hi, steps = device_windows(
+                plan.lo_i, plan.hi_i, m.n_devices, m.batch)
+            want_launches += len(pow2_subs(steps))
+        calls.clear()
+        before = _MET_LAUNCHES.value
+        handle = m.dispatch(lo, hi)
+        assert int(getattr(handle, "nbytes", 0)) == 20
+        got = m.finalize(handle, lo)
+        assert got == scan_min(data, lo, hi)
+        assert len(calls) == 1
+        assert _MET_LAUNCHES.value - before == want_launches
+
+
+def test_mesh_two_phase_dispatch_finalize_equivalence(mesh8):
+    """The miner pipeline's contract: dispatch k+1 before finalize k —
+    two overlapped spans must still answer exactly."""
+    data = "cmu440"
+    m = MeshNonceSearcher(data, batch=BATCH, mesh=mesh8)
+    h1 = m.dispatch(0, 2999)
+    h2 = m.dispatch(3000, 5999)
+    assert m.finalize(h1, 0) == scan_min(data, 0, 2999)
+    assert m.finalize(h2, 3000) == scan_min(data, 3000, 5999)
+    with pytest.raises(ValueError):
+        m.dispatch(10, 9)
+
+
+# -------------------------------------------------- DBM_MESH=0 parity
+
+def test_factory_mesh_default_and_knob_off(monkeypatch):
+    from distributed_bitcoinminer_tpu.apps.miner import \
+        default_searcher_factory
+    monkeypatch.delenv("DBM_MESH", raising=False)
+    monkeypatch.delenv("DBM_COMPUTE", raising=False)
+    s = default_searcher_factory("cmu440", batch=BATCH)
+    assert type(s) is MeshNonceSearcher
+    monkeypatch.setenv("DBM_MESH", "0")
+    s0 = default_searcher_factory("cmu440", batch=BATCH)
+    assert type(s0) is ShardedNonceSearcher   # stock local-device plane
+    assert s.search(100, 4099) == s0.search(100, 4099) \
+        == scan_min("cmu440", 100, 4099)
+
+
+def test_sharded_dispatch_batch_covers_full_rows():
+    """Regression (ISSUE 14 fix): the coalescer's row decomposition is
+    pinned to the SINGLE-device step. ShardedNonceSearcher inherited
+    dispatch_batch but its _sub_dispatches sizes steps for the whole
+    mesh (batch x n_devices), so the single-device segmin launch
+    scanned only 1/n of each row — wrong argmins whenever the answer
+    lay past the first 1/n (reproduced with these exact ranges)."""
+    data = "tie hunt"
+    for lo, hi in ((1000, 2999), (2000, 2999), (5000, 9999)):
+        s = ShardedNonceSearcher(data, batch=64)
+        got = s.finalize_batch(s.dispatch_batch([(s, lo, hi)]))[0]
+        assert got == scan_min(data, lo, hi)
+    m = MeshNonceSearcher(data, batch=64)
+    got = m.finalize_batch(m.dispatch_batch([(m, 1000, 2999)]))[0]
+    assert got == scan_min(data, 1000, 2999)
+
+
+# ----------------------------------------------------- rate-hint JOIN
+
+def test_join_wire_bytes_stock_without_hint():
+    """Wire-compat pin: a hint-less JOIN is byte-identical to the
+    reference encoding — a stock miner joins unchanged."""
+    assert new_join().to_json() == \
+        b'{"Type":0,"Data":"","Lower":0,"Upper":0,"Hash":0,"Nonce":0}'
+    raw = new_join(rate=1_000_000_000).to_json()
+    assert b'"Rate":1000000000' in raw
+    msg = Message.from_json(raw)
+    assert msg.rate == 1_000_000_000
+    # A stock parser's view: the extension rides AFTER reference keys.
+    assert raw.startswith(
+        b'{"Type":0,"Data":"","Lower":0,"Upper":0,"Hash":0,"Nonce":0')
+
+
+@pytest.mark.parametrize("bad", ('"fast"', "-5", "1.5", "true",
+                                 "18446744073709551616"))
+def test_join_malformed_rate_drops_to_no_hint(bad):
+    raw = ('{"Type":0,"Data":"","Lower":0,"Upper":0,"Hash":0,'
+           '"Nonce":0,"Rate":%s}' % bad).encode()
+    msg = Message.from_json(raw)
+    assert msg.rate == 0              # hint dropped, JOIN still valid
+    assert msg.type == 0
+
+
+class _StubServer:
+    def __init__(self):
+        self.writes = []
+
+    def write(self, conn_id, payload):
+        self.writes.append((conn_id, payload))
+
+    def close_conn(self, conn_id):
+        pass
+
+
+def _mk_sched():
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    from distributed_bitcoinminer_tpu.utils.config import (AdaptParams,
+                                                           LeaseParams,
+                                                           QosParams)
+    return Scheduler(_StubServer(), lease=LeaseParams(),
+                     qos=QosParams(),
+                     adapt=AdaptParams(enabled=False))
+
+
+def test_rate_hint_seeds_bounded_decays_and_confirms():
+    from distributed_bitcoinminer_tpu.apps.miner_plane import MinerPlane
+    sched = _mk_sched()
+    mp = sched.miner_plane
+    # Seed through the real JOIN path, bounded at the cap.
+    sched._on_join(7, Message.from_json(
+        new_join(rate=10 ** 15).to_json()))
+    m = sched._find_miner(7)
+    assert m.rate_hinted
+    assert m.rate_ewma == MinerPlane.RATE_HINT_CAP
+    assert mp.pool_rate == MinerPlane.RATE_HINT_CAP  # empty pool seeded
+    # Unconfirmed hints decay every sweep.
+    before = m.rate_ewma
+    mp.decay_rate_hints()
+    assert m.rate_ewma == pytest.approx(
+        before * MinerPlane.RATE_HINT_DECAY)
+    assert mp.pool_rate == m.rate_ewma
+    # A real throughput window REPLACES the hint (no blend with the
+    # claim) and stops the decay.
+    from distributed_bitcoinminer_tpu.apps.miner_plane import Chunk
+    import time as _time
+    chunk = Chunk(1, "x", 0, 5000, idx=0)
+    chunk.assigned_at = _time.monotonic() - 1.0
+    chunk.deadline = _time.monotonic() + 100.0
+    chunk.lease_started = True
+    mp.observe_result(m, chunk)
+    assert not m.rate_hinted and not mp._pool_hinted
+    assert m.rate_ewma == pytest.approx(5001 / 1.0, rel=0.2)
+    v = m.rate_ewma
+    mp.decay_rate_hints()
+    assert m.rate_ewma == v           # confirmed: no more decay
+
+
+def test_rate_hint_sizes_first_lease_and_stripes():
+    """The point of the hint: a cold 1B-nps miner's FIRST chunks are
+    sized and leased for its width — no mouse-chunk warmup."""
+    from distributed_bitcoinminer_tpu.apps.miner_plane import Chunk
+    sched = _mk_sched()
+    mp = sched.miner_plane
+    sched._on_join(9, Message.from_json(
+        new_join(rate=1_000_000_000).to_json()))
+    m = sched._find_miner(9)
+    # Stripe plan: a 2-second share at the hinted rate cuts into
+    # chunk_s-sized stripes instead of one cold whole-share chunk.
+    n = mp.stripe_chunks(m, 2_000_000_000)
+    assert n >= 2
+    # Lease sized from the hint, not the cold grace.
+    lease = mp.lease_for(m, Chunk(1, "x", 0, 1_000_000_000))
+    assert lease == pytest.approx(
+        max(mp.lease.floor_s, 1.0 * mp.lease.factor), rel=0.01)
+    # A hint-less join still takes the stock cold path.
+    sched._on_join(10)
+    m2 = sched._find_miner(10)
+    assert m2.rate_ewma is None and not m2.rate_hinted
