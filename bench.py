@@ -843,6 +843,72 @@ def _load_probe() -> dict:
     }
 
 
+def _replay_probe() -> dict:
+    """Workload capture→replay fidelity (ISSUE 15, ``detail.replay``):
+    capture a synthesized uniform storm on the detnet harness
+    (``run_load`` with the capture plane armed), re-drive the capture
+    through :func:`~...apps.loadharness.run_replay`, and embed the
+    side-by-side report — the capture's own admitted/s, shed rate,
+    p50/p99 and per-phase span medians next to each replay round's,
+    plus the fidelity verdict (``within`` = the replay reproduced the
+    shape inside the stated bounds). Replay rounds are
+    median-aggregated on the fidelity ratios; the capture leg runs
+    once (it IS the artifact under test).
+
+    ``DBM_BENCH_REPLAY=0`` skips; ``DBM_BENCH_REPLAY_ROUNDS`` (default
+    2) sets the replay rounds.
+    """
+    import os
+    import tempfile
+    from statistics import median
+
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        run_load, run_replay)
+
+    rounds = max(1, _int_env("DBM_BENCH_REPLAY_ROUNDS", 2))
+    fd, path = tempfile.mkstemp(prefix="dbm_bench_cap_",
+                                suffix=".jsonl")
+    os.close(fd)
+    try:
+        cap_leg = run_load(tenants=400, replicas=1, miners=4,
+                           req_nonces=256, capture_path=path,
+                           timeout_s=120.0)
+        reps = [run_replay(path, timeout_s=120.0)
+                for _ in range(rounds)]
+    finally:
+        for suffix in ("", ".1"):
+            try:
+                os.unlink(path + suffix)
+            except OSError:
+                pass
+    keys = ("admitted_ratio", "p99_ratio", "shed_delta")
+    med = {}
+    for key in keys:
+        vals = [r["fidelity"][key] for r in reps
+                if r.get("fidelity", {}).get(key) is not None]
+        med[key] = round(median(vals), 4) if vals else None
+    out = {
+        "rounds": rounds,
+        "capture_leg": {k: cap_leg.get(k) for k in
+                        ("requests", "completed", "shed_rate",
+                         "admitted_per_s", "p50_s", "p99_s")},
+        "capture": reps[-1]["capture"],
+        "replay": {k: reps[-1].get(k) for k in
+                   ("requests", "completed", "shed_rate",
+                    "admitted_per_s", "p50_s", "p99_s", "trace")},
+        "fidelity_median": med,
+        # A timed-out round can still carry a violation-free fidelity
+        # dict (hung tenants are not sheds); it must not read as a
+        # healthy round trip (code review).
+        "within": all(r["fidelity"]["within"] and not r.get("timed_out")
+                      for r in reps),
+        "samples": [dict(r["fidelity"],
+                         **({"timed_out": True} if r.get("timed_out")
+                            else {})) for r in reps],
+    }
+    return out
+
+
 def _adapt_probe() -> dict:
     """Self-tuning control plane A/B (ISSUE 13, ``detail.adapt``): the
     three adversarial load-harness workloads — mice stampede, tenant
@@ -1371,6 +1437,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             adapt_detail = {"adapt": {"error": repr(exc)[:300]}}
 
+    # Workload capture→replay fidelity (ISSUE 15): capture a detnet
+    # storm, re-drive it, gate the shape reproduction — no JAX compute
+    # involved, so it runs on any box. DBM_BENCH_REPLAY=0 skips it.
+    replay_detail = {}
+    if _str_env("DBM_BENCH_REPLAY", "1") != "0":
+        try:
+            replay_detail = {"replay": _replay_probe()}
+        except Exception as exc:  # noqa: BLE001
+            replay_detail = {"replay": {"error": repr(exc)[:300]}}
+
     # Mesh plane (ISSUE 14): per-device-count scaling sweep + the
     # heterogeneous mixed-pool storm. The same dict is the
     # MULTICHIP_r06.json artifact schema. DBM_BENCH_MESH=0 skips it.
@@ -1414,6 +1490,7 @@ def main() -> int:
         **batch_detail,
         **load_detail,
         **adapt_detail,
+        **replay_detail,
         **mesh_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters

@@ -25,9 +25,11 @@ which is what makes shrinking converge on the ordering change alone.
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 
 from ...apps.scheduler import Scheduler
+from ...utils._env import str_env
 from ...bitcoin.hash import hash_op
 from ...bitcoin.message import (Message, MsgType, new_join, new_request,
                                 new_result)
@@ -818,6 +820,120 @@ class WideMiner(Scenario):
         return out
 
 
+# --------------------------------------------------------- replayed_storm
+
+#: Parsed captures by path (the explorer re-executes a scenario
+#: thousands of times; the capture file is parsed ONCE per process).
+_REPLAY_CAPS: dict = {}
+
+
+def _replay_capture():
+    """The capture the ``replayed_storm`` scenario replays:
+    ``DBM_CHECK_CAPTURE`` (the tier-1 replay leg points it at the storm
+    it just captured), or the checked-in fixture — a real
+    mice-stampede run captured on the detnet harness."""
+    path = str_env("DBM_CHECK_CAPTURE", "") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "replay_fixture.jsonl")
+    cap = _REPLAY_CAPS.get(path)
+    if cap is None:
+        from ...apps.capture import load_capture
+        cap = _REPLAY_CAPS[path] = load_capture(path)
+    return cap
+
+
+class ReplayedStorm(Scenario):
+    """Interleaving exploration over MEASURED traffic (ISSUE 15): a
+    workload capture converts into a scripted population — per-tenant
+    arrival pacing and geometry mix from the capture's ``req`` records,
+    the miner pool's relative rate skew from its ``pool`` snapshots —
+    and the full invariant pack (exactly-once oracle-exact replies,
+    accounting balance, span closure, liveness) runs over every
+    explored schedule. The seed draws WHICH window of the capture
+    replays (tenant subset + offset), jitters the pool, and may wedge
+    one miner, so scenario diversity grows from real traffic shapes
+    instead of hand-written scripts. Geometry is clamped to
+    oracle-checkable sizes (ranges ≤ 512 nonces, vtime-compressed
+    arrivals) — the capture drives the SHAPE; the oracle needs the
+    scale bounded."""
+
+    name = "replayed_storm"
+
+    #: Clamps keeping one schedule's host-oracle work bounded whatever
+    #: capture DBM_CHECK_CAPTURE points at.
+    MAX_TENANTS = 8
+    MAX_REQS_PER_TENANT = 3
+    MAX_NONCES = 512
+    MAX_WINDOW_VTIME = 2.5
+
+    def build(self, ctx: Ctx) -> None:
+        from ...apps.capture import replay_plan
+        rng = ctx.rng
+        cap = _replay_capture()
+        plan = replay_plan(cap)
+        n_ten = rng.randint(4, self.MAX_TENANTS)
+        if len(plan) > n_ten:
+            at = rng.randrange(0, len(plan) - n_ten + 1)
+            window = plan[at:at + n_ten]
+        else:
+            window = plan
+        t_lo = min(p["start"] for p in window)
+        dur = max((p["start"] - t_lo)
+                  + (p["reqs"][min(len(p["reqs"]),
+                                   self.MAX_REQS_PER_TENANT) - 1][0]
+                     if p["reqs"] else 0.0)
+                  for p in window)
+        scale = (min(1.0, self.MAX_WINDOW_VTIME / dur)
+                 if dur > 0 else 1.0)
+        _make_sched(ctx, lease=LeaseParams(
+            grace_s=0.8, factor=4.0, floor_s=0.5, tick_s=0.05,
+            quarantine_after=2, queue_alarm_s=30.0),
+            qos=QosParams(enabled=True, chunk_s=0.2, max_chunks=16,
+                          depth=2, wholesale_s=0.5, max_queued=64))
+        # Pool: captured rate EWMAs keep their RELATIVE skew, mapped
+        # onto the ~1000-nps virtual-time scale the other scenarios
+        # use; one miner may wedge (the capture's reissue events say
+        # real traffic saw re-issues too — the shape must survive one
+        # here).
+        rates = cap.pool_rates() or [1000.0, 1000.0]
+        med = sorted(rates)[len(rates) // 2]
+        n_m = min(3, max(2, len(rates)))
+        wedged = rng.random() < 0.3
+        bad = rng.randrange(n_m) if wedged else None
+        for i in range(n_m):
+            rel = max(0.25, min(4.0, rates[i % len(rates)] / med))
+            vrate = 1000.0 * rel
+            kw = {}
+            mrng = _fork(rng)
+            if bad == i:
+                kw["wedge_after"] = rng.choice((0, 1))
+            else:
+                kw["delay_fn"] = (lambda size, r=mrng, v=vrate:
+                                  size / v * r.uniform(0.8, 1.2))
+            ctx.add_miner(f"m{i}", **kw)
+        ctx.spawn(_warm_rates(ctx, n_m, 1000.0))
+        for ti, p in enumerate(window):
+            reqs = []
+            prev = 0.0
+            offsets = [p["start"] - t_lo + dt for dt, _n, _m, _d
+                       in p["reqs"][:self.MAX_REQS_PER_TENANT]]
+            for i, (dt, n, mode, _dc) in enumerate(
+                    p["reqs"][:self.MAX_REQS_PER_TENANT]):
+                at = offsets[i] * scale
+                reqs.append(Req(
+                    f"{rng.choice(_DATA)}#{ti}.{i}", 0,
+                    min(max(1, n), self.MAX_NONCES) - 1,
+                    target=1 if mode == "diff" else 0,
+                    pre_delay=max(0.0, at - prev)))
+                prev = at
+            ctx.add_client(f"t{ti}", reqs)
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        return out
+
+
 # -------------------------------------------------------- health_takeover
 
 class _ProcView:
@@ -1139,6 +1255,7 @@ SCENARIOS = {
     "difficulty_prefix": DifficultyPrefix,
     "plane_split": PlaneSplit,
     "wide_miner": WideMiner,
+    "replayed_storm": ReplayedStorm,
     "replica_takeover": ReplicaTakeover,
     "adaptive_control": AdaptiveControl,
     "health_takeover": HealthTakeover,

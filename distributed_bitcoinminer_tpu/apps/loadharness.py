@@ -53,7 +53,8 @@ from ..utils.config import AdaptParams, CacheParams, LeaseParams, \
 from ..utils.trace import SPAN_PHASES
 
 __all__ = ["run_load", "load_curve", "run_adversarial",
-           "adversarial_ab", "WORKLOADS"]
+           "adversarial_ab", "WORKLOADS", "run_replay",
+           "run_replay_procs"]
 
 #: A 64-bit odd multiplier (splitmix64 finalizer constant): the fake
 #: miner's answer must be a deterministic function of the chunk so
@@ -125,11 +126,26 @@ def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
              max_queued: int = 4096, recv_batch: Optional[int] = None,
              trace_sample: Optional[float] = None,
              qos_lazy: Optional[bool] = None,
+             capture_path: Optional[str] = None,
              timeout_s: float = 300.0) -> dict:
     """One storm leg; returns the leg's measurement dict.
 
     ``qos_lazy`` pins the lazy-DRR walk knob for A/B legs (ISSUE 12;
-    None = the default, lazy on)."""
+    None = the default, lazy on). ``capture_path`` arms the workload
+    capture plane (ISSUE 15) for the leg: the scheduler(s) write the
+    storm's workload trace there (flushed and closed with the leg), so
+    a synthesized storm becomes a :func:`run_replay` input — the
+    round-trip the tier-1 replay leg and ``bench.py detail.replay``
+    drive."""
+
+    # Constructed (and closed) OUTSIDE the leg coroutine: an exception
+    # escaping the storm must still flush/close the capture and clear
+    # its crash-artifact registration (code review — a leaked handle
+    # left flight dumps naming a stale file).
+    cap = None
+    if capture_path is not None:
+        from .capture import WorkloadCapture
+        cap = WorkloadCapture(path=capture_path)
 
     async def leg() -> dict:
         from .replicas import ReplicaSet
@@ -148,7 +164,8 @@ def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
         # since ISSUE 14.
         kw = dict(lease=lease, cache=CacheParams(enabled=False), qos=qos,
                   adapt=AdaptParams(enabled=False),
-                  recv_batch=recv_batch, trace_sample=trace_sample)
+                  recv_batch=recv_batch, trace_sample=trace_sample,
+                  capture=cap)
         if replicas > 1:
             coord = ReplicaSet(server, replicas, **kw)
         else:
@@ -209,7 +226,11 @@ def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
             out["timed_out"] = True
         return out
 
-    return asyncio.run(leg())
+    try:
+        return asyncio.run(leg())
+    finally:
+        if cap is not None:
+            cap.close()
 
 
 # --------------------------------------------- adversarial workloads
@@ -302,6 +323,7 @@ def run_adversarial(workload: str, *, adapt: bool = False,
                     duration_s: Optional[float] = None,
                     miners: int = 4,
                     adapt_params: Optional[AdaptParams] = None,
+                    capture_path: Optional[str] = None,
                     timeout_s: float = 120.0) -> dict:
     """One adversarial-workload leg (ISSUE 13), static knobs
     (``adapt=False`` — the defaults every deployment would ship) or
@@ -319,6 +341,11 @@ def run_adversarial(workload: str, *, adapt: bool = False,
     # dbmcheck executor applies the same discipline.
     import logging
     dbm_logger = logging.getLogger("dbm")
+    # Outside the leg coroutine for exception-safe close (run_load).
+    cap = None
+    if capture_path is not None:
+        from .capture import WorkloadCapture
+        cap = WorkloadCapture(path=capture_path)
 
     async def leg() -> dict:
         from .scheduler import Scheduler
@@ -337,7 +364,8 @@ def run_adversarial(workload: str, *, adapt: bool = False,
         coord = Scheduler(server, lease=lease,
                           cache=CacheParams(enabled=False), qos=qos,
                           adapt=ap if adapt
-                          else AdaptParams(enabled=False))
+                          else AdaptParams(enabled=False),
+                          capture=cap)
         coord_task = asyncio.create_task(coord.run())
         miner_tasks = [asyncio.create_task(
             _fake_miner(server.connect(), trace_spans=True,
@@ -408,6 +436,8 @@ def run_adversarial(workload: str, *, adapt: bool = False,
         return asyncio.run(leg())
     finally:
         dbm_logger.setLevel(prev_level)
+        if cap is not None:
+            cap.close()
 
 
 def adversarial_ab(workloads=None, rounds: int = 3, **kw) -> dict:
@@ -483,6 +513,360 @@ def _trace_summary(coord, replicas: int) -> dict:
     for ph, xs in sorted(phases.items()):
         out[f"miner_{ph}_p50"] = round(median(xs), 6)
     return out
+
+
+# ----------------------------------------------------- workload replay
+
+#: Captured rate EWMAs above this are a detnet instant miner's measured
+#: throughput (microsecond answers read as 10^8+ nps); modeling them as
+#: rate-limited sleeps would add loop churn without adding fidelity —
+#: the replay miner goes INSTANT instead.
+_REPLAY_RATE_CUTOFF = 5e6
+
+
+def _replay_data(name: str, dc: int) -> str:
+    """Replay request key padded toward the captured pow2 data-size
+    class (bounded at 128 chars — the class preserves the geometry mix,
+    not the bytes)."""
+    want = min(max(1, (1 << max(0, dc)) - 1), 128)
+    return name + "x" * max(0, want - len(name))
+
+
+async def _replay_tenant(server, name: str, start_s: float, reqs: list,
+                         latencies: list, sheds: list) -> None:
+    """One replayed tenant: connect at its captured (speed-warped)
+    arrival slot, submit each request at its captured offset from an
+    inner writer task while reading replies — a captured tenant may
+    interleave submissions and replies arbitrarily, unlike the storm
+    tenants' send-all-then-read shape. A dead conn sheds every
+    still-unanswered request (the ``_paced_tenant`` accounting rule)."""
+    if start_s > 0:
+        await asyncio.sleep(start_s)
+    chan = server.connect()
+    t0 = time.monotonic()
+    stamps: list = []
+    state = {"answered": 0}
+    total = len(reqs)
+
+    async def writer() -> None:
+        for i, (dt, n, mode, dc) in enumerate(reqs):
+            wait = t0 + dt - time.monotonic()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            stamps.append(time.monotonic())
+            try:
+                # Difficulty-mode geometry replays with target=1: the
+                # scheduler runs the real difficulty path (fan-out,
+                # prefix-release bookkeeping) while the fake pool's
+                # answers practically never qualify, so the reply is
+                # the deterministic barrier arg-min.
+                chan.write(new_request(
+                    _replay_data(f"{name}#{i}", dc), 0, max(1, n) - 1,
+                    1 if mode == "diff" else 0).to_json())
+            except LspError:
+                return       # shed mid-storm; the reader records it
+
+    wtask = asyncio.create_task(writer())
+    try:
+        while state["answered"] < total:
+            payload = await chan.read()
+            msg = Message.from_json(payload)
+            if msg.type == MsgType.RESULT:
+                latencies.append(
+                    time.monotonic() - stamps[state["answered"]])
+                state["answered"] += 1
+    except LspError:
+        lost = total - state["answered"]
+        if lost > 0:
+            sheds.append(lost)
+    finally:
+        wtask.cancel()
+
+
+def run_replay(path: str, *, speed: Optional[float] = None,
+               miners: Optional[int] = None,
+               max_tenants: Optional[int] = None,
+               bounds: Optional[dict] = None,
+               timeout_s: float = 300.0) -> dict:
+    """Re-drive a captured workload trace through the detnet harness
+    (ISSUE 15): the ``replay`` workload.
+
+    Preserves the capture's inter-arrival process per hashed tenant and
+    its geometry mix (range size, argmin-vs-difficulty, data-size
+    class); models the serving side from the capture's pool snapshots
+    (rate EWMAs become rate-limited fake miners; instant-class rates
+    stay instant); ``speed`` (default ``DBM_REPLAY_SPEED``) time-warps
+    BOTH the arrival clock and the rate-limited service rates, so the
+    load factor — the shape — survives the warp. Returns the
+    ``run_load`` measurement shape plus the capture's own baseline
+    (``capture``) and the side-by-side ``fidelity`` verdict."""
+    from .capture import (capture_baseline, fidelity, load_capture,
+                          replay_plan, replay_speed)
+    cap = load_capture(path)
+    plan = replay_plan(cap, max_tenants=max_tenants)
+    # Baseline restricted to the REPLAYED tenant window: a max_tenants
+    # truncation must compare against the same subset's own numbers,
+    # not the full capture's (code review).
+    base = capture_baseline(cap, tenants={p["ten"] for p in plan})
+    spd = speed if speed is not None else replay_speed()
+    if bounds is None and cap.cfg.get("transport") not in (None,
+                                                          "DetServer"):
+        # Cross-transport replay (a real-LSP capture re-driven on
+        # detnet — the primary "measured traffic becomes the test
+        # suite" workflow): the latency ratio reflects the transport's
+        # own floor, not workload shape, so it is reported UNGATED;
+        # arrival pacing, admitted/s, shed shape, and request-count
+        # equality still gate (the run_replay_procs rule, reversed).
+        bounds = {"p99_ratio": None}
+    # Sheds may be the replayed workload (run_adversarial discipline):
+    # a shed-heavy capture must not drown the leg in warning lines.
+    import logging
+    dbm_logger = logging.getLogger("dbm")
+
+    async def leg() -> dict:
+        from .scheduler import Scheduler
+        server = DetServer(record=False)
+        qos = QosParams(
+            enabled=bool(cap.cfg.get("qos", True)),
+            max_queued=max(1, int(cap.cfg.get("max_queued", 4096))),
+            wholesale_s=float(cap.cfg.get("wholesale_s", 5.0)))
+        lease = LeaseParams(grace_s=120.0, floor_s=60.0,
+                            queue_alarm_s=0.0)
+        # Adapt pinned OFF like every other harness leg: fidelity
+        # compares scheduler SHAPES at known static knobs.
+        # capture=False: a lingering DBM_CAPTURE=1 must NOT arm the
+        # env capture here — WorkloadCapture opens its path with 'w',
+        # which would truncate the very file being replayed when
+        # DBM_CAPTURE_PATH points at it (code review).
+        coord = Scheduler(server, lease=lease,
+                          cache=CacheParams(enabled=False), qos=qos,
+                          adapt=AdaptParams(enabled=False),
+                          capture=False)
+        coord_task = asyncio.create_task(coord.run())
+        rates = cap.pool_rates()
+        n_miners = (miners if miners is not None
+                    else min(16, len(rates)) if rates else 4)
+        miner_tasks = []
+        for i in range(max(1, n_miners)):
+            rate = rates[i % len(rates)] if rates else 0.0
+            rate_eff = (0.0 if rate <= 0 or rate > _REPLAY_RATE_CUTOFF
+                        else rate * spd)
+            miner_tasks.append(asyncio.create_task(_fake_miner(
+                server.connect(), trace_spans=True, rate=rate_eff)))
+        for _ in range(4):
+            await asyncio.sleep(0)
+        latencies: list = []
+        sheds: list = []
+        cpu0 = time.process_time()
+        t0 = time.monotonic()
+        tenant_tasks = [asyncio.create_task(_replay_tenant(
+            server, p["name"], p["start"] / spd,
+            [(dt / spd, n, mode, dc) for dt, n, mode, dc in p["reqs"]],
+            latencies, sheds))
+            for p in plan]
+        try:
+            await asyncio.wait_for(asyncio.gather(*tenant_tasks),
+                                   timeout_s)
+            timed_out = False
+        except asyncio.TimeoutError:
+            timed_out = True
+        makespan = time.monotonic() - t0
+        cpu_s = time.process_time() - cpu0
+        for task in tenant_tasks + miner_tasks + [coord_task]:
+            task.cancel()
+        total = sum(len(p["reqs"]) for p in plan)
+        completed = len(latencies)
+        latencies.sort()
+
+        def pct(q: float):
+            if not latencies:
+                return None
+            return round(latencies[min(len(latencies) - 1,
+                                       int(q * len(latencies)))], 4)
+
+        out = {
+            "workload": "replay",
+            "source": path,
+            "speed": spd,
+            "tenants": len(plan),
+            "replicas": 1,
+            "miners": len(miner_tasks),
+            "requests": total,
+            "completed": completed,
+            "shed_tenants": len(sheds),
+            "shed_requests": sum(sheds),
+            # sheds over arrivals — the SAME definition the capture
+            # baseline uses, so the fidelity delta compares like with
+            # like (run_load's 1 - completed/total would also fold
+            # timeouts in).
+            "shed_rate": round(sum(sheds) / total, 4) if total else 0.0,
+            "makespan_s": round(makespan, 3),
+            "admitted_per_s": round(completed / makespan, 1)
+            if makespan > 0 else None,
+            "p50_s": pct(0.50),
+            "p99_s": pct(0.99),
+            "cpu_s_per_request": round(cpu_s / completed, 6)
+            if completed else None,
+            "trace": _trace_summary(coord, 1),
+        }
+        if timed_out:
+            out["timed_out"] = True
+        out["capture"] = base
+        out["fidelity"] = fidelity(base, out, speed=spd, bounds=bounds)
+        return out
+
+    prev_level = dbm_logger.level
+    dbm_logger.setLevel(logging.CRITICAL)
+    try:
+        return asyncio.run(leg())
+    finally:
+        dbm_logger.setLevel(prev_level)
+
+
+async def _replay_ring_tenant(statedir: str, params, name: str,
+                              start_s: float, reqs: list,
+                              latencies: list, sheds: list) -> None:
+    """The --procs replay tenant: same pacing contract as
+    :func:`_replay_tenant`, over real UDP against the advertised
+    ring."""
+    from ..lsp.client import new_async_client
+    from .procs import resolve_owner
+    if start_s > 0:
+        await asyncio.sleep(start_s)
+    owner = resolve_owner(statedir, name)
+    if owner is None:
+        sheds.append(len(reqs))
+        return
+    try:
+        client = await new_async_client(owner[1], params)
+    except LspError:
+        sheds.append(len(reqs))
+        return
+    t0 = time.monotonic()
+    stamps: list = []
+    state = {"answered": 0}
+    total = len(reqs)
+
+    async def writer() -> None:
+        for i, (dt, n, mode, dc) in enumerate(reqs):
+            wait = t0 + dt - time.monotonic()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            stamps.append(time.monotonic())
+            try:
+                client.write(new_request(
+                    _replay_data(f"{name}#{i}", dc), 0, max(1, n) - 1,
+                    1 if mode == "diff" else 0).to_json())
+            except LspError:
+                return
+    wtask = asyncio.create_task(writer())
+    try:
+        while state["answered"] < total:
+            msg = Message.from_json(await client.read())
+            if msg.type == MsgType.RESULT:
+                latencies.append(
+                    time.monotonic() - stamps[state["answered"]])
+                state["answered"] += 1
+    except LspError:
+        if total - state["answered"] > 0:
+            sheds.append(total - state["answered"])
+    finally:
+        wtask.cancel()
+        await client.close()
+
+
+def run_replay_procs(path: str, *, replicas: int = 2, miners: int = 4,
+                     speed: Optional[float] = None,
+                     max_tenants: Optional[int] = None,
+                     bounds: Optional[dict] = None,
+                     timeout_s: float = 180.0) -> dict:
+    """Replay a capture through the REAL multi-process topology
+    (``loadharness --replay ... --procs``): router + replica processes
+    on their own LSP sockets + instant fake miner agents, arrivals
+    re-driven over real localhost UDP with the captured per-tenant
+    pacing. The serving side is the cluster's own (instant) agents —
+    captured pool rates do not transfer across the process boundary —
+    so the DEFAULT fidelity bounds here gate only the arrival/shed
+    shape (request count, shed delta); the latency ratios are reported
+    ungated (``bounds=`` re-arms them for a same-transport capture)."""
+    import shutil
+    import tempfile
+
+    from .capture import (capture_baseline, fidelity, load_capture,
+                          replay_plan, replay_speed)
+    cap = load_capture(path)
+    plan = replay_plan(cap, max_tenants=max_tenants)
+    base = capture_baseline(cap, tenants={p["ten"] for p in plan})
+    spd = speed if speed is not None else replay_speed()
+    if bounds is None:
+        bounds = {"admitted_ratio": None, "p99_ratio": None}
+
+    async def leg() -> dict:
+        from .procs import ProcCluster
+        statedir = tempfile.mkdtemp(prefix="dbm_replayprocs_")
+        # DBM_CAPTURE=0 pinned in the children: replaying must never
+        # arm a fresh capture that truncates the source file (or
+        # records the replay's own synthetic traffic as if measured).
+        env = {"DBM_HEALTH_BEAT_S": "0.25", "DBM_HEALTH_MISS_K": "3",
+               "DBM_EPOCH_MILLIS": "500", "DBM_EPOCH_LIMIT": "8",
+               "DBM_TRACE_SAMPLE": "0.01", "DBM_ADAPT": "0",
+               "DBM_CAPTURE": "0"}
+        cluster = ProcCluster(statedir, replicas=replicas,
+                              miners=miners, env=env, fake_miners=True)
+        cluster.start()
+        params = _proc_params()
+        latencies: list = []
+        sheds: list = []
+        timed_out = False
+        try:
+            await cluster.wait_live(replicas, timeout_s=30.0,
+                                    miners=miners)
+            t0 = time.monotonic()
+            tasks = [asyncio.create_task(_replay_ring_tenant(
+                statedir, params, p["name"], p["start"] / spd,
+                [(dt / spd, n, mode, dc)
+                 for dt, n, mode, dc in p["reqs"]],
+                latencies, sheds)) for p in plan]
+            try:
+                await asyncio.wait_for(asyncio.gather(*tasks),
+                                       timeout_s)
+            except asyncio.TimeoutError:
+                timed_out = True
+            makespan = time.monotonic() - t0
+            for task in tasks:
+                task.cancel()
+        finally:
+            cluster.close()
+            shutil.rmtree(statedir, ignore_errors=True)
+        total = sum(len(p["reqs"]) for p in plan)
+        completed = len(latencies)
+        latencies.sort()
+
+        def pct(q: float):
+            if not latencies:
+                return None
+            return round(latencies[min(len(latencies) - 1,
+                                       int(q * len(latencies)))], 4)
+
+        out = {
+            "workload": "replay", "topology": "procs", "source": path,
+            "speed": spd, "tenants": len(plan), "replicas": replicas,
+            "miners": miners, "requests": total, "completed": completed,
+            "shed_tenants": len(sheds), "shed_requests": sum(sheds),
+            "shed_rate": round(sum(sheds) / total, 4) if total else 0.0,
+            "makespan_s": round(makespan, 3),
+            "admitted_per_s": round(completed / makespan, 1)
+            if makespan > 0 else None,
+            "p50_s": pct(0.50), "p99_s": pct(0.99),
+            "trace": {"sampled_traces": 0},
+        }
+        if timed_out:
+            out["timed_out"] = True
+        out["capture"] = base
+        out["fidelity"] = fidelity(base, out, speed=spd, bounds=bounds)
+        return out
+
+    return asyncio.run(leg())
 
 
 def _children_cpu_s(pids) -> float:

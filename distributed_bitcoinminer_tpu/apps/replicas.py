@@ -128,7 +128,8 @@ class ReplicaSet:
                  stripe=None, qos=None, coalesce=None, adapt=None,
                  clock=None,
                  recv_batch: Optional[int] = None,
-                 trace_sample: Optional[float] = None):
+                 trace_sample: Optional[float] = None,
+                 capture=None):
         self.server = server
         self.n = n if n is not None else replicas_from_env()
         cache = cache if cache is not None else CacheParams()
@@ -141,7 +142,7 @@ class ReplicaSet:
                 server, lease=lease, cache=cache, stripe=stripe, qos=qos,
                 coalesce=coalesce, adapt=adapt, clock=clock,
                 result_cache=self.shared_cache, recv_batch=recv_batch,
-                trace_sample=trace_sample)
+                trace_sample=trace_sample, capture=capture)
             sched._next_job_id = rid * self.JOB_ID_STRIDE
             self.replicas[rid] = sched
         self.live: List[int] = list(range(self.n))

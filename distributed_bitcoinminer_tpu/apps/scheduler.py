@@ -115,6 +115,7 @@ from ..utils.config import AdaptParams, CacheParams, CoalesceParams, \
     coalesce_from_env, qos_from_env, stripe_from_env
 from ..utils.metrics import (Registry, RequestTrace, ensure_emitter,
                              registry as process_registry)
+from . import capture as _capture
 from .adapt import AdaptPlane
 from .miner_plane import Chunk, MinerPlane, MinerState
 from .qos import LAZY_REMOVE
@@ -245,7 +246,8 @@ class Scheduler:
                  clock=None,
                  result_cache: Optional[ResultCache] = None,
                  recv_batch: Optional[int] = None,
-                 trace_sample: Optional[float] = None):
+                 trace_sample: Optional[float] = None,
+                 capture=None):
         self.server = server
         lease = lease if lease is not None else LeaseParams()
         self.cache = cache if cache is not None else CacheParams()
@@ -319,6 +321,22 @@ class Scheduler:
         self._cache_hit_ratio = self.metrics.gauge("cache_hit_ratio")
         # Cross-process tracing plane (ISSUE 10, DBM_TRACE=1 default).
         self._trace_on = _tracing.ensure_tracer()
+        # Workload capture plane (ISSUE 15, DBM_CAPTURE, default OFF):
+        # with the knob off (and no explicit instance) this is None and
+        # every hook below is one attribute test — no capture state
+        # exists anywhere, the bit-for-bit stock contract the knob-off
+        # matrix leg pins. ``capture=`` injects an explicit instance
+        # (harness legs, tests); ``capture=False`` REFUSES env arming —
+        # the replay harness must never let a lingering DBM_CAPTURE=1
+        # open (and truncate) the very file it is replaying (code
+        # review); env-driven processes share ONE capture so the
+        # in-process replica tier interleaves into one trace.
+        if capture is False:
+            self.capture = None
+        elif capture is not None:
+            self.capture = capture
+        else:
+            self.capture = _capture.ensure_from_env()
         # The two planes (ISSUE 11 split; see module docstring).
         # ``clock`` (ISSUE 8) feeds the admission token buckets: the
         # deterministic-schedule explorer (analysis/schedcheck) injects
@@ -330,7 +348,19 @@ class Scheduler:
         self.tenant_plane = TenantPlane(
             self.metrics, self._count, qos, lease,
             clock=clock, close_conn=getattr(server, "close_conn", None),
-            trace_on=self._trace_on, trace_sample=trace_sample)
+            trace_on=self._trace_on, trace_sample=trace_sample,
+            capture=self.capture)
+        if self.capture is not None:
+            # Workload-shape context a replay reproduces (the capture
+            # records knob VALUES, never identities). ``transport``
+            # lets the replay side gate latency fidelity only against
+            # a SAME-transport capture — a real-LSP capture replayed
+            # on detnet differs by the transport's own latency floor,
+            # not by workload shape (found in a live 3-process drive).
+            self.capture.config(max_queued=qos.max_queued,
+                                wholesale_s=qos.wholesale_s,
+                                qos=bool(qos.enabled),
+                                transport=type(server).__name__)
         self.miner_plane = MinerPlane(
             self.metrics, self._count, lease, stripe, coalesce,
             write=self._write, inflight=self._inflight,
@@ -526,6 +556,12 @@ class Scheduler:
         miner 7" without arithmetic, and the owning miner's export track
         is registered (retired again on miner drop). Unsampled requests
         (NULL trace) skip the fold entirely."""
+        if span is not None and self.capture is not None:
+            # Capture sees every served span (ISSUE 15) — independent of
+            # trace sampling and of the trace plane itself, because the
+            # fidelity report's per-phase medians must describe the
+            # WORKLOAD, not the sampled subset.
+            self.capture.span(span)
         if span is None or trace is None or trace.null \
                 or not self._trace_on:
             return
@@ -637,6 +673,16 @@ class Scheduler:
             self._check_leases()
         self.miner_plane.decay_rate_hints()
         self._check_queue_age()
+        if self.capture is not None:
+            # Periodic pool-composition snapshot (ISSUE 15): what a
+            # replay needs to model the serving side — miner count and
+            # rate EWMAs — plus queue/in-flight depth for context.
+            self.capture.maybe_snapshot(
+                miners=len(self.miner_plane.miners),
+                rates=[m.rate_ewma for m in self.miner_plane.miners
+                       if m.rate_ewma],     # cold miners carry None
+                queued=self.tenant_plane.queue_len(),
+                inflight=len(self._inflight))
         if self.adapt_plane is not None:
             self._apply_adapt()
         if self.qos.enabled:
@@ -713,6 +759,13 @@ class Scheduler:
     def _on_request(self, conn_id: int, msg: Message) -> None:
         if self._owner is not None:
             self._owner.assert_here()
+        if self.capture is not None:
+            # Arrival stamp + geometry BEFORE admission (ISSUE 15): a
+            # shed arrival is part of the measured workload — the
+            # capture's shed rate is sheds over ALL arrivals.
+            self.capture.request(conn_id, len(msg.data),
+                                 msg.upper - msg.lower + 1,
+                                 bool(msg.target))
         request = self._build_request(conn_id, msg)
         if request is None:
             return       # answered from the ResultCache at arrival
@@ -775,6 +828,8 @@ class Scheduler:
                 h, nonce = hit
                 self._write(conn_id, new_result(h, nonce))
                 self._count("results_sent")
+                if self.capture is not None:
+                    self.capture.reply(conn_id, 0.0, cached=True)
                 self.tenant_plane.cache_replay_trace(conn_id, key, h, nonce)
                 logger.info("request %r [%d, %d] target=%d answered from "
                             "the result cache", msg.data, msg.lower,
@@ -929,16 +984,21 @@ class Scheduler:
             # Purge the dead client's queued requests FIRST so cancelling
             # its in-flight request can't promote another of its own
             # requests.
-            for req in self.tenant_plane.purge_tenant(conn_id):
+            purged = self.tenant_plane.purge_tenant(conn_id)
+            for req in purged:
                 req.trace.event("cancel", reason="client_drop")
             self.tenant_plane.retire_tenant_track(conn_id)
             if self.qos.enabled:
                 self.qos_plane.forget(conn_id)
+            cancelled = len(purged)
             for req in [r for r in self._inflight.values()
                         if r.conn_id == conn_id]:
                 # Cancel immediately (divergence, see module docstring).
                 req.trace.event("cancel", reason="client_drop")
+                cancelled += 1
                 self._retire(req)
+            if self.capture is not None and cancelled:
+                self.capture.cancel(conn_id, cancelled)
 
     def _on_lease_event(self, kind: str, chunk: Chunk, miner_conn: int,
                         **info) -> None:
@@ -980,6 +1040,8 @@ class Scheduler:
                 _tracing.flight("reissue", job=chunk.job_id,
                                 idx=chunk.idx, from_miner=miner_conn,
                                 to_miner=info["to_miner"])
+            if self.capture is not None:
+                self.capture.reissue()
             logger.warning(
                 "speculatively re-issuing job %d chunk %d [%d, %d) "
                 "from miner %d to miner %d",
@@ -1006,6 +1068,13 @@ class Scheduler:
             self.results.put(curr.cache_key, (h, nonce))
             self._count("cache_stores")
         elapsed = time.monotonic() - curr.started
+        if self.capture is not None:
+            # Arrival-to-reply latency (queued_at, not dispatch start):
+            # the replay harness measures submit-to-reply client-side,
+            # and the fidelity p50/p99 columns must compare like with
+            # like.
+            self.capture.reply(curr.conn_id,
+                               time.monotonic() - curr.queued_at)
         curr.trace.event("reply", hash=h, nonce=nonce, early=early,
                          weak=curr.weak, elapsed_s=round(elapsed, 6))
         if self._trace_on:
@@ -1129,6 +1198,14 @@ class Scheduler:
             return False
         self._write(req.conn_id, new_result(*hit))
         self._count("results_sent")
+        if self.capture is not None:
+            # Every results_sent path records a reply (code review:
+            # a missing one under-counts completions in the baseline
+            # and fails faithful replays on admitted_ratio). Real
+            # queue wait — this copy did sit in line.
+            self.capture.reply(req.conn_id,
+                               time.monotonic() - req.queued_at,
+                               cached=True)
         self.tenant_plane.observe_queue_wait(
             time.monotonic() - req.queued_at)
         req.trace.event("cache_hit", at="dispatch")

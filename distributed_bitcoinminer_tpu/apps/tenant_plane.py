@@ -58,13 +58,18 @@ class TenantPlane:
                  qos: QosParams, lease: LeaseParams, *,
                  clock=None, close_conn: Optional[Callable] = None,
                  trace_on: bool = False,
-                 trace_sample: Optional[float] = None):
+                 trace_sample: Optional[float] = None,
+                 capture=None):
         self.metrics = metrics
         self._count = count
         self.qos = qos
         self.lease = lease
         self._close_conn = close_conn
         self._trace_on = trace_on
+        # Workload capture plane (ISSUE 15): the scheduler hands its
+        # capture handle down so the shed path records one event per
+        # victim (None = plane off, the hook is one attribute test).
+        self._capture = capture
         # Trace sampling (ISSUE 11, DBM_TRACE_SAMPLE): 1.0 = stock
         # (every request allocates a real RequestTrace), read once at
         # construction like every other scheduler param.
@@ -275,6 +280,13 @@ class TenantPlane:
         victims = [req] + [r for r in others if r is not req]
         for i, victim in enumerate(victims):
             self._count("qos_shed")
+            if self._capture is not None:
+                # One shed record per victim (ISSUE 15): purged queued
+                # siblings are sheds too — the captured shed rate is
+                # victims over arrivals, exactly what a replay must
+                # reproduce.
+                self._capture.shed(victim.conn_id,
+                                   reason if i == 0 else "conn")
             self.qos_plane.on_shed(victim.conn_id,
                                    reason if i == 0 else "conn")
             victim.trace.event("cancel", reason="shed", shed_reason=reason)

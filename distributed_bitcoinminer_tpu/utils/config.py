@@ -421,6 +421,44 @@ Environment variables:
   ``DBM_BENCH_LOAD_TENANTS`` is the comma-separated tenant-count
   sweep (default "500,2000"; the checked-in BENCH_r06 artifact used
   "500,2000,10000").
+- ``DBM_CAPTURE`` (default 0): the workload capture plane
+  (apps/capture.py, ISSUE 15). 0 = bit-for-bit stock: no capture
+  object exists anywhere, every scheduler hook is one attribute test
+  (pinned in the knob-off matrix leg). 1 = the scheduler(s) append a
+  versioned JSONL workload trace — per-request arrival stamp, salted-
+  hash tenant key, geometry (range size, argmin vs difficulty, pow2
+  data-size class), shed/cancel/re-issue events, folded span phases,
+  periodic pool-composition snapshots — that ``loadharness --replay``
+  re-drives and the dbmcheck ``replayed_storm`` scenario explores.
+- ``DBM_CAPTURE_PATH`` (default ``dbm_capture.jsonl``): where the
+  env-armed capture writes (explicit harness legs pass
+  ``capture_path=``/``--capture-to`` instead).
+- ``DBM_CAPTURE_LINES`` (default 200000, floor 1024): rotation bound —
+  past this many lines the file rotates (current renamed to
+  ``<path>.1``, previous ``.1`` unlinked), so a long-lived capture
+  holds at most ~two windows on disk and every window is
+  independently loadable (each restarts with its own header).
+- ``DBM_CAPTURE_SNAP_S`` (default 5.0): pool-composition snapshot
+  period (rides the scheduler sweep); doubles as the flush cadence.
+- ``DBM_REPLAY_SPEED`` (default 1.0): replay time-warp — captured
+  inter-arrival gaps are divided by it and rate-limited replay miners
+  are scaled by it (the load factor, i.e. the shape, survives the
+  warp); the fidelity p99 bound only gates at 1.0.
+- ``DBM_CHECK_CAPTURE`` (default: the checked-in
+  ``analysis/schedcheck/replay_fixture.jsonl``): capture file the
+  dbmcheck ``replayed_storm`` scenario replays — the tier-1 replay
+  leg points it at the storm it just captured, so interleaving
+  exploration runs over that session's own measured traffic.
+- ``DBM_TIER1_REPLAY`` (0 disables): scripts/tier1.sh's replay leg —
+  capture a mini detnet storm (``loadharness --capture-to``), replay
+  it under the fidelity gate (``--replay --assert-fidelity``), then
+  run the ``replayed_storm`` dbmcheck scenario over the fresh capture
+  with a >=500 distinct-schedule floor.
+- ``DBM_BENCH_REPLAY`` (0 disables) / ``DBM_BENCH_REPLAY_ROUNDS``
+  (default 2): ``bench.py detail.replay`` — capture a synthesized
+  storm, replay it, embed the side-by-side fidelity report (capture's
+  own admitted/s, shed rate, p50/p99, span medians vs each replay
+  round's, plus the ``within`` verdict).
 """
 
 from __future__ import annotations

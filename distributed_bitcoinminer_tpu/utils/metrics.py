@@ -369,6 +369,13 @@ class Emitter(threading.Thread):
                "interval_s": self.interval_s,
                "uptime_s": round(time.monotonic() - self._t0, 3),
                "snapshot": self.registry.snapshot()}
+        # Crash/exit artifacts name the active workload capture
+        # (ISSUE 15): the final atexit dump is often the only line an
+        # operator has after an incident, and "which traffic produced
+        # this" should be on it.
+        info = capture_info()
+        if info is not None:
+            doc["capture"] = info
         self.logger.info("%s", json.dumps(doc, sort_keys=True))
 
     def run(self) -> None:
@@ -388,6 +395,48 @@ class Emitter(threading.Thread):
 _default_registry = Registry()
 _emitter: Optional[Emitter] = None
 _emitter_lock = threading.Lock()
+
+# Active workload capture (ISSUE 15): the capture plane registers a
+# zero-argument info callable here so CRASH ARTIFACTS name the workload
+# that produced them — the flight-recorder dump (utils/trace.py) and
+# the emitter's snapshot lines (incl. the atexit final dump) both embed
+# it. Lives in this module because it is the bottom layer both sides
+# already import (trace.py cannot be imported from here, and the apps
+# layer cannot be imported from either).
+_capture_info = None
+_capture_info_lock = threading.Lock()
+
+
+def set_capture_info(fn) -> None:
+    """Register the active capture's info callable (or None to clear)."""
+    global _capture_info
+    with _capture_info_lock:
+        _capture_info = fn
+
+
+def clear_capture_info(fn) -> None:
+    """Clear the slot iff ``fn`` still owns it (a test's short-lived
+    capture must not clobber the process capture's registration)."""
+    global _capture_info
+    with _capture_info_lock:
+        if _capture_info is fn:
+            _capture_info = None
+
+
+def capture_info() -> Optional[dict]:
+    """The active capture's ``{"path", "lines", ...}``, or None.
+
+    Never raises: a capture mid-close returning garbage must not take
+    down the alarm path embedding this."""
+    with _capture_info_lock:
+        fn = _capture_info
+    if fn is None:
+        return None
+    try:
+        info = fn()
+    except Exception:   # noqa: BLE001 — crash-artifact path, best effort
+        return None
+    return info if isinstance(info, dict) else None
 
 
 def registry() -> Registry:

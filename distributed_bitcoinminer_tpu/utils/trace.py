@@ -62,7 +62,7 @@ from collections import deque
 from typing import Dict, Optional, Tuple
 
 from ._env import float_env as _float_env, int_env as _int_env
-from .metrics import registry as _registry
+from .metrics import capture_info as _capture_info, registry as _registry
 
 _log = logging.getLogger("dbm.trace")
 
@@ -173,13 +173,19 @@ class FlightRecorder:
         return len(self._d)
 
     def dump(self, why: str) -> None:
-        """One JSON line with the whole ring (oldest first)."""
+        """One JSON line with the whole ring (oldest first). When a
+        workload capture is active (ISSUE 15) the dump names it (path +
+        line count) — a crash artifact points at the trace of the
+        traffic that produced it."""
         if self.cap <= 0:
             return
         self._dumps.inc()
-        _log.warning("flight recorder dump (%s): %s", why, json.dumps(
-            {"why": why, "events": self.events()}, sort_keys=True,
-            default=str))
+        doc = {"why": why, "events": self.events()}
+        info = _capture_info()
+        if info is not None:
+            doc["capture"] = info
+        _log.warning("flight recorder dump (%s): %s", why,
+                     json.dumps(doc, sort_keys=True, default=str))
 
 
 _flight: Optional[FlightRecorder] = None

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""benchdiff — machine-readable diff of two bench artifacts (ISSUE 15).
+
+The bench trajectory (``BENCH_r*.json`` / ``MULTICHIP_r*.json``) grew a
+probe dict per PR but no comparator: "did detail.qos regress between
+r05 and r06" was a human eyeballing two JSON trees. This script walks
+two artifacts, pairs every numeric leaf by path, classifies each leaf
+by its key name (higher-better like ``nps``/``admitted_per_s``,
+lower-better like ``p99_s``/``cpu_s_per_request``, or informational —
+configuration echoes and counts are never gated), and prints a
+per-probe regression table.
+
+    python scripts/benchdiff.py BENCH_r05.json BENCH_r06.json
+    python scripts/benchdiff.py OLD.json NEW.json --threshold 0.25
+    python scripts/benchdiff.py OLD.json NEW.json --json > diff.json
+
+Exit codes: 0 no directional metric regressed past ``--threshold``
+(default 0.20 = 20%), 1 at least one did (each flagged ``REGRESSED``
+in the table), 2 usage/IO. Paths only in one artifact are listed as
+added/removed, never gated — a new probe is not a regression.
+
+Direction is classified by the LAST path segment (word-boundary
+matching against the pattern lists below); anything unmatched is
+``info``. Sample lists (``*_samples``, ``samples``) and obvious
+config echoes are skipped entirely.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+# Leaf-name patterns. Matched as whole words against the FINAL path
+# segment (lowercased); first hit wins, higher-better checked first so
+# e.g. "rate_gain" beats the lower-better "rate" guard below.
+_HIGHER = (
+    "nps", "value", "vs_baseline", "admitted_per_s", "speedup",
+    "rate_gain", "dispatch_reduction", "efficiency", "throughput",
+    "completed", "hit_ratio", "gain", "admitted_ratio",
+)
+_LOWER = (
+    "p50_s", "p99_s", "p50", "p99", "cpu_s_per_request", "makespan_s",
+    "latency_s", "latency", "shed_rate", "regression", "compile_s",
+    "elapsed_s", "overhead", "dispatches_per_mouse", "timed_s",
+    "queue_wait_s", "shed_delta",
+)
+#: Path segments that are configuration/noise, never metrics: the walk
+#: prunes the whole subtree.
+_SKIP_SEGMENTS = ("samples", "on_samples", "off_samples", "adapt_state",
+                  "snapshot", "metrics", "hoist", "capture")
+_SKIP_RE = re.compile(r"(^|_)(range|rounds|repeats|tenants|miners|"
+                      r"replicas|batch|lanes|devices|depth|size|seed|"
+                      r"count|lower|upper|warmup_s|interval|port|pid)"
+                      r"(_|$)")
+
+
+def _direction(segment: str) -> str:
+    seg = segment.lower()
+    for pat in _HIGHER:
+        if seg == pat:
+            return "higher"
+    for pat in _LOWER:
+        if seg == pat:
+            return "lower"
+    return "info"
+
+
+def _leaves(obj, path=()):
+    """(path_tuple, number) for every numeric leaf, pruning noise."""
+    if isinstance(obj, dict):
+        for key, val in obj.items():
+            key = str(key)
+            if key in _SKIP_SEGMENTS:
+                continue
+            yield from _leaves(val, path + (key,))
+    elif isinstance(obj, bool):
+        return
+    elif isinstance(obj, (int, float)):
+        if path and not _SKIP_RE.search(path[-1].lower()):
+            yield path, float(obj)
+    # Lists are samples/sweeps — per-element pairing across artifacts
+    # is not stable, so they are never diffed.
+
+
+def diff(old: dict, new: dict, threshold: float) -> dict:
+    old_leaves = dict(_leaves(old))
+    new_leaves = dict(_leaves(new))
+    rows = []
+    regressions = 0
+    for path in sorted(set(old_leaves) & set(new_leaves)):
+        a, b = old_leaves[path], new_leaves[path]
+        direction = _direction(path[-1])
+        if a == 0:
+            change = None
+        else:
+            change = (b - a) / abs(a)
+        verdict = "info"
+        if direction != "info" and change is not None:
+            worse = change < -threshold if direction == "higher" \
+                else change > threshold
+            better = change > threshold if direction == "higher" \
+                else change < -threshold
+            verdict = ("REGRESSED" if worse
+                       else "improved" if better else "ok")
+            if worse:
+                regressions += 1
+        rows.append({"path": "/".join(path), "old": a, "new": b,
+                     "change": round(change, 4)
+                     if change is not None else None,
+                     "direction": direction, "verdict": verdict})
+    return {
+        "threshold": threshold,
+        "rows": rows,
+        "regressions": regressions,
+        "added": sorted("/".join(p)
+                        for p in set(new_leaves) - set(old_leaves)),
+        "removed": sorted("/".join(p)
+                          for p in set(old_leaves) - set(new_leaves)),
+    }
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def print_table(result: dict, all_rows: bool) -> None:
+    rows = [r for r in result["rows"]
+            if all_rows or r["verdict"] != "info"]
+    if rows:
+        width = max(len(r["path"]) for r in rows)
+        print(f"{'metric':<{width}}  {'old':>12}  {'new':>12}  "
+              f"{'change':>8}  verdict")
+        for r in rows:
+            pct = (f"{r['change'] * 100:+.1f}%"
+                   if r["change"] is not None else "n/a")
+            print(f"{r['path']:<{width}}  {_fmt(r['old']):>12}  "
+                  f"{_fmt(r['new']):>12}  {pct:>8}  {r['verdict']}")
+    else:
+        print("no comparable directional metrics")
+    for key in ("added", "removed"):
+        if result[key]:
+            print(f"{key} ({len(result[key])}): "
+                  + ", ".join(result[key][:8])
+                  + (" ..." if len(result[key]) > 8 else ""))
+    print(f"BENCHDIFF_REGRESSIONS={result['regressions']} "
+          f"(threshold {result['threshold'] * 100:.0f}%)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="benchdiff", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="regression fraction past which a directional "
+                         "metric fails the diff (default 0.20)")
+    ap.add_argument("--all", action="store_true",
+                    help="print informational rows too, not only "
+                         "directional metrics")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the diff as one JSON object instead of "
+                         "a table")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.old, encoding="utf-8") as fh:
+            old = json.load(fh)
+        with open(args.new, encoding="utf-8") as fh:
+            new = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"benchdiff: {exc}", file=sys.stderr)
+        return 2
+    result = diff(old, new, args.threshold)
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        print_table(result, args.all)
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,14 @@ Two modes (ISSUE 10):
     process/miner/tenant, request slices, instant fault events, and the
     stitched miner-side phase spans.
 
+``python scripts/dbmtrace.py summarize DUMP_OR_CAPTURE...``
+    Text summary straight from a trace dump OR a workload capture file
+    (ISSUE 15) — per-phase span medians (count/p50/p90/max) and the
+    slowest-request table — without the Perfetto round-trip. Inputs
+    auto-detect per line: stitched trace dicts (``convert``'s input
+    format) and capture records (``span``/``rep`` lines) both feed the
+    same tables.
+
 ``python scripts/dbmtrace.py demo -o trace.json``
     Run the acceptance scenario in-process — a mixed-load storm
     (one elephant + a wave of mice, coalescing on, one wedged miner)
@@ -79,6 +87,108 @@ def convert(paths: list, out: str) -> int:
         json.dump(doc, fh, sort_keys=True)
     print(f"dbmtrace: {len(dicts)} trace(s) -> {out} "
           f"({len(doc['traceEvents'])} events)")
+    return 0
+
+
+# ---------------------------------------------------------------- summarize
+
+
+def _iter_records(path: str):
+    """Auto-detecting line reader: yields ``("trace", dict)`` for
+    stitched trace dicts (incl. ``trace dump (...)`` log lines) and
+    ``("capture", dict)`` for workload-capture records."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            if _DUMP_MARK in line:
+                at = line.find("): ", line.index(_DUMP_MARK))
+                if at < 0:
+                    continue
+                line = line[at + 3:]
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if "events" in obj:
+                yield "trace", obj
+            elif "k" in obj:
+                yield "capture", obj
+
+
+def _pctl(xs: list, q: float) -> float:
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def summarize(paths: list, top: int) -> int:
+    from distributed_bitcoinminer_tpu.utils.trace import SPAN_PHASES
+    phases = {}                   # phase -> [seconds]
+    slowest = []                  # (elapsed_s, label, detail)
+    n_traces = n_spans = 0
+    for path in paths:
+        for kind, obj in _iter_records(path):
+            if kind == "capture":
+                k = obj.get("k")
+                if k == "span":
+                    n_spans += 1
+                    for ph in SPAN_PHASES:
+                        v = obj.get(ph)
+                        if isinstance(v, (int, float)):
+                            phases.setdefault(ph, []).append(float(v))
+                elif k == "rep" and not obj.get("cached"):
+                    slowest.append((float(obj.get("el", 0.0)),
+                                    f"tenant {obj.get('ten')}",
+                                    f"t={obj.get('t')}"))
+                continue
+            n_traces += 1
+            events = obj.get("events", [])
+            reply = next((e for e in events
+                          if e.get("event") == "reply"), None)
+            worst_phase, worst_v = None, 0.0
+            for ev in events:
+                if ev.get("event") != "miner_span":
+                    continue
+                n_spans += 1
+                for ph in SPAN_PHASES:
+                    v = ev.get(ph)
+                    if isinstance(v, (int, float)):
+                        phases.setdefault(ph, []).append(float(v))
+                        if v > worst_v:
+                            worst_phase, worst_v = ph[:-2], float(v)
+            if reply is not None and isinstance(
+                    reply.get("elapsed_s"), (int, float)):
+                meta = obj.get("meta", {})
+                label = (f"{obj.get('key')} "
+                         f"(tenant {meta.get('client')})")
+                detail = (f"slowest phase {worst_phase} {worst_v:.4f}s"
+                          if worst_phase else "no spans folded")
+                slowest.append((float(reply["elapsed_s"]), label,
+                                detail))
+    if not phases and not slowest:
+        print("dbmtrace summarize: no spans or replies found in "
+              f"{paths}", file=sys.stderr)
+        return 1
+    print(f"{n_traces} trace(s), {n_spans} span(s), "
+          f"{len(slowest)} replied request(s)\n")
+    if phases:
+        print(f"{'phase':<10} {'count':>7} {'p50':>10} {'p90':>10} "
+              f"{'max':>10}")
+        for ph in SPAN_PHASES:
+            xs = sorted(phases.get(ph, ()))
+            if not xs:
+                continue
+            print(f"{ph[:-2]:<10} {len(xs):>7} {_pctl(xs, 0.5):>10.6f} "
+                  f"{_pctl(xs, 0.9):>10.6f} {xs[-1]:>10.6f}")
+    if slowest:
+        slowest.sort(key=lambda r: -r[0])
+        print(f"\nslowest {min(top, len(slowest))} request(s):")
+        for elapsed, label, detail in slowest[:top]:
+            print(f"  {elapsed:>10.4f}s  {label}  [{detail}]")
     return 0
 
 
@@ -237,11 +347,19 @@ def main(argv=None) -> int:
     conv = sub.add_parser("convert", help="trace dumps -> Perfetto JSON")
     conv.add_argument("paths", nargs="+")
     conv.add_argument("-o", "--out", default="dbmtrace.json")
+    summ = sub.add_parser(
+        "summarize",
+        help="per-phase medians + slowest requests from dumps/captures")
+    summ.add_argument("paths", nargs="+")
+    summ.add_argument("--top", type=int, default=10,
+                      help="slowest-request table depth (default 10)")
     dm = sub.add_parser("demo", help="run the mixed-load demo + export")
     dm.add_argument("-o", "--out", default="dbmtrace.json")
     args = ap.parse_args(argv)
     if args.cmd == "convert":
         return convert(args.paths, args.out)
+    if args.cmd == "summarize":
+        return summarize(args.paths, args.top)
     return demo(args.out)
 
 

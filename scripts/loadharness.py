@@ -43,7 +43,11 @@ def main(argv=None) -> int:
                     help="tenant count (default: 1000, or the "
                          "workload's own population with --workload)")
     ap.add_argument("--replicas", type=int, default=None)
-    ap.add_argument("--miners", type=int, default=4)
+    ap.add_argument("--miners", type=int, default=None,
+                    help="miner count (default 4; for a detnet "
+                         "--replay the default models the pool from "
+                         "the capture's own snapshots and an explicit "
+                         "count overrides it)")
     ap.add_argument("--requests-per-tenant", type=int, default=None)
     ap.add_argument("--nonces", type=int, default=None)
     ap.add_argument("--max-queued", type=int, default=None)
@@ -70,6 +74,22 @@ def main(argv=None) -> int:
     ap.add_argument("--adapt", type=int, choices=(0, 1), default=0,
                     help="with --workload: 1 = the self-tuning "
                          "controllers, 0 = the static knob defaults")
+    ap.add_argument("--capture-to", default=None, metavar="PATH",
+                    help="arm the workload capture plane (ISSUE 15) "
+                         "for the storm: the scheduler writes its "
+                         "workload trace there (a --replay input)")
+    ap.add_argument("--replay", default=None, metavar="PATH",
+                    help="REPLAY a captured workload trace instead of "
+                         "synthesizing a storm (ISSUE 15); prints the "
+                         "measurement with the capture's own baseline "
+                         "and the side-by-side fidelity verdict")
+    ap.add_argument("--replay-speed", type=float, default=None,
+                    help="time-warp factor for --replay (default: "
+                         "DBM_REPLAY_SPEED, 1.0)")
+    ap.add_argument("--assert-fidelity", action="store_true",
+                    help="gate (--replay): exit 1 unless the replay "
+                         "lands inside the stated fidelity bounds "
+                         "(fidelity.within)")
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--assert-p99", type=float, default=None,
                     help="gate: reply p99 ceiling in seconds")
@@ -83,10 +103,50 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from distributed_bitcoinminer_tpu.apps.loadharness import (
-        run_adversarial, run_load, run_load_procs)
+        run_adversarial, run_load, run_load_procs, run_replay,
+        run_replay_procs)
     before = _series_count()
     tenants = args.tenants if args.tenants is not None else 1000
-    if args.workload is not None:
+    miners = args.miners if args.miners is not None else 4
+    if args.replay is not None:
+        # The CAPTURE owns the workload shape; storm-shape flags are
+        # refused like --workload refuses them (silently dropping one
+        # would print JSON that looks like the requested configuration
+        # was measured).
+        for flag, value in (("--workload", args.workload),
+                            ("--tenants", args.tenants),
+                            ("--requests-per-tenant",
+                             args.requests_per_tenant),
+                            ("--nonces", args.nonces),
+                            ("--max-queued", args.max_queued),
+                            ("--capture-to", args.capture_to),
+                            ("--recv-batch", args.recv_batch),
+                            ("--trace-sample", args.trace_sample),
+                            ("--qos-lazy", args.qos_lazy),
+                            ("--drivers", args.drivers
+                             if args.drivers != 1 else None)):
+            if value is not None:
+                ap.error(f"{flag} does not apply to --replay runs "
+                         f"(the capture owns the workload shape)")
+        if args.procs:
+            leg = run_replay_procs(
+                args.replay,
+                replicas=args.replicas if args.replicas is not None
+                else 2,
+                miners=miners, speed=args.replay_speed,
+                timeout_s=args.timeout)
+        else:
+            if args.replicas is not None:
+                ap.error("--replicas applies to --replay only with "
+                         "--procs (the detnet replay is one replica)")
+            # --miners forwards as an override; unset models the pool
+            # from the capture's snapshots (silently dropping it was
+            # the exact failure the refusal block above exists to
+            # prevent — code review).
+            leg = run_replay(args.replay, speed=args.replay_speed,
+                             miners=args.miners,
+                             timeout_s=args.timeout)
+    elif args.workload is not None:
         # The workload SPEC owns replica topology, request counts,
         # nonce sizes, and the queue bound — a storm flag accepted
         # here and silently dropped would print JSON that looks like
@@ -108,12 +168,17 @@ def main(argv=None) -> int:
         leg = run_adversarial(
             args.workload, adapt=bool(args.adapt),
             tenants=args.tenants,
-            miners=args.miners, timeout_s=args.timeout)
+            miners=miners, capture_path=args.capture_to,
+            timeout_s=args.timeout)
     elif args.procs:
+        if args.capture_to is not None:
+            ap.error("--capture-to does not apply to --procs runs "
+                     "(the capture plane is scheduler-resident; arm "
+                     "DBM_CAPTURE in the replica processes' env)")
         leg = run_load_procs(
             tenants=tenants,
             replicas=args.replicas if args.replicas is not None else 1,
-            miners=args.miners,
+            miners=miners,
             requests_per_tenant=args.requests_per_tenant or 1,
             req_nonces=args.nonces or 256, drivers=args.drivers,
             timeout_s=args.timeout)
@@ -121,7 +186,7 @@ def main(argv=None) -> int:
         leg = run_load(
             tenants=tenants,
             replicas=args.replicas if args.replicas is not None else 1,
-            miners=args.miners,
+            miners=miners,
             requests_per_tenant=args.requests_per_tenant or 1,
             req_nonces=args.nonces or 256,
             max_queued=args.max_queued
@@ -129,21 +194,28 @@ def main(argv=None) -> int:
             recv_batch=args.recv_batch, trace_sample=args.trace_sample,
             qos_lazy=(None if args.qos_lazy is None
                       else bool(args.qos_lazy)),
+            capture_path=args.capture_to,
             timeout_s=args.timeout)
     after = _series_count()
     leg["metric_series"] = {"before": before, "after": after}
     print(json.dumps(leg, sort_keys=True), flush=True)
 
     rc = 0
-    if args.workload is not None:
-        # Adversarial workloads SHED BY DESIGN (admission control is
-        # the thing under test): the no-loss rule is that every
-        # request was either answered or shed with its conn closed,
-        # and --assert-complete floors the answered fraction.
+    if args.workload is not None or args.replay is not None:
+        # Adversarial workloads — and replays of shed-heavy captures —
+        # SHED BY DESIGN: the no-loss rule is that every request was
+        # either answered or shed with its conn closed, and
+        # --assert-complete floors the answered fraction.
         expected = leg["requests"] - leg.get("shed_requests", 0)
     else:
         expected = leg["requests"] \
             - leg["shed_tenants"] * (args.requests_per_tenant or 1)
+    if args.replay is not None and args.assert_fidelity:
+        fid = leg.get("fidelity", {})
+        if not fid.get("within"):
+            print(f"LOAD_GATE: replay fidelity outside the stated "
+                  f"bounds: {fid.get('violations')}", file=sys.stderr)
+            rc = 1
     if leg.get("timed_out"):
         print("LOAD_GATE: storm timed out", file=sys.stderr)
         rc = 1

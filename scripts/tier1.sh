@@ -131,6 +131,41 @@ if [ "${DBM_TIER1_MESH:-1}" != "0" ]; then
     echo "MESH_LEG_RC=$mesh_rc"
 fi
 
+# Replay leg (ISSUE 15): the capture→replay round trip as a gate.
+# (a) capture a mini detnet storm (the capture plane armed on the
+# mini-load harness shape); (b) replay the capture under the stated
+# fidelity bounds (--assert-fidelity: admitted/s ratio, p99 ratio,
+# shed delta, request-count equality); (c) run the dbmcheck
+# replayed_storm scenario over the FRESH capture — interleaving
+# exploration on this session's own measured traffic — with the same
+# >=500 distinct-schedule floor as the other dbmcheck legs. No JAX
+# import anywhere. DBM_TIER1_REPLAY=0 skips.
+replay_rc=0
+if [ "${DBM_TIER1_REPLAY:-1}" != "0" ]; then
+    rm -f /tmp/_t1_cap.jsonl /tmp/_t1_cap.jsonl.1 /tmp/_t1_replay.log
+    timeout -k 5 120 python scripts/loadharness.py --tenants 300 \
+        --capture-to /tmp/_t1_cap.jsonl
+    replay_rc=$?
+    if [ "$replay_rc" -eq 0 ]; then
+        timeout -k 5 120 python scripts/loadharness.py \
+            --replay /tmp/_t1_cap.jsonl --assert-fidelity
+        replay_rc=$?
+    fi
+    if [ "$replay_rc" -eq 0 ]; then
+        DBM_CHECK_CAPTURE=/tmp/_t1_cap.jsonl timeout -k 5 150 \
+            python scripts/dbmcheck.py --scenario replayed_storm \
+            --seeds 700 2>&1 | tee /tmp/_t1_replay.log
+        replay_rc=${PIPESTATUS[0]}
+        rdistinct=$(grep -a '^DBMCHECK_DISTINCT=' /tmp/_t1_replay.log | tail -1 | cut -d= -f2)
+        if [ "$replay_rc" -eq 0 ] && [ "${rdistinct:-0}" -lt 500 ]; then
+            echo "REPLAY_FLOOR: only ${rdistinct:-0} distinct schedules" \
+                 "explored (< 500) — treating as failure"
+            replay_rc=3
+        fi
+    fi
+    echo "REPLAY_LEG_RC=$replay_rc"
+fi
+
 # Multi-process smoke leg (ISSUE 12): the REAL process topology on
 # localhost — router + 2 replica processes on their own LSP sockets +
 # 1 miner agent — with a kill -9 of the replica owning an in-flight
@@ -187,16 +222,21 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # sharding model (per-sub partials — the stock multi-device plane)
     # and DBM_ADAPT=0 now pins the flipped default (the plane is ON in
     # the main leg since the ISSUE 13 soak ran clean).
+    # ISSUE 15 addition: DBM_CAPTURE=0 pins the no-capture-plane shape
+    # (the default, pinned EXPLICITLY so an env leak cannot arm it)
+    # with test_capture.py — whose parity pin asserts byte-identical
+    # replies capture-on vs capture-off — in the module list.
     timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
         DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 DBM_MESH=0 \
+        DBM_CAPTURE=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
         tests/test_apps.py tests/test_qos.py tests/test_batch.py \
         tests/test_trace.py tests/test_plane_split.py \
-        tests/test_adapt.py \
+        tests/test_adapt.py tests/test_capture.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}
@@ -207,6 +247,7 @@ fi
 [ "$check_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$check_rc
 [ "$load_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$load_rc
 [ "$adapt_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$adapt_rc
+[ "$replay_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$replay_rc
 [ "$mesh_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$mesh_rc
 [ "$procs_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$procs_rc
 exit $rc

@@ -1,0 +1,601 @@
+"""Workload capture & replay plane (ISSUE 15, ``apps/capture.py``).
+
+Covers the capture file format (versioned header, refusal of unknown
+versions, torn-tail tolerance, the rotation disk bound), the scheduler
+hooks (req/rep/shed/cancel records through a scripted drive, the
+``DBM_CAPTURE=0`` byte-for-byte parity pin the tier-1 knob-off matrix
+leg re-runs), the deterministic replay plan, the capture→replay round
+trip on the detnet harness (shape-equal reports, fidelity inside the
+stated bounds), the fidelity verdict arithmetic (speed rescale, None
+bounds, request-count mismatch), crash-artifact naming (flight dump +
+metrics emitter embed the active capture), the dbmcheck
+``replayed_storm`` scenario, and the ``benchdiff`` / ``dbmtrace
+summarize`` satellites.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps import capture as capmod
+from distributed_bitcoinminer_tpu.apps.capture import (
+    CAPTURE_VERSION, WorkloadCapture, capture_baseline, fidelity,
+    load_capture, replay_plan)
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
+                                                          new_request,
+                                                          new_result)
+from distributed_bitcoinminer_tpu.utils import metrics as umetrics
+from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
+                                                       LeaseParams,
+                                                       QosParams)
+
+MINER_A, MINER_B = 1, 2
+TEN_X, TEN_Y = 10, 11
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def _cap(tmp_path, **kw):
+    kw.setdefault("snap_s", 0.0)
+    return WorkloadCapture(path=str(tmp_path / "cap.jsonl"), **kw)
+
+
+# ---------------------------------------------------------- file format
+
+
+def test_records_round_trip_through_loader(tmp_path):
+    cap = _cap(tmp_path)
+    cap.config(max_queued=64, qos=True)
+    cap.request(5, 8, 256, False)
+    cap.request(6, 8, 4096, True)
+    cap.reply(5, 0.25)
+    cap.reply(6, 0.0, cached=True)
+    cap.shed(7, "overload")
+    cap.cancel(7, 2)
+    cap.reissue()
+    cap.span({"queue_s": 0.1, "force_s": 0.2, "bogus": "dropped",
+              "lanes": 4})
+    cap.maybe_snapshot(miners=2, rates=[1000.0, 2000.0], queued=3,
+                       inflight=1)
+    cap.close()
+    c = load_capture(cap.path)
+    assert c.header["v"] == CAPTURE_VERSION
+    assert c.cfg == {"max_queued": 64, "qos": True}
+    assert [r["mode"] for r in c.reqs] == ["argmin", "diff"]
+    assert [r["n"] for r in c.reqs] == [256, 4096]
+    # Hashed tenant keys: distinct per conn, stable within the capture,
+    # and never the raw conn id.
+    assert c.reqs[0]["ten"] != c.reqs[1]["ten"]
+    assert c.reqs[0]["ten"] == c.reps[0]["ten"]
+    assert "5" != c.reqs[0]["ten"]
+    assert c.reps[1]["cached"] is True
+    assert c.sheds[0]["why"] == "overload"
+    assert c.cancels[0]["n"] == 2
+    assert c.reissues == 1
+    assert c.spans[0]["force_s"] == 0.2
+    assert "bogus" not in c.spans[0]       # whitelist held
+    assert c.pools[0]["rates"] == [1000.0, 2000.0]
+
+
+def test_unknown_version_refused(tmp_path):
+    path = tmp_path / "v99.jsonl"
+    path.write_text(json.dumps({"k": "hdr", "v": 99, "t0": 0}) + "\n")
+    with pytest.raises(ValueError, match="unsupported capture version"):
+        load_capture(str(path))
+
+
+def test_headerless_file_refused(tmp_path):
+    path = tmp_path / "nohdr.jsonl"
+    path.write_text(json.dumps({"k": "req", "t": 0.0, "ten": "x",
+                                "n": 1}) + "\n")
+    with pytest.raises(ValueError, match="not a workload capture"):
+        load_capture(str(path))
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="empty capture"):
+        load_capture(str(empty))
+
+
+def test_torn_tail_line_skipped(tmp_path):
+    cap = _cap(tmp_path)
+    cap.request(1, 8, 64, False)
+    cap.reply(1, 0.1)
+    cap.close()
+    with open(cap.path, "a", encoding="utf-8") as fh:
+        fh.write('{"k": "rep", "t": 9.9, "ten": "torn')   # crash mid-write
+    c = load_capture(cap.path)
+    assert len(c.reqs) == 1 and len(c.reps) == 1
+
+
+def test_records_are_line_durable_without_close(tmp_path):
+    """Every record reaches the OS as it is written (line buffering):
+    a SIGTERM'd/killed process must lose nothing already recorded —
+    atexit does not run on SIGTERM, and a live 3-process drive lost
+    every record between the last snapshot flush and the kill before
+    this was pinned."""
+    cap = _cap(tmp_path)
+    cap.request(1, 8, 64, False)
+    cap.reply(1, 0.1)
+    # No close(), no flush(): read what is durably visible NOW.
+    with open(cap.path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == 3            # header + req + rep
+    cap.close()
+
+
+def test_rotation_bounds_disk(tmp_path):
+    cap = _cap(tmp_path, max_lines=1024)   # ctor floor
+    cap.max_lines = 50                     # tighten for the test
+    for i in range(400):
+        cap.request(i, 8, 64, False)
+    cap.close()
+    assert cap._rotations >= 1
+    # At most ~two windows on disk, nothing else.
+    n_current = sum(1 for _ in open(cap.path, encoding="utf-8"))
+    n_rotated = sum(1 for _ in open(cap.path + ".1", encoding="utf-8"))
+    assert n_current <= 50 and n_rotated <= 50
+    assert not os.path.exists(cap.path + ".2")
+    # Each window restarts with its own header: both load alone.
+    for p in (cap.path, cap.path + ".1"):
+        c = load_capture(p)
+        assert c.header["v"] == CAPTURE_VERSION
+        assert c.reqs
+
+
+def test_rotation_reemits_config(tmp_path):
+    """A rotated-in window keeps the attach config — especially the
+    transport tag the replay side's cross-transport gating needs
+    (code review)."""
+    cap = _cap(tmp_path, max_lines=1024)
+    cap.max_lines = 20
+    cap.config(max_queued=7, transport="AsyncServer")
+    for i in range(60):
+        cap.request(i, 8, 64, False)
+    cap.close()
+    assert cap._rotations >= 1
+    current = load_capture(cap.path)
+    assert current.cfg["max_queued"] == 7
+    assert current.cfg["transport"] == "AsyncServer"
+
+
+# ------------------------------------------------------ scheduler hooks
+
+
+class FakeServer:
+    def __init__(self):
+        self.writes = []
+        self.closed = []
+
+    def write(self, conn_id, payload):
+        self.writes.append((conn_id, Message.from_json(payload)))
+
+    def close_conn(self, conn_id):
+        self.closed.append(conn_id)
+
+
+def _drive(sched):
+    """Scripted storm: two miners, three requests, one tenant flood
+    that trips the max_queued=2 overload shed."""
+    sched._on_join(MINER_A)
+    sched._on_join(MINER_B)
+    sched._pool_rate = 100.0
+    sched._on_request(TEN_X, new_request("alpha", 0, 999))
+    sched._on_request(TEN_Y, new_request("beta", 0, 499))
+    sched._on_request(TEN_X, new_request("gamma", 0, 99))
+    sched._on_request(TEN_Y, new_request("delta", 0, 99))
+    for _ in range(400):
+        popped = None
+        for m in sched.miners:
+            if m.pending:
+                popped = m.pending[0]
+                sched._on_result(m.conn_id,
+                                 new_result(1_000_000 + popped.lower,
+                                            popped.lower))
+                break
+        if popped is None:
+            break
+
+
+def _sched(capture=None, max_queued=0):
+    return Scheduler(FakeServer(), lease=LeaseParams(),
+                     cache=CacheParams(enabled=False),
+                     qos=QosParams(enabled=True, max_queued=max_queued),
+                     capture=capture)
+
+
+def test_scheduler_hooks_record_the_drive(tmp_path):
+    cap = _cap(tmp_path)
+    sched = _sched(capture=cap, max_queued=2)
+    _drive(sched)
+    cap.close()
+    c = load_capture(cap.path)
+    assert len(c.reqs) == 4                   # every arrival, shed or not
+    assert c.cfg["max_queued"] == 2
+    # max_queued=2 sheds oldest queued work as the flood lands; sheds +
+    # replies + cancels cover what the drive produced.
+    assert len(c.sheds) == sched.stats["qos_shed"] > 0
+    assert len(c.reps) == sched.stats["results_sent"] > 0
+    # Distinct tenants stayed distinct through the hash.
+    assert len({r["ten"] for r in c.reqs}) == 2
+
+
+def test_capture_off_is_bit_for_bit_stock(monkeypatch, tmp_path):
+    """The tier-1 matrix-leg pin: DBM_CAPTURE=0 (and unset — the
+    default) builds NO capture, and every write a capture-armed
+    scheduler emits is byte-identical to the stock one's — the plane
+    is observability-only by construction."""
+    monkeypatch.delenv("DBM_CAPTURE", raising=False)
+    assert _sched().capture is None            # default off
+    monkeypatch.setenv("DBM_CAPTURE", "0")
+    assert _sched().capture is None
+    cap = _cap(tmp_path)
+    on = _sched(capture=cap, max_queued=2)
+    off = _sched(max_queued=2)
+    _drive(on)
+    _drive(off)
+    cap.close()
+    assert [(c, m.to_json()) for c, m in on.server.writes] == \
+        [(c, m.to_json()) for c, m in off.server.writes]
+    assert on.server.closed == off.server.closed
+
+
+def test_capture_false_refuses_env_arming(monkeypatch, tmp_path):
+    """The replay-side guard (code review): ``capture=False`` must not
+    let a lingering DBM_CAPTURE=1 open — and truncate — the capture
+    file, which may be the very file being replayed."""
+    path = tmp_path / "precious.jsonl"
+    cap = WorkloadCapture(path=str(path), snap_s=0.0)
+    cap.request(1, 8, 64, False)
+    cap.close()
+    before = path.read_text()
+    monkeypatch.setenv("DBM_CAPTURE", "1")
+    monkeypatch.setenv("DBM_CAPTURE_PATH", str(path))
+    try:
+        sched = Scheduler(FakeServer(), lease=LeaseParams(),
+                          cache=CacheParams(enabled=False),
+                          qos=QosParams(), capture=False)
+        assert sched.capture is None
+        assert path.read_text() == before      # not truncated
+    finally:
+        capmod.close_active()
+
+
+def test_replay_does_not_truncate_source_under_env_capture(
+        monkeypatch, tmp_path):
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        run_load, run_replay)
+    path = str(tmp_path / "storm.jsonl")
+    run_load(tenants=20, replicas=1, miners=2, req_nonces=128,
+             capture_path=path, timeout_s=30.0)
+    monkeypatch.setenv("DBM_CAPTURE", "1")
+    monkeypatch.setenv("DBM_CAPTURE_PATH", path)
+    try:
+        rep = run_replay(path, timeout_s=30.0)
+    finally:
+        capmod.close_active()
+    assert rep["completed"] == 20
+    # The source survived the replay and still loads.
+    assert len(load_capture(path).reqs) == 20
+
+
+def test_env_armed_capture_is_process_shared(monkeypatch, tmp_path):
+    path = str(tmp_path / "env_cap.jsonl")
+    monkeypatch.setenv("DBM_CAPTURE", "1")
+    monkeypatch.setenv("DBM_CAPTURE_PATH", path)
+    try:
+        a = _sched()
+        b = _sched()
+        assert a.capture is b.capture          # one trace per process
+        assert a.capture.path == path
+    finally:
+        capmod.close_active()
+    assert capmod.ensure_from_env() is not None
+    capmod.close_active()
+    monkeypatch.setenv("DBM_CAPTURE", "0")
+    assert capmod.ensure_from_env() is None
+
+
+# --------------------------------------------------- plan + round trip
+
+
+def test_replay_plan_is_deterministic(tmp_path):
+    cap = _cap(tmp_path)
+    for i in range(20):
+        cap.request(i % 7, 8, 128 + i, i % 3 == 0)
+    cap.close()
+    c1, c2 = load_capture(cap.path), load_capture(cap.path)
+    assert replay_plan(c1) == replay_plan(c2)
+    plan = replay_plan(c1)
+    assert len(plan) == 7
+    assert [p["name"] for p in plan] == [f"r{i}" for i in range(7)]
+    assert sum(len(p["reqs"]) for p in plan) == 20
+    assert replay_plan(c1, max_tenants=3) == plan[:3]
+    # Offsets are relative and non-negative.
+    assert plan[0]["start"] == 0.0
+    for p in plan:
+        assert p["reqs"][0][0] == 0.0
+        assert all(dt >= 0 for dt, _n, _m, _d in p["reqs"])
+
+
+def test_capture_replay_round_trip_shape_equal(tmp_path):
+    """The acceptance round trip: a captured synthesized storm replays
+    with the same request population — and twice in a row with
+    shape-equal reports — inside the stated fidelity bounds."""
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        run_load, run_replay)
+    path = str(tmp_path / "storm.jsonl")
+    leg = run_load(tenants=120, replicas=1, miners=3, req_nonces=256,
+                   capture_path=path, timeout_s=60.0)
+    assert leg["completed"] == 120
+    reps = [run_replay(path, timeout_s=60.0) for _ in range(2)]
+    for rep in reps:
+        assert rep["requests"] == 120          # every captured arrival
+        assert rep["completed"] == 120         # instant pool: all served
+        assert rep["shed_requests"] == 0
+        assert rep["capture"]["requests"] == 120
+        assert rep["fidelity"]["within"], rep["fidelity"]
+    # Shape-equal across replays: same population, same outcome set.
+    assert reps[0]["requests"] == reps[1]["requests"]
+    assert reps[0]["completed"] == reps[1]["completed"]
+    assert reps[0]["tenants"] == reps[1]["tenants"]
+
+
+def test_replay_max_tenants_compares_against_window_baseline(tmp_path):
+    """A max_tenants-truncated replay gates against the SAME tenant
+    window's baseline — comparing against the full capture guaranteed
+    a request-count violation (code review)."""
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        run_load, run_replay)
+    path = str(tmp_path / "storm.jsonl")
+    run_load(tenants=40, replicas=1, miners=2, req_nonces=128,
+             capture_path=path, timeout_s=30.0)
+    rep = run_replay(path, max_tenants=10, timeout_s=30.0)
+    assert rep["requests"] == 10
+    assert rep["capture"]["requests"] == 10     # windowed baseline
+    assert rep["completed"] == 10
+    assert rep["fidelity"]["within"], rep["fidelity"]
+
+
+def test_replay_preserves_geometry_mix(tmp_path):
+    """Difficulty mode and range sizes survive the round trip: the
+    replayed scheduler sees the captured geometry, not a homogenized
+    one."""
+    cap = _cap(tmp_path)
+    cap.config(max_queued=0, qos=True, wholesale_s=5.0)
+    cap.request(1, 8, 512, False)
+    cap.request(2, 8, 2048, True)
+    cap.reply(1, 0.01)
+    cap.reply(2, 0.01)
+    cap.close()
+    from distributed_bitcoinminer_tpu.apps.loadharness import run_replay
+    rep = run_replay(cap.path, timeout_s=30.0)
+    assert rep["completed"] == rep["requests"] == 2
+
+
+# ------------------------------------------------------------- fidelity
+
+
+def test_fidelity_speed_rescale_and_bounds():
+    base = {"requests": 100, "admitted_per_s": 100.0, "p99_s": 1.0,
+            "shed_rate": 0.1}
+    rep = {"requests": 100, "admitted_per_s": 400.0, "p99_s": 3.0,
+           "shed_rate": 0.15}
+    out = fidelity(base, rep, speed=4.0)
+    assert out["admitted_ratio"] == 1.0        # rescaled by the warp
+    assert out["within"], out                  # p99 ungated off 1.0 speed
+    out1 = fidelity(base, rep, speed=1.0)
+    assert out1["admitted_ratio"] == 4.0
+    assert not out1["within"]
+    assert any("admitted" in v for v in out1["violations"])
+
+
+def test_fidelity_zero_replay_rate_still_gates():
+    """A near-dead replay's admitted/s rounds to 0.0; truthiness would
+    skip the ratio gate exactly then (code review)."""
+    base = {"requests": 3000, "admitted_per_s": 50.0, "p99_s": 1.0,
+            "shed_rate": 0.0}
+    rep = {"requests": 3000, "admitted_per_s": 0.0, "p99_s": 0.0,
+           "shed_rate": 0.0}
+    out = fidelity(base, rep)
+    assert not out["within"]
+    assert any("admitted" in v for v in out["violations"])
+    assert any("p99" in v for v in out["violations"])
+
+
+def test_baseline_excludes_cached_replies_from_percentiles(tmp_path):
+    cap = _cap(tmp_path)
+    cap.request(1, 8, 64, False)
+    cap.request(2, 8, 64, False)
+    cap.reply(1, 2.0)
+    cap.reply(2, 0.0, cached=True)
+    cap.close()
+    base = capture_baseline(load_capture(cap.path))
+    assert base["completed"] == 2          # cached replies still served
+    assert base["p50_s"] == 2.0            # but never deflate latency
+
+
+def test_fidelity_none_bound_reports_without_gating():
+    base = {"requests": 10, "admitted_per_s": 100.0, "p99_s": 1.0,
+            "shed_rate": 0.0}
+    rep = {"requests": 10, "admitted_per_s": 5.0, "p99_s": 9.0,
+           "shed_rate": 0.0}
+    out = fidelity(base, rep, bounds={"admitted_ratio": None,
+                                     "p99_ratio": None})
+    assert out["admitted_ratio"] == 0.05       # still reported
+    assert out["within"], out                  # but not gated
+
+
+def test_fidelity_request_count_mismatch_fails():
+    base = {"requests": 100, "shed_rate": 0.0}
+    rep = {"requests": 60, "shed_rate": 0.0}
+    out = fidelity(base, rep)
+    assert not out["within"]
+    assert any("60 requests for 100" in v for v in out["violations"])
+
+
+# -------------------------------------------- crash artifacts name it
+
+
+def test_flight_dump_names_active_capture(tmp_path, caplog):
+    from distributed_bitcoinminer_tpu.utils.trace import FlightRecorder
+    cap = _cap(tmp_path)
+    cap.request(1, 8, 64, False)
+    try:
+        ring = FlightRecorder(cap=16)
+        ring.record("dispatch", job=1)
+        with caplog.at_level(logging.WARNING, logger="dbm.trace"):
+            ring.dump("test alarm")
+    finally:
+        cap.close()
+    dumped = [r.getMessage() for r in caplog.records
+              if "flight recorder dump" in r.getMessage()]
+    assert dumped
+    doc = json.loads(dumped[-1].split(": ", 1)[1])
+    assert doc["capture"]["path"] == cap.path
+    assert doc["capture"]["lines"] >= 2        # header + one record
+    # After close the slot clears: no stale pointer in later dumps.
+    with caplog.at_level(logging.WARNING, logger="dbm.trace"):
+        ring.dump("after close")
+    doc2 = json.loads(
+        [r.getMessage() for r in caplog.records
+         if "after close" in r.getMessage()][-1].split(": ", 1)[1])
+    assert "capture" not in doc2
+
+
+def test_metrics_emitter_final_dump_names_capture(tmp_path, caplog):
+    cap = _cap(tmp_path)
+    try:
+        emitter = umetrics.Emitter(umetrics.Registry(), 1000.0)
+        with caplog.at_level(logging.INFO, logger="dbm.metrics"):
+            emitter.emit(final=True)
+    finally:
+        cap.close()
+    lines = [r.getMessage() for r in caplog.records
+             if '"event": "metrics"' in r.getMessage()]
+    assert lines
+    doc = json.loads(lines[-1])
+    assert doc["final"] is True
+    assert doc["capture"]["path"] == cap.path
+
+
+# ------------------------------------------------- replayed_storm
+
+
+def test_replayed_storm_scenario_clean_sweep():
+    """The measured-traffic scenario holds the full invariant pack over
+    a seeded sweep of the checked-in fixture (the tier-1 replay leg
+    explores >=500 distinct schedules over a FRESH capture)."""
+    from distributed_bitcoinminer_tpu.analysis.schedcheck.scenario \
+        import execute
+    from distributed_bitcoinminer_tpu.analysis.schedcheck.scenarios \
+        import ReplayedStorm
+    for seed in range(15):
+        result = execute(ReplayedStorm(), seed)
+        assert not result.failed, \
+            f"seed {seed}: {result.violations}"
+
+
+def test_replayed_storm_reads_dbm_check_capture(monkeypatch, tmp_path):
+    cap = _cap(tmp_path)
+    for i in range(12):
+        cap.request(i % 5, 8, 200, False)
+    cap.maybe_snapshot(miners=2, rates=[800.0, 3200.0], queued=0,
+                       inflight=0)
+    cap.close()
+    monkeypatch.setenv("DBM_CHECK_CAPTURE", cap.path)
+    from distributed_bitcoinminer_tpu.analysis.schedcheck.scenario \
+        import execute
+    from distributed_bitcoinminer_tpu.analysis.schedcheck.scenarios \
+        import ReplayedStorm
+    result = execute(ReplayedStorm(), 3)
+    assert not result.failed, result.violations
+
+
+# ------------------------------------------------------ CLI satellites
+
+
+def _load_script(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        f"_cli_{name}", os.path.join(_SCRIPTS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_benchdiff_flags_regressions_and_exits_nonzero(tmp_path):
+    benchdiff = _load_script("benchdiff")
+    old = {"value": 100.0, "detail": {"qos": {"p99_s": 1.0,
+                                              "rounds": 3},
+                                      "load": {"admitted_per_s": 50.0}}}
+    new = json.loads(json.dumps(old))
+    new["detail"]["qos"]["p99_s"] = 2.0        # 2x worse, lower-better
+    a, b = tmp_path / "old.json", tmp_path / "new.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    result = benchdiff.diff(old, new, 0.2)
+    rows = {r["path"]: r for r in result["rows"]}
+    assert rows["detail/qos/p99_s"]["verdict"] == "REGRESSED"
+    assert rows["value"]["verdict"] == "ok"
+    assert "detail/qos/rounds" not in rows     # config, never gated
+    # Identical artifacts: clean exit.
+    assert benchdiff.main([str(a), str(a)]) == 0
+    # Improvement is not a regression.
+    better = json.loads(json.dumps(old))
+    better["detail"]["qos"]["p99_s"] = 0.4
+    c = tmp_path / "better.json"
+    c.write_text(json.dumps(better))
+    assert benchdiff.main([str(a), str(c)]) == 0
+
+
+def test_benchdiff_added_removed_not_gated(tmp_path):
+    benchdiff = _load_script("benchdiff")
+    old = {"value": 1.0}
+    new = {"value": 1.0, "detail": {"replay": {"p99_s": 9.0}}}
+    a, b = tmp_path / "o.json", tmp_path / "n.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 0
+    result = benchdiff.diff(old, new, 0.2)
+    assert "detail/replay/p99_s" in result["added"]
+
+
+def test_dbmtrace_summarize_reads_captures_and_dumps(tmp_path, capsys):
+    dbmtrace = _load_script("dbmtrace")
+    cap = _cap(tmp_path)
+    cap.span({"queue_s": 0.1, "force_s": 0.4})
+    cap.span({"queue_s": 0.2, "force_s": 0.6})
+    cap.reply(1, 1.25)
+    cap.reply(2, 0.75)
+    cap.close()
+    trace_dump = tmp_path / "dump.jsonl"
+    trace_dump.write_text(json.dumps({
+        "key": 7, "meta": {"client": 42},
+        "events": [
+            {"t": 0.0, "event": "enqueue"},
+            {"t": 0.1, "event": "miner_span", "miner": 1,
+             "queue_s": 0.05, "force_s": 0.3},
+            {"t": 0.5, "event": "reply", "elapsed_s": 0.5},
+        ]}) + "\n")
+    rc = dbmtrace.summarize([str(cap.path), str(trace_dump)], top=5)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "force" in out and "queue" in out
+    assert "slowest" in out
+    assert "tenant" in out
+    # Empty input: loud nonzero, not a silent success.
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert dbmtrace.summarize([str(empty)], top=5) == 1
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
