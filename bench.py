@@ -955,7 +955,7 @@ def _mesh_mixed_pool() -> dict:
     from distributed_bitcoinminer_tpu.lspnet.detnet import DetServer
     from distributed_bitcoinminer_tpu.utils.config import (
         AdaptParams, CoalesceParams, LeaseParams, QosParams,
-        StripeParams)
+        StripeParams, VerifyParams)
 
     RATES = {"mesh": 200_000.0, "host_a": 2_000.0, "host_b": 2_000.0}
     ELEPHANT = 150_000
@@ -971,7 +971,11 @@ def _mesh_mixed_pool() -> dict:
                           depth=2, wholesale_s=0.2),
             stripe=StripeParams(enabled=False),
             coalesce=CoalesceParams(enabled=False),
-            adapt=AdaptParams(enabled=False))
+            adapt=AdaptParams(enabled=False),
+            # The miners below answer with deterministic non-oracle
+            # hashes (the probe measures placement, not merges), which
+            # the claim check would reject.
+            verify=VerifyParams(enabled=False))
         stask = asyncio.create_task(sched.run())
         miner_tasks = []
 

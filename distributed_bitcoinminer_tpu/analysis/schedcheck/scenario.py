@@ -170,21 +170,47 @@ class FakeMiner:
       merge shape;
     - ``rate_hint``: nonces/s sent on the Join's Rate extension (the
       ISSUE 14 rate-hint path — the scheduler seeds this miner's EWMA
-      from it instead of warming through traffic).
+      from it instead of warming through traffic);
+    - ``byzantine`` (ISSUE 16): the miner LIES instead of computing.
+      ``"wrong_hash"`` fabricates an unbeatable fake pair (hash 1 at
+      the range's first nonce — wins every merge unless claim-checked;
+      identical across miners, so two such miners are the colluding-
+      duplicates class that defeats vote-counting but not
+      recomputation); ``"sentinel"`` hashes ONE nonce (the range's
+      first) and claims it as the argmin — a real in-range pair only
+      re-execution audits can expose; ``"selective"`` alternates
+      honest and sentinel answers (builds trust, spends it).
     """
 
     def __init__(self, ctx: "Ctx", name: str,
                  delay_fn: Optional[Callable[[int], float]] = None,
                  wedge_after: Optional[int] = None, stock: bool = False,
-                 rate_hint: float = 0.0):
+                 rate_hint: float = 0.0, byzantine: str = ""):
+        assert byzantine in ("", "wrong_hash", "sentinel", "selective"), \
+            byzantine
         self.ctx = ctx
         self.name = name
         self.delay_fn = delay_fn or (lambda size: 0.0)
         self.wedge_after = wedge_after
         self.stock = stock
         self.rate_hint = rate_hint
+        self.byzantine = byzantine
         self.chan = ctx.server.connect()
         self.answered = 0
+        self.lies = 0
+
+    def _fabricate(self, msg: Message):
+        """The byzantine answer for this REQUEST, or None to answer
+        honestly (mirrors lspnet.chaos.ByzantineSearcher)."""
+        if not self.byzantine:
+            return None
+        if self.byzantine == "selective" and self.answered % 2 == 0:
+            return None          # even calls honest: trust-building
+        self.lies += 1
+        if self.byzantine == "wrong_hash":
+            return (1, msg.lower)
+        from ...bitcoin.hash import hash_op
+        return (hash_op(msg.data, msg.lower), msg.lower)
 
     async def run(self) -> None:
         import asyncio
@@ -200,6 +226,18 @@ class FakeMiner:
             if self.wedge_after is not None \
                     and self.answered >= self.wedge_after:
                 continue   # wedged: keep reading, never answer
+            lie = self._fabricate(msg)
+            if lie is not None:
+                # A liar pays NO compute delay — skipping the scan is
+                # the whole point of lying, and the instant answer wins
+                # more merge races, which is the adversarial pressure
+                # the verification tier must hold against.
+                self.answered += 1
+                try:
+                    self.chan.write(new_result(*lie, 0).to_json())
+                except LspError:
+                    return
+                continue
             d = self.delay_fn(msg.upper - msg.lower + 1)
             if d > 0:
                 await asyncio.sleep(d)

@@ -52,12 +52,16 @@ def _fork(rng: random.Random) -> random.Random:
 def _make_sched(ctx: Ctx, lease: LeaseParams, qos: QosParams,
                 stripe: StripeParams = None,
                 coalesce: CoalesceParams = None,
-                adapt=None) -> Scheduler:
+                adapt=None, verify=None, audit_rng=None) -> Scheduler:
     # clock=ctx.loop.time: the admission buckets — and the ISSUE 13
     # adapt controllers — must tick on the VIRTUAL clock (they capture
     # their clock at construction, before the time.monotonic patch
-    # could reach them).
-    from ...utils.config import AdaptParams
+    # could reach them). ``verify``/``audit_rng`` (ISSUE 16): the
+    # byzantine family turns the verification tier on with a seeded
+    # audit stream (the _fork discipline — audit coin flips and
+    # subwindow draws come from a per-scheduler child stream, never
+    # global RNG state); everyone else runs the stock default.
+    from ...utils.config import AdaptParams, VerifyParams
     sched = Scheduler(
         ctx.server, lease=lease, cache=CacheParams(),
         stripe=stripe if stripe is not None
@@ -65,7 +69,9 @@ def _make_sched(ctx: Ctx, lease: LeaseParams, qos: QosParams,
         coalesce=coalesce if coalesce is not None
         else CoalesceParams(enabled=False),
         adapt=adapt if adapt is not None
-        else AdaptParams(enabled=False), clock=ctx.loop.time)
+        else AdaptParams(enabled=False), clock=ctx.loop.time,
+        verify=verify if verify is not None else VerifyParams(),
+        audit_rng=audit_rng)
     ctx.sched = sched
     ctx.spawn(sched.run())
     return sched
@@ -1193,6 +1199,143 @@ class HealthTakeover(Scenario):
 
 # ------------------------------------------------------- known-bad fixtures
 
+# --------------------------------------------------------- byzantine_miner
+
+class _ByzantineBase(Scenario):
+    """Base of the byzantine_miner family (ISSUE 16): FakeMiners that
+    LIE — fabricated pairs, sentinel-without-scan claims, alternating
+    honesty, colluding duplicates — against a REAL scheduler running
+    the verification tier (claim checks always; full-window audits with
+    a seeded stream where the subclass says so). The generic pack is
+    the point: exactly-once ORACLE-EXACT replies prove no lie ever
+    reached a client, however the explorer interleaves the liars'
+    instant answers against honest scans, claim-retry re-issues, audit
+    grants, and trust decay — the acceptance bar is 0 violations while
+    any honest miner remains, and every population here keeps at least
+    one honest miner.
+
+    Subclasses set ``LIAR_MODES`` (one FakeMiner ``byzantine`` mode per
+    liar; the seed draws their positions in the 3-miner pool) and
+    ``AUDIT_P`` (1.0 + a full-range ``audit_max_nonces`` where claim
+    checks alone cannot see the lie: a sentinel claim is a real pair,
+    only re-execution exposes it, and the reply hold + audit repair is
+    what keeps the final answer exact)."""
+
+    LIAR_MODES: tuple = ()
+    AUDIT_P = 0.0
+    #: One optional drop-after-send client (wrong-hash only): a lie
+    #: about a cancelled request's chunk pops STALE — never
+    #: claim-checked — which the caught-liar soft check must tolerate.
+    DROPPER = False
+
+    def build(self, ctx: Ctx) -> None:
+        from ...utils.config import VerifyParams
+        rng = ctx.rng
+        _make_sched(
+            ctx,
+            lease=LeaseParams(grace_s=2.0, factor=4.0, floor_s=0.5,
+                              tick_s=0.05, quarantine_after=2,
+                              ewma_alpha=0.3, queue_alarm_s=30.0),
+            qos=QosParams(enabled=False),
+            verify=VerifyParams(enabled=True, audit_p=self.AUDIT_P,
+                                audit_max_nonces=1 << 20),
+            audit_rng=_fork(rng))
+        liar_at = dict(zip(rng.sample(range(3), len(self.LIAR_MODES)),
+                           self.LIAR_MODES))
+        self.liars = []
+        for i in range(3):
+            mrng = _fork(rng)
+            kw = {"delay_fn": lambda size, r=mrng: r.uniform(0.02, 0.25),
+                  "byzantine": liar_at.get(i, "")}
+            m = ctx.add_miner(f"m{i}", **kw)
+            if kw["byzantine"]:
+                self.liars.append(m)
+        reqs = []
+        for j in range(rng.choice((2, 3))):
+            # Unique cache keys (the "#j" suffix): no ResultCache
+            # replay, so every reply is a fresh merge the liars raced.
+            reqs.append(Req(f"{rng.choice(_DATA)}#{j}", 0,
+                            rng.choice((59, 119, 199)),
+                            pre_delay=rng.uniform(0.0, 0.3)))
+        ctx.add_client("c0", reqs)
+        if self.DROPPER and rng.random() < 0.5:
+            ctx.add_client("c1", [Req(f"{rng.choice(_DATA)}#x", 0, 99,
+                                      pre_delay=rng.uniform(0.0, 0.4),
+                                      close_after=True)])
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        stats = ctx.sched.stats
+        lied = sum(m.lies for m in self.liars)
+        dropped = any(c.dropped or c.shed for c in ctx.clients)
+        if lied and not dropped and not stats["claims_failed"] \
+                and not stats["audits_failed"] \
+                and not stats["audits_passed"]:
+            # Every lie raced a LIVE request (no cancel made it stale),
+            # so the tier must have examined at least one: a rejected
+            # claim, a failed audit, or a coincidentally-correct
+            # sentinel surviving its re-execution. Zero of each means
+            # the lies were believed unexamined.
+            out.append(
+                f"{lied} lie(s) answered live requests but the "
+                f"verification tier recorded nothing (claims_failed=0, "
+                f"no audit outcomes)")
+        if self.AUDIT_P >= 1.0 and not dropped \
+                and not stats["audits_issued"]:
+            out.append("audit_p=1.0 yet no audit was ever issued")
+        return out
+
+
+class ByzantineWrongHash(_ByzantineBase):
+    """One or two miners fabricate an unbeatable fake pair (wrong-hash
+    class): the claim check's SHA-256 recompute must reject every one
+    BEFORE merge and re-issue the range until an honest scan answers.
+    No audits — this class dies at the claim layer."""
+
+    name = "byzantine_wrong_hash"
+    DROPPER = True
+
+    def build(self, ctx: Ctx) -> None:
+        self.LIAR_MODES = ("wrong_hash",) * ctx.rng.choice((1, 2))
+        super().build(ctx)
+
+
+class ByzantineCollude(_ByzantineBase):
+    """Colluding duplicates: TWO miners submit the IDENTICAL fabricated
+    pair (FakeMiner wrong-hash fabrication is deterministic), the class
+    that defeats any vote-counting verifier. Recomputation does not
+    count votes: both copies must fail the claim check independently,
+    and the surviving honest miner's scans answer everything."""
+
+    name = "byzantine_collude"
+    LIAR_MODES = ("wrong_hash", "wrong_hash")
+
+
+class ByzantineSentinel(_ByzantineBase):
+    """Sentinel-without-scan: the liar hashes ONE nonce and claims it
+    as its chunk's argmin — a REAL in-range pair the claim check
+    cannot fault. Full-window audits (p=1.0) re-execute every merged
+    chunk on a disjoint miner while the reply HOLDS; a failed audit
+    merges the auditor's verified sub-argmin (the repair) before the
+    release, so the client still sees the oracle-exact answer."""
+
+    name = "byzantine_sentinel"
+    LIAR_MODES = ("sentinel",)
+    AUDIT_P = 1.0
+
+
+class ByzantineSelective(_ByzantineBase):
+    """Selectively-correct: the liar alternates honest scans with
+    sentinel claims — building trust and spending it, the adversary
+    reputation decay alone cannot keep out. Full-window audits catch
+    each lying call regardless of the honest calls around it."""
+
+    name = "byzantine_selective"
+    LIAR_MODES = ("selective",)
+    AUDIT_P = 1.0
+
+
 class FixtureLostUpdate(Scenario):
     """KNOWN-BAD: classic read-yield-write lost update. Two tasks
     increment a counter with an await between load and store; any
@@ -1259,6 +1402,10 @@ SCENARIOS = {
     "replica_takeover": ReplicaTakeover,
     "adaptive_control": AdaptiveControl,
     "health_takeover": HealthTakeover,
+    "byzantine_wrong_hash": ByzantineWrongHash,
+    "byzantine_collude": ByzantineCollude,
+    "byzantine_sentinel": ByzantineSentinel,
+    "byzantine_selective": ByzantineSelective,
 }
 
 FIXTURES = {

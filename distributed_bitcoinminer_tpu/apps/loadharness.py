@@ -9,7 +9,8 @@ the tenant count grows, with compute removed from the equation:
   queue put, so 10k conns cost 10k× one message, not sockets, epochs,
   or capture lists.
 - Miners are INSTANT actors: each Request is answered immediately with
-  a cheap deterministic fake hash (the scheduler never verifies hashes;
+  a cheap deterministic fake hash (verification is pinned OFF in every
+  harness leg so the claim check doesn't reject the fakes;
   merge/lease/accounting mechanics are identical), plus an honest
   miner-side Span (measured queue/force wall times of the actor) so the
   per-phase trace medians the probes embed stay populated.
@@ -49,7 +50,7 @@ from ..bitcoin.message import Message, MsgType, new_join, new_request, \
 from ..lsp.errors import LspError
 from ..lspnet.detnet import DetServer
 from ..utils.config import AdaptParams, CacheParams, LeaseParams, \
-    QosParams
+    QosParams, VerifyParams
 from ..utils.trace import SPAN_PHASES
 
 __all__ = ["run_load", "load_curve", "run_adversarial",
@@ -164,6 +165,7 @@ def run_load(tenants: int = 1000, replicas: int = 1, miners: int = 4,
         # since ISSUE 14.
         kw = dict(lease=lease, cache=CacheParams(enabled=False), qos=qos,
                   adapt=AdaptParams(enabled=False),
+                  verify=VerifyParams(enabled=False),
                   recv_batch=recv_batch, trace_sample=trace_sample,
                   capture=cap)
         if replicas > 1:
@@ -365,6 +367,7 @@ def run_adversarial(workload: str, *, adapt: bool = False,
                           cache=CacheParams(enabled=False), qos=qos,
                           adapt=ap if adapt
                           else AdaptParams(enabled=False),
+                          verify=VerifyParams(enabled=False),
                           capture=cap)
         coord_task = asyncio.create_task(coord.run())
         miner_tasks = [asyncio.create_task(
@@ -641,6 +644,7 @@ def run_replay(path: str, *, speed: Optional[float] = None,
         coord = Scheduler(server, lease=lease,
                           cache=CacheParams(enabled=False), qos=qos,
                           adapt=AdaptParams(enabled=False),
+                          verify=VerifyParams(enabled=False),
                           capture=False)
         coord_task = asyncio.create_task(coord.run())
         rates = cap.pool_rates()

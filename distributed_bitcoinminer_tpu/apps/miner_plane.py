@@ -49,7 +49,8 @@ from typing import Callable, Optional
 
 from ..bitcoin.message import new_request
 from ..utils import trace as _tracing
-from ..utils.config import CoalesceParams, LeaseParams, StripeParams
+from ..utils.config import (CoalesceParams, LeaseParams, StripeParams,
+                            VerifyParams)
 from ..utils.metrics import LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS, Registry
 
 logger = logging.getLogger("dbm.scheduler")
@@ -115,6 +116,16 @@ class MinerState:
     # first real throughput window REPLACES the hint instead of
     # blending with it.
     rate_hinted: bool = False
+    # Verification tier (ISSUE 16): reputation score in
+    # [trust_floor, 1.0]. Starts at full trust (the score only matters
+    # once a miner MISBEHAVES), multiplies by trust_decay per
+    # claim/audit failure, steps back by trust_recover per confirmed
+    # pop. Below VerifyParams.trust_bar the miner is ineligible for
+    # new grants exactly like a quarantined one; trust also weights
+    # striping share and clamps the unauthenticated JOIN rate hint's
+    # influence. Never moves off 1.0 while verification is off, so the
+    # stock paths that read it see the identity weight.
+    trust: float = 1.0
     # Windowed throughput sampling (ISSUE 5; see observe_result): the
     # wall-clock window currently accumulating answered nonces. Per-pop
     # size/elapsed sampling is a lie under the pipelined miner — a
@@ -167,12 +178,14 @@ class MinerPlane:
                  coalesce: CoalesceParams, *,
                  write: Callable, inflight: dict, trace_get: Callable,
                  lease_event: Callable, dispatch: Callable,
-                 trace_on: bool = False):
+                 trace_on: bool = False,
+                 verify: Optional[VerifyParams] = None):
         self.metrics = metrics
         self._count = count
         self.lease = lease
         self.stripe = stripe
         self.coalesce = coalesce
+        self.verify = verify if verify is not None else VerifyParams()
         self._write = write
         self._inflight = inflight
         self._trace_get = trace_get
@@ -218,10 +231,20 @@ class MinerPlane:
         ``RATE_HINT_CAP`` and flagged unconfirmed, so lease sizing and
         stripe plans treat a cold 1B-nps mesh as wide from its first
         chunk — the hint is seeded before the parked-chunk absorption
-        below so even that first lease is sized from it."""
+        below so even that first lease is sized from it.
+
+        The hint is an UNAUTHENTICATED self-report (ISSUE 16 bugfix):
+        its seeded value is clamped by the miner's trust score (full
+        trust at a fresh join — the identity), its downstream influence
+        on striping share is weighted by trust (:meth:`stripe_chunks`),
+        and the first claim/audit failure DISCARDS it outright
+        (:meth:`trust_fail`) — a byzantine miner cannot hold an
+        inflated grant share past its first lie, and can never confirm
+        the claim without actually doing the work."""
         miner = MinerState(conn_id=conn_id)
         if rate_hint > 0:
-            miner.rate_ewma = min(float(rate_hint), self.RATE_HINT_CAP)
+            miner.rate_ewma = min(float(rate_hint),
+                                  self.RATE_HINT_CAP) * miner.trust
             miner.rate_hinted = True
             self.metrics.gauge("miner_rate_nps",
                                miner=str(conn_id)).set(miner.rate_ewma)
@@ -274,6 +297,7 @@ class MinerPlane:
         self.metrics.remove("miner_rate_nps", miner=str(conn_id))
         self.metrics.remove("lease_remaining_s", miner=str(conn_id))
         self.metrics.remove("adapt_chunk_s_miner", miner=str(conn_id))
+        self.metrics.remove("miner_trust", miner=str(conn_id))
         return miner
 
     def recover(self, miner: MinerState) -> None:
@@ -315,26 +339,53 @@ class MinerPlane:
             return chunk
         return None
 
+    def distrusted(self, miner: MinerState) -> bool:
+        """Verification tier (ISSUE 16): a miner whose trust score fell
+        below the bar is barred from NEW grants exactly like a
+        quarantined one. Trust never moves off 1.0 while verification
+        is off, so this is one always-false comparison on the stock
+        path."""
+        return miner.trust < self.verify.trust_bar
+
     def eligible(self) -> list[MinerState]:
-        """Miners that may take new work: available and not quarantined."""
+        """Miners that may take new work: available, not quarantined,
+        and (verification tier) not distrusted."""
         return [m for m in self.miners
-                if m.available and not m.quarantined]
+                if m.available and not m.quarantined
+                and not self.distrusted(m)]
 
     def desperation_pool(self) -> list[MinerState]:
-        """Last-resort pool when the WHOLE pool is quarantined: the
-        least-bad available quarantined miner (lowest blown streak, then
-        highest observed throughput), or nothing. Any non-quarantined
-        miner — even a busy one that will free up — disables desperation:
-        waiting for a healthy miner beats feeding a known-bad one."""
+        """Last-resort pool when the WHOLE pool is quarantined or
+        distrusted: the least-bad available such miner (lowest blown
+        streak, then highest trust, then highest observed throughput),
+        or nothing. Any healthy miner — even a busy one that will free
+        up — disables desperation: waiting for it beats feeding a
+        known-bad one."""
         if not self.lease.desperation or not self.miners:
             return []
-        if not all(m.quarantined for m in self.miners):
+        if not all(m.quarantined or self.distrusted(m)
+                   for m in self.miners):
             return []
         avail = [m for m in self.miners if m.available]
         if not avail:
             return []
-        return [min(avail, key=lambda m: (m.blown_streak,
+        return [min(avail, key=lambda m: (m.blown_streak, -m.trust,
                                           -(m.rate_ewma or 0.0)))]
+
+    def pick_auditor(self, *exclude: int):
+        """Auditor selection (ISSUE 16): any trusted, unquarantined
+        miner other than the excluded conn ids — explicitly NOT
+        ``eligible()``, whose availability test would mean "no audits
+        while the pool is busy", i.e. never mid-request, exactly when
+        claims race. An audit subwindow is tiny next to a chunk, so it
+        queues on the least-loaded candidate's FIFO (ties keep join
+        order, like every assignment path)."""
+        cands = [m for m in self.miners
+                 if m.conn_id not in exclude
+                 and not m.quarantined and not self.distrusted(m)]
+        if not cands:
+            return None
+        return min(cands, key=self.miner_live)
 
     def miner_live(self, miner: MinerState) -> int:
         """Live (non-cancelled) chunks in a miner's pending FIFO, with
@@ -362,7 +413,8 @@ class MinerPlane:
         first (ties keep join order — the reference's assignment
         order)."""
         pool = [m for m in self.miners
-                if not m.quarantined and self.miner_live(m) < depth
+                if not m.quarantined and not self.distrusted(m)
+                and self.miner_live(m) < depth
                 and not any(c.lease_blown and not c.cancelled
                             for c in m.pending)]
         pool.sort(key=self.miner_live)
@@ -435,6 +487,13 @@ class MinerPlane:
             else self.pool_rate
         if rate is None or rate <= 0:
             return 1
+        # Verification tier (ISSUE 16): striping share is weighted by
+        # trust — the rate feeding the plan may be an UNAUTHENTICATED
+        # JOIN self-report (rate_hinted), so a byzantine miner
+        # overclaiming 1000x must not win a proportionally deep stripe
+        # plan once it has been caught lying. trust == 1.0 (stock, and
+        # every honest miner) is the identity weight.
+        rate *= miner.trust
         # Per-miner setpoint override (DBM_ADAPT_PER_MINER) over the
         # pool-wide knob: in a 100x-skewed heterogeneous pool one
         # seconds-of-work value cannot hit both tiers' force-latency
@@ -508,7 +567,15 @@ class MinerPlane:
         # work. The just-popped (job, idx) is excluded: this very Result
         # is about to answer it, so a parked speculative copy of it is
         # garbage — not work to hand back to the miner that just did it.
-        if self.parked and miner.available:
+        # Verification tier (ISSUE 16): a DISTRUSTED miner stops
+        # absorbing parked work (quarantine lifts on any pop above, but
+        # trust does not — a caught liar re-fed its own rejected chunk
+        # would lie forever) unless desperation says it is the whole
+        # pool's least-bad option. Stock path: distrusted() is one
+        # always-false comparison and short-circuits the rest.
+        if self.parked and miner.available and (
+                not self.distrusted(miner)
+                or miner in self.desperation_pool()):
             parked = self.next_parked(skip_key=(chunk.job_id, chunk.idx))
             if parked is not None:
                 self.assign_chunk(miner, parked, kind="parked")
@@ -584,11 +651,48 @@ class MinerPlane:
         else:
             miner.win_t0, miner.win_nonces = 0.0, 0
         miner.blown_streak = 0
+        # Verification tier (ISSUE 16): confirmed work recovers trust
+        # one step toward full. The scheduler's claim check runs AFTER
+        # this pop-side step, so a lying Result's trust_fail decay
+        # lands last — multiplicative decay dominates the additive
+        # step and a liar can never net-gain trust from the very
+        # Result that convicted it. Stock path: one always-false
+        # comparison.
+        if miner.trust < 1.0:
+            miner.trust = min(1.0, miner.trust + self.verify.trust_recover)
+            self.metrics.gauge("miner_trust",
+                               miner=str(miner.conn_id)).set(miner.trust)
         if miner.quarantined:
             miner.quarantined = False
             self.update_pool_gauges()
             self._lease_event("quarantine_lifted", chunk, miner.conn_id)
             self._dispatch()
+
+    def trust_fail(self, miner: MinerState, reason: str) -> float:
+        """Verification tier (ISSUE 16): decay ``miner``'s trust after a
+        claim or audit failure (``reason`` is ``"claim"``/``"audit"``,
+        counted per kind). Multiplicative decay clamped at the floor —
+        repeat offenses drive the score below ``trust_bar`` (grant
+        ineligibility) fast, while the floor keeps recovery through
+        confirmed work possible. An UNCONFIRMED join rate hint dies on
+        the first lie (the PR 14 bugfix's teeth): a self-reported rate
+        from a miner caught fabricating results is worthless, and
+        keeping it would let the liar hold its inflated stripe share
+        through the whole decay horizon. Returns the new score."""
+        v = self.verify
+        miner.trust = max(v.trust_floor, miner.trust * v.trust_decay)
+        self._count("trust_decays_" + reason)
+        self.metrics.gauge("miner_trust",
+                           miner=str(miner.conn_id)).set(miner.trust)
+        if self._trace_on:
+            _tracing.flight("trust_decayed", miner=miner.conn_id,
+                            trust=round(miner.trust, 4), reason=reason)
+        if miner.rate_hinted:
+            miner.rate_hinted = False
+            miner.rate_ewma = None
+            self.metrics.remove("miner_rate_nps",
+                                miner=str(miner.conn_id))
+        return miner.trust
 
     def decay_rate_hints(self) -> None:
         """One sweep tick of unconfirmed rate-hint decay (ISSUE 14):

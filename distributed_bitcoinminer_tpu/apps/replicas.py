@@ -126,7 +126,7 @@ class ReplicaSet:
     def __init__(self, server, n: Optional[int] = None, *,
                  lease=None, cache: Optional[CacheParams] = None,
                  stripe=None, qos=None, coalesce=None, adapt=None,
-                 clock=None,
+                 verify=None, clock=None,
                  recv_batch: Optional[int] = None,
                  trace_sample: Optional[float] = None,
                  capture=None):
@@ -140,7 +140,8 @@ class ReplicaSet:
         for rid in range(self.n):
             sched = Scheduler(
                 server, lease=lease, cache=cache, stripe=stripe, qos=qos,
-                coalesce=coalesce, adapt=adapt, clock=clock,
+                coalesce=coalesce, adapt=adapt, verify=verify,
+                clock=clock,
                 result_cache=self.shared_cache, recv_batch=recv_batch,
                 trace_sample=trace_sample, capture=capture)
             sched._next_job_id = rid * self.JOB_ID_STRIDE

@@ -99,11 +99,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..bitcoin.hash import MAX_U64
+from ..bitcoin.hash import MAX_U64, hash_op
 from ..bitcoin.message import Message, MsgType, new_result
 from ..lsp.errors import LspError
 from ..lsp.server import AsyncServer
@@ -111,8 +112,8 @@ from ..utils import sanitize as _sanitize
 from ..utils import trace as _tracing
 from ..utils._env import int_env as _int_env
 from ..utils.config import AdaptParams, CacheParams, CoalesceParams, \
-    LeaseParams, QosParams, StripeParams, adapt_from_env, \
-    coalesce_from_env, qos_from_env, stripe_from_env
+    LeaseParams, QosParams, StripeParams, VerifyParams, adapt_from_env, \
+    coalesce_from_env, qos_from_env, stripe_from_env, verify_from_env
 from ..utils.metrics import (Registry, RequestTrace, ensure_emitter,
                              registry as process_registry)
 from . import capture as _capture
@@ -134,6 +135,10 @@ STAT_COUNTERS = (
     "queue_alarms", "inflight_alarms", "no_eligible_miner",
     "desperation_dispatch", "leases_blown_spurious", "chunks_striped",
     "qos_grants", "qos_shed", "qos_window_grants",
+    # Verification tier (ISSUE 16).
+    "claims_checked", "claims_failed", "audits_issued",
+    "audits_passed", "audits_failed", "audits_inconclusive",
+    "trust_decays_claim", "trust_decays_audit",
 )
 
 
@@ -216,6 +221,11 @@ class Request:
     chunk_bounds: list = None      # chunked mode: [(lo, up_excl), ...]
     next_chunk: int = 0            # chunked mode: first ungranted idx
     granted_chunks: int = 0        # chunks handed to miners so far
+    # Verification tier (ISSUE 16): outstanding audits sampled from this
+    # request's chunks. The reply HOLDS until they resolve — an audit
+    # that lands after the client was answered could only detect, never
+    # prevent, a sentinel-without-scan lie reaching the client.
+    audit_holds: int = 0
 
     def __post_init__(self):
         # Every Request carries a trace from birth, even when constructed
@@ -226,6 +236,31 @@ class Request:
             self.trace = RequestTrace(data=self.data, lower=self.lower,
                                       upper=self.upper, target=self.target,
                                       client=self.conn_id)
+
+
+@dataclass
+class AuditRecord:
+    """One outstanding probabilistic audit (ISSUE 16): a random
+    subwindow of a completed argmin chunk, re-granted to a DISJOINT
+    miner under a fresh job id that never enters ``_inflight`` — the
+    audit Result routes here (side table) instead of the merge path,
+    so audits survive the request's retirement and are invisible to
+    lease sweeps and recovery (both skip chunks whose job is not in
+    flight; the scheduler's own sweep expires them via ``deadline``
+    instead, so a wedged auditor cannot hold a reply forever). While
+    outstanding, the audited request's reply HOLDS (``audit_holds``)
+    — and on failure the AUDITOR's verified sub-argmin merges in its
+    place, so a full-window audit repairs the answer, not just the
+    liar's reputation."""
+    job_id: int          # the ORIGINAL job the audited claim answered
+    idx: int             # original chunk idx (fanout/logging context)
+    suspect: int         # conn id of the miner whose claim is audited
+    auditor: int         # conn id of the disjoint re-executing miner
+    lower: int           # audit subwindow, inclusive bounds (the
+    upper: int           # reference's Upper-read-inclusive quirk)
+    claimed_hash: int    # the suspect's chunk-argmin claim
+    claimed_nonce: int
+    deadline: float = float("inf")   # monotonic expiry (sweep tick)
 
 
 class Scheduler:
@@ -247,7 +282,9 @@ class Scheduler:
                  result_cache: Optional[ResultCache] = None,
                  recv_batch: Optional[int] = None,
                  trace_sample: Optional[float] = None,
-                 capture=None):
+                 capture=None,
+                 verify: Optional[VerifyParams] = None,
+                 audit_rng: Optional[random.Random] = None):
         self.server = server
         lease = lease if lease is not None else LeaseParams()
         self.cache = cache if cache is not None else CacheParams()
@@ -262,6 +299,20 @@ class Scheduler:
         # accounting (no windows, no shared live slots) bit-for-bit.
         coalesce = (coalesce if coalesce is not None
                     else coalesce_from_env())
+        # Verification tier (ISSUE 16): env-defaulted like stripe/qos so
+        # the tier-1 knob-off matrix leg (DBM_VERIFY=0) pins the
+        # believe-every-Result stock path bit-for-bit. ``audit_rng``
+        # injects a seeded stream (the schedcheck explorer's fork
+        # discipline) so audit draws — probability AND subwindow — are
+        # a function of the explored schedule, not of global RNG state.
+        verify = verify if verify is not None else verify_from_env()
+        self._audit_rng = (audit_rng if audit_rng is not None
+                           else random.Random())
+        #: Outstanding audits by audit job id (ids come off the shared
+        #: _next_job_id counter, so they can never collide with a live
+        #: request). Empty dict when audits are off — the hot-path
+        #: routing guard is one truthiness test.
+        self._audits: dict[int, AuditRecord] = {}
         # ``result_cache`` overrides with a SHARED instance (the replica
         # tier's replay plane); otherwise each scheduler owns one.
         self.results: Optional[ResultCache] = (
@@ -366,7 +417,8 @@ class Scheduler:
             write=self._write, inflight=self._inflight,
             trace_get=self.tenant_plane.traces.get,
             lease_event=self._on_lease_event,
-            dispatch=self._maybe_dispatch, trace_on=self._trace_on)
+            dispatch=self._maybe_dispatch, trace_on=self._trace_on,
+            verify=verify)
         # Self-tuning control plane (ISSUE 13, DBM_ADAPT, default OFF):
         # env-defaulted like stripe/qos/coalesce so the knob pins the
         # stock shape through every existing harness. Disabled = None —
@@ -427,6 +479,14 @@ class Scheduler:
     @coalesce.setter
     def coalesce(self, value: CoalesceParams) -> None:
         self.miner_plane.coalesce = value
+
+    @property
+    def verify(self) -> VerifyParams:
+        return self.miner_plane.verify
+
+    @verify.setter
+    def verify(self, value: VerifyParams) -> None:
+        self.miner_plane.verify = value
 
     @property
     def qos(self) -> QosParams:
@@ -671,6 +731,8 @@ class Scheduler:
         so the replica tier can drive each replica's sweep."""
         if self.lease.enabled:
             self._check_leases()
+        if self._audits:
+            self._expire_audits()
         self.miner_plane.decay_rate_hints()
         self._check_queue_age()
         if self.capture is not None:
@@ -880,6 +942,14 @@ class Scheduler:
         if popped is None:
             return
         miner, chunk = popped
+        if self._audits:
+            # Audit Results route to the side table (see AuditRecord),
+            # never the merge path: an audit job id is not in
+            # _inflight, so without this it would read as stale.
+            rec = self._audits.pop(chunk.job_id, None)
+            if rec is not None:
+                self._on_audit_result(rec, miner, chunk, msg)
+                return
         curr = self._inflight.get(chunk.job_id)
         if self.adapt_plane is not None:
             # Chunk-sizing signal (ISSUE 13): the lease plane's own
@@ -922,6 +992,14 @@ class Scheduler:
                 # The duplicate still freed a live-FIFO slot on this miner.
                 self._maybe_dispatch()
             return
+        # Claim check (ISSUE 16): one host-side SHA-256 recompute per
+        # claimed WINNER, before any merge state moves — a Result is a
+        # CLAIM, not a fact, once miners may lie. Microseconds against
+        # the multi-second chunk it answers; DBM_VERIFY=0 skips to the
+        # stock believe-verbatim merge (one boolean test).
+        if self.verify.enabled and not self._claim_ok(curr, chunk,
+                                                      miner, msg):
+            return
         if msg.hash < curr.min_hash:
             curr.min_hash = msg.hash
             curr.min_nonce = msg.nonce
@@ -932,6 +1010,13 @@ class Scheduler:
         curr.trace.event("result", miner=conn_id, idx=chunk.idx)
         curr.trace.event("merge", idx=chunk.idx,
                          answered=sum(curr.answered))
+        if self.verify.audit_p > 0 and not curr.target:
+            # Probabilistic audit (ISSUE 16): the claim check above
+            # proved the pair REAL, not MINIMAL — only re-execution
+            # can catch a sentinel-without-scan miner. Argmin chunks
+            # only: a difficulty miner's in-kernel early exit makes
+            # "sub-argmin over a window" unfalsifiable.
+            self._maybe_audit(curr, chunk, miner, msg)
         if curr.target and msg.target != curr.target and not curr.weak:
             curr.weak = True
             logger.info(
@@ -950,10 +1035,13 @@ class Scheduler:
                 nonce, q_hash = curr.chunk_q[c]
                 self._finish(curr, q_hash, nonce, early=True)
                 return
-        if curr.answered and all(curr.answered):
+        if curr.answered and all(curr.answered) and not curr.audit_holds:
             # Full barrier: stock request, or target missed everywhere —
             # the exact arg-min. (A difficulty hit always releases above:
             # at the barrier, its qualifying prefix is trivially complete.)
+            # Outstanding audits HOLD the reply: _on_audit_result (or the
+            # sweep's expiry) re-checks this barrier when the last one
+            # resolves.
             self._finish(curr, curr.min_hash, curr.min_nonce)
         elif self.qos.enabled:
             # The answering miner freed a live-FIFO slot: grant the next
@@ -967,6 +1055,17 @@ class Scheduler:
         if miner is not None:
             logger.info("miner %d dropped", conn_id)
             self.miner_plane.drop_miner(conn_id)
+            if self._audits:
+                # A dead auditor's outstanding audits can never
+                # conclude, and each holds a request's reply: re-issue
+                # to another disjoint miner or release as inconclusive.
+                # (Audit chunks carry job ids recover() skips — not in
+                # _inflight — so recovery never reassigns them; this
+                # path owns them.)
+                for c in miner.pending:
+                    rec = self._audits.pop(c.job_id, None)
+                    if rec is not None:
+                        self._reaudit_or_release(rec)
             if self.adapt_plane is not None:
                 self.adapt_plane.forget_miner(conn_id)
             # Export-track retirement (ISSUE 10): same churn rule as the
@@ -1052,6 +1151,258 @@ class Scheduler:
         elif kind == "park":
             if curr is not None:
                 curr.trace.event("park", idx=chunk.idx)
+        elif kind == "claim_failed":
+            if curr is not None:
+                curr.trace.event("claim_failed", miner=miner_conn,
+                                 idx=chunk.idx, nonce=info.get("nonce"),
+                                 claimed=info.get("claimed"),
+                                 actual=info.get("actual"))
+            if self._trace_on:
+                _tracing.flight("claim_failed", job=chunk.job_id,
+                                idx=chunk.idx, miner=miner_conn,
+                                trust=info.get("trust"))
+            logger.warning(
+                "miner %d FAILED the claim check on job %d chunk %d: "
+                "claimed hash %s for nonce %s, recomputed %s "
+                "(trust -> %.3g)%s",
+                miner_conn, chunk.job_id, chunk.idx,
+                info.get("claimed"), info.get("nonce"),
+                info.get("actual"), info.get("trust", 0.0),
+                " [audit re-execution]" if info.get("audit") else "")
+        elif kind == "audit_failed":
+            job = info.get("job", chunk.job_id)
+            trace = self.tenant_plane.traces.get(job)
+            if trace is not None:
+                trace.event("audit_failed", miner=miner_conn,
+                            idx=info.get("idx"),
+                            lower=chunk.lower, upper=chunk.upper)
+            if self._trace_on:
+                _tracing.flight("audit_failed", job=job,
+                                idx=info.get("idx"), miner=miner_conn,
+                                auditor=info.get("auditor"),
+                                trust=info.get("trust"))
+            logger.warning(
+                "miner %d FAILED an audit on job %s chunk %s: claimed "
+                "argmin hash %s, but auditor %s found %s at nonce %s "
+                "inside [%d, %d] (trust -> %s)",
+                miner_conn, job, info.get("idx"), info.get("claimed"),
+                info.get("auditor"), info.get("found"),
+                info.get("found_nonce"), chunk.lower, chunk.upper,
+                info.get("trust"))
+
+    # ----------------------------------------------- verification (ISSUE 16)
+
+    def _claim_ok(self, curr: Request, chunk: Chunk, miner: MinerState,
+                  msg: Message) -> bool:
+        """Claim check: is this Result's ``(hash, nonce)`` pair real?
+
+        Three tests, all against values the scheduler can verify
+        itself: the nonce must lie in the chunk's assigned range (a
+        real pair lifted from OUTSIDE the range would otherwise pass),
+        the hash must equal the host-side SHA-256 recompute, and a
+        difficulty claim entering the qualifying set must satisfy the
+        target bound ON THE RECOMPUTED hash (never the claimed one).
+        A failed claim decays the liar's trust, fires the
+        ``claim_failed`` lease event, and hands the range back for
+        re-execution — ``answered[idx]`` stays False, so the request
+        can still finish correctly off another miner's scan."""
+        self._count("claims_checked")
+        actual = hash_op(curr.data, msg.nonce)
+        if chunk.lower <= msg.nonce <= chunk.upper \
+                and actual == msg.hash \
+                and not (curr.target and msg.hash < curr.target
+                         and not actual < curr.target):
+            return True
+        self._count("claims_failed")
+        trust = self.miner_plane.trust_fail(miner, "claim")
+        self._on_lease_event("claim_failed", chunk, miner.conn_id,
+                             nonce=msg.nonce, claimed=msg.hash,
+                             actual=actual, trust=trust)
+        # The liar's FIFO already popped this assignment: unless a
+        # speculative copy is in flight the range must re-execute, to
+        # a different miner when one is eligible (mirrors the lease
+        # plane's re-issue; the park path keeps it alive otherwise).
+        if not chunk.reissued:
+            mp = self.miner_plane
+            copy = Chunk(chunk.job_id, chunk.data, chunk.lower,
+                         chunk.upper, target=chunk.target, idx=chunk.idx)
+            takeover = next(
+                (m for m in mp.eligible() if m is not miner), None)
+            if takeover is not None:
+                mp.assign_chunk(takeover, copy, kind="claim_retry")
+            else:
+                mp.parked.append(copy)
+                self._on_lease_event("park", copy, miner.conn_id)
+        self._maybe_dispatch()
+        return False
+
+    def _maybe_audit(self, curr: Request, chunk: Chunk,
+                     miner: MinerState, msg: Message) -> None:
+        """With probability ``audit_p``, re-grant a random subwindow of
+        the just-merged chunk to a DISJOINT miner (see AuditRecord) and
+        HOLD the request's reply until the cross-check resolves. No
+        eligible disjoint miner = no audit: an audit is a spot check,
+        never a reason to queue work behind a busy pool."""
+        v = self.verify
+        if self._audit_rng.random() >= v.audit_p:
+            return
+        mp = self.miner_plane
+        auditor = mp.pick_auditor(miner.conn_id)
+        if auditor is None:
+            return
+        span = min(v.audit_max_nonces, chunk.size)
+        lo = chunk.lower + self._audit_rng.randrange(chunk.size - span + 1)
+        hi = lo + span - 1       # inclusive, like every scanned bound
+        self._issue_audit(AuditRecord(
+            job_id=chunk.job_id, idx=chunk.idx, suspect=miner.conn_id,
+            auditor=auditor.conn_id, lower=lo, upper=hi,
+            claimed_hash=msg.hash, claimed_nonce=msg.nonce),
+            curr.data, auditor)
+        curr.audit_holds += 1
+
+    def _issue_audit(self, rec: AuditRecord, data: str,
+                     auditor: MinerState) -> None:
+        """Grant one audit subwindow to ``auditor`` under a fresh job
+        id, with a FIFO-budgeted expiry deadline (a wedged auditor's
+        audit re-issues on a sweep tick instead of holding the reply
+        forever). Shared by first issue and re-issue paths; the caller
+        owns the hold accounting."""
+        self._next_job_id += 1
+        aid = self._next_job_id
+        ac = Chunk(aid, data, rec.lower, rec.upper, target=0, idx=0)
+        rec.auditor = auditor.conn_id
+        rec.deadline = time.monotonic() + \
+            self.miner_plane.lease_for(auditor, ac) \
+            * (1 + len(auditor.pending))
+        self._audits[aid] = rec
+        self._count("audits_issued")
+        trace = self.tenant_plane.traces.get(rec.job_id)
+        if trace is not None:
+            trace.event("audit", idx=rec.idx, auditor=auditor.conn_id,
+                        lower=rec.lower, upper=rec.upper)
+        if self._trace_on:
+            _tracing.flight("audit", job=rec.job_id, idx=rec.idx,
+                            suspect=rec.suspect, auditor=auditor.conn_id)
+        self.miner_plane.assign_chunk(auditor, ac, kind="audit")
+
+    def _resolve_audit(self, rec: AuditRecord) -> None:
+        """Release the audited request's reply hold (whatever the
+        verdict — failure already merged the auditor's repair) and
+        finish it if this was the last thing it waited on."""
+        curr = self._inflight.get(rec.job_id)
+        if curr is None:
+            return
+        if curr.audit_holds:
+            curr.audit_holds -= 1
+        if not curr.audit_holds and curr.answered \
+                and all(curr.answered):
+            self._finish(curr, curr.min_hash, curr.min_nonce)
+
+    def _reaudit_or_release(self, rec: AuditRecord) -> None:
+        """An audit lost its auditor (drop, or sweep expiry): re-issue
+        the same subwindow to another disjoint miner when one is
+        eligible, else record it inconclusive and release the hold —
+        liveness beats a spot check with nobody left to run it."""
+        curr = self._inflight.get(rec.job_id)
+        if curr is None:
+            return          # audited request already retired
+        mp = self.miner_plane
+        auditor = mp.pick_auditor(rec.suspect, rec.auditor)
+        if auditor is not None:
+            self._issue_audit(rec, curr.data, auditor)
+            return
+        self._count("audits_inconclusive")
+        self._resolve_audit(rec)
+
+    def _expire_audits(self) -> None:
+        """Sweep-tick expiry for outstanding audits (see AuditRecord:
+        audit chunks are invisible to the lease plane by design)."""
+        now = time.monotonic()
+        for aid, rec in [(a, r) for a, r in self._audits.items()
+                         if now >= r.deadline]:
+            del self._audits[aid]
+            logger.warning(
+                "audit of job %d chunk %d expired on auditor %d; "
+                "re-issuing", rec.job_id, rec.idx, rec.auditor)
+            self._reaudit_or_release(rec)
+
+    def _on_audit_result(self, rec: AuditRecord, miner: MinerState,
+                         chunk: Chunk, msg: Message) -> None:
+        """Cross-check an audit Result against the audited claim.
+
+        The auditor's Result is a CLAIM too, verified first — a
+        byzantine auditor must not frame an honest miner with a
+        fabricated lower hash. Then: the suspect claimed
+        ``claimed_hash`` as the argmin of the WHOLE chunk, so (a) a
+        strictly better recomputed-real hash inside the subwindow
+        proves the suspect never scanned it (sentinel-without-scan) —
+        and since that pair is verified real and in-range, it MERGES
+        into the held request, repairing the answer (a full-window
+        audit by an honest auditor thereby restores the exact chunk
+        argmin); (b) if the claimed winner lies INSIDE the window, an
+        honest auditor must rediscover exactly it — reporting only a
+        worse hash convicts the AUDITOR of the same laziness. A verdict
+        that convicts the AUDITOR leaves the suspect's claim unchecked,
+        so the same subwindow re-audits on another disjoint miner — a
+        byzantine auditor must not be able to LAUNDER a byzantine
+        suspect's lie by burning the spot check (the convictions decay
+        its trust out of the auditor pool, so the loop terminates).
+        Every other verdict releases the request's reply hold here."""
+        mp = self.miner_plane
+        curr = self._inflight.get(rec.job_id)
+        self._count("claims_checked")
+        actual = hash_op(chunk.data, msg.nonce)
+        if msg.nonce < rec.lower or msg.nonce > rec.upper \
+                or actual != msg.hash:
+            self._count("claims_failed")
+            trust = mp.trust_fail(miner, "claim")
+            self._on_lease_event("claim_failed", chunk, miner.conn_id,
+                                 nonce=msg.nonce, claimed=msg.hash,
+                                 actual=actual, trust=trust, audit=True)
+            self._reaudit_or_release(rec)
+            self._maybe_dispatch()
+            return
+        elif msg.hash < rec.claimed_hash:
+            self._count("audits_failed")
+            suspect = mp.find_miner(rec.suspect)
+            trust = (mp.trust_fail(suspect, "audit")
+                     if suspect is not None else None)
+            self._on_lease_event("audit_failed", chunk, rec.suspect,
+                                 job=rec.job_id, idx=rec.idx,
+                                 claimed=rec.claimed_hash,
+                                 found=msg.hash, found_nonce=msg.nonce,
+                                 auditor=miner.conn_id, trust=trust)
+            if curr is not None and msg.hash < curr.min_hash:
+                # Repair: the auditor's pair is claim-checked real and
+                # inside the audited chunk's range — it supersedes the
+                # liar's sentinel in the running min before the held
+                # reply releases.
+                curr.min_hash = msg.hash
+                curr.min_nonce = msg.nonce
+                curr.trace.event("merge", idx=rec.idx, audit_repair=True)
+        elif rec.lower <= rec.claimed_nonce <= rec.upper \
+                and msg.hash != rec.claimed_hash:
+            # The real claimed winner is in-window; the auditor missed
+            # it, so the auditor did not actually scan. The suspect's
+            # claim is still unchecked — re-audit elsewhere.
+            trust = mp.trust_fail(miner, "audit")
+            self._on_lease_event("audit_failed", chunk, miner.conn_id,
+                                 job=rec.job_id, idx=rec.idx,
+                                 claimed=rec.claimed_hash,
+                                 found=msg.hash, found_nonce=msg.nonce,
+                                 auditor=miner.conn_id, trust=trust)
+            self._reaudit_or_release(rec)
+            self._maybe_dispatch()
+            return
+        else:
+            self._count("audits_passed")
+            trace = self.tenant_plane.traces.get(rec.job_id)
+            if trace is not None:
+                trace.event("audit_passed", idx=rec.idx,
+                            auditor=miner.conn_id)
+        self._resolve_audit(rec)
+        # The auditor freed a live-FIFO slot either way.
+        self._maybe_dispatch()
 
     # -------------------------------------------------------------- internal
 
@@ -1830,10 +2181,11 @@ class Scheduler:
         self.miner_plane.check_leases()
 
     def _check_queue_age(self) -> None:
+        mp = self.miner_plane
         self.tenant_plane.check_queue_age(
             self._inflight, self.current,
-            len(self.miner_plane.miners),
-            len(self.miner_plane.eligible()))
+            len(mp.miners), len(mp.eligible()),
+            distrusted_n=sum(1 for m in mp.miners if mp.distrusted(m)))
 
     def _write(self, conn_id: int, msg: Message) -> None:
         try:

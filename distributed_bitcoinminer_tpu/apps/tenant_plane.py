@@ -312,7 +312,8 @@ class TenantPlane:
     # ------------------------------------------------------------- alarms
 
     def check_queue_age(self, inflight: dict, current,
-                        miners_n: int, eligible_n: int) -> None:
+                        miners_n: int, eligible_n: int,
+                        distrusted_n: int = 0) -> None:
         """Age alarms (ROADMAP open item + ISSUE 3; per-tenant since
         ISSUE 5): the OLDEST queued request of each TENANT past
         ``lease.queue_alarm_s`` — and any request still IN FLIGHT past the
@@ -324,9 +325,13 @@ class TenantPlane:
         The alarm and its dump carry the tenant's cumulative GRANT SHARE,
         so a starved mouse (near-zero share despite backlog) is
         distinguishable from a busy elephant (large share, long queue by
-        its own volume). Observability only: never changes scheduling.
-        The per-tenant-oldest scan rides the FIFO index — O(backlogged
-        tenants) per sweep, not O(queued requests) (ISSUE 11)."""
+        its own volume). ``distrusted_n`` (ISSUE 16) names the miners
+        the verification tier barred from grants, so an eligibility
+        collapse under a byzantine pool reads as what it is rather
+        than as a mystery stall. Observability only: never changes
+        scheduling. The per-tenant-oldest scan rides the FIFO index —
+        O(backlogged tenants) per sweep, not O(queued requests)
+        (ISSUE 11)."""
         bound = self.lease.queue_alarm_s
         if bound <= 0:
             return
@@ -343,9 +348,9 @@ class TenantPlane:
             logger.warning(
                 "tenant %d: oldest request %r [%d, %d] queued for %.1fs "
                 "(bound %.1fs): grant_share=%.3f pool=%d eligible=%d "
-                "in_flight=%d",
+                "distrusted=%d in_flight=%d",
                 req.conn_id, req.data, req.lower, req.upper, age, bound,
-                share, miners_n, eligible_n, len(inflight))
+                share, miners_n, eligible_n, distrusted_n, len(inflight))
             req.trace.event("queue_alarm", age_s=round(age, 3),
                             tenant=req.conn_id,
                             grant_share=round(share, 4))

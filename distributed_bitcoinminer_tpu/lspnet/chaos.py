@@ -90,18 +90,82 @@ class WedgeableSearcher:
         return self._inner.search_until(lower, upper, target)
 
 
+#: Byzantine lying modes (ISSUE 16). ``wrong_hash`` fabricates an
+#: impossibly good pair the claim check rejects in microseconds;
+#: ``sentinel`` returns a REAL pair (one hash of the range's first
+#: nonce, no scan) that only a probabilistic audit can catch;
+#: ``selective`` alternates honest and sentinel calls — the miner that
+#: builds trust and spends it.
+BYZANTINE_MODES = ("wrong_hash", "sentinel", "selective")
+
+
+class ByzantineSearcher:
+    """Wrap a searcher so its ANSWERS (not its liveness) can be turned
+    adversarial at will — the failure class the verification tier
+    (ISSUE 16) exists for, orthogonal to :class:`WedgeableSearcher`'s
+    stuck-compute model. While the shared ``lie_flag`` is set, calls
+    fabricate per ``mode`` (see :data:`BYZANTINE_MODES`); clear, they
+    pass through to the inner searcher untouched, so one handle models
+    a miner that turns coat mid-storm and back.
+    """
+
+    def __init__(self, inner, data: str, mode: str,
+                 lie_flag: threading.Event):
+        assert mode in BYZANTINE_MODES, mode
+        self._inner = inner
+        self._data = data
+        self._mode = mode
+        self._lie_flag = lie_flag
+        self._calls = 0
+        # Same shadow idiom as WedgeableSearcher: only claim the until
+        # extension when the inner searcher actually speaks it.
+        if not hasattr(inner, "search_until"):
+            self.search_until = None
+
+    def _fabricate(self, lower: int):
+        """The lie for this call, or None to answer honestly."""
+        if not self._lie_flag.is_set():
+            return None
+        self._calls += 1
+        if self._mode == "selective" and self._calls % 2:
+            return None
+        if self._mode == "wrong_hash":
+            # An unbeatable claimed hash for a nonce that almost
+            # certainly does not produce it: wins every merge race
+            # unless checked, dies instantly under DBM_VERIFY.
+            return (0, lower)
+        # sentinel (and selective's lying calls): hash ONE nonce and
+        # claim it as the scan's answer — a real pair, in range, that
+        # passes any recompute; only re-execution can expose it.
+        from ..bitcoin.hash import hash_op
+        return (hash_op(self._data, lower), lower)
+
+    def search(self, lower: int, upper: int):
+        out = self._fabricate(lower)
+        return out if out is not None else self._inner.search(lower, upper)
+
+    def search_until(self, lower: int, upper: int, target: int):
+        out = self._fabricate(lower)
+        if out is not None:
+            h, nonce = out
+            return (h, nonce, h < target)
+        return self._inner.search_until(lower, upper, target)
+
+
 class ChaosMiner:
-    """A restartable miner with crash-kill and compute-wedge controls.
+    """A restartable miner with crash-kill, compute-wedge, and
+    byzantine-answer controls.
 
     One handle models one miner "process" across restarts: each
     :meth:`start` joins the pool as a fresh LSP connection, and the wedge
-    gate is shared across restarts (an operator unwedges a host, not a
-    process incarnation).
+    gate — like the byzantine lie flag — is shared across restarts (an
+    operator unwedges a host, not a process incarnation; a compromised
+    host stays compromised through a respawn).
     """
 
     def __init__(self, hostport: str, params=None,
                  searcher_factory: Optional[Callable] = None,
-                 name: str = "miner"):
+                 name: str = "miner", byzantine: str = ""):
         from ..apps.miner import MinerWorker  # lazy: keep lspnet app-free
         self._worker_cls = MinerWorker
         self.hostport = hostport
@@ -109,10 +173,19 @@ class ChaosMiner:
         self.name = name
         self.gate = threading.Event()
         self.gate.set()
+        #: Set = currently lying (only meaningful with a ``byzantine``
+        #: mode; the miner starts honest either way and a schedule's
+        #: "byzantine" event flips it).
+        self.lie_flag = threading.Event()
+        self.byzantine = byzantine
         inner = searcher_factory
         if inner is None:
             from ..apps.miner import HostSearcher
             inner = lambda data, batch: HostSearcher(data)  # noqa: E731
+        if byzantine:
+            base = inner
+            inner = lambda data, batch: ByzantineSearcher(  # noqa: E731
+                base(data, batch), data, byzantine, self.lie_flag)
         self._factory = lambda data, batch: WedgeableSearcher(
             inner(data, batch), self.gate)
         self.worker = None
@@ -149,6 +222,22 @@ class ChaosMiner:
     @property
     def wedged(self) -> bool:
         return not self.gate.is_set()
+
+    def go_byzantine(self) -> None:
+        """Start lying per the ctor's ``byzantine`` mode (no-op without
+        one — the flag is set but no ByzantineSearcher reads it)."""
+        logger.info("chaos: %s turns byzantine (%s)", self.name,
+                    self.byzantine or "no mode: inert")
+        self.lie_flag.set()
+
+    def go_honest(self) -> None:
+        if self.lie_flag.is_set():
+            logger.info("chaos: %s turns honest", self.name)
+        self.lie_flag.clear()
+
+    @property
+    def lying(self) -> bool:
+        return self.lie_flag.is_set()
 
     async def kill(self) -> None:
         """Crash, not close: abort the conn and drop the socket so the
@@ -207,6 +296,12 @@ class ChaosEvent:
 EPISODES = ("drop_read", "drop_write", "delay", "kill", "wedge",
             "partition_in", "partition_out")
 
+#: EPISODES plus the byzantine turn-coat episode (ISSUE 16). Kept out of
+#: the default tuple so existing seeded schedules replay byte-identical;
+#: storms that wire :class:`ChaosMiner` handles with a ``byzantine``
+#: mode pass ``kinds=BYZ_EPISODES`` explicitly.
+BYZ_EPISODES = EPISODES + ("byzantine",)
+
 
 def generate_schedule(seed: int, duration_s: float,
                       miner_names: Sequence[str], *,
@@ -232,7 +327,7 @@ def generate_schedule(seed: int, duration_s: float,
                "drop_write": "clear_drop_write",
                "delay": "clear_delay", "kill": "restart",
                "wedge": "unwedge", "partition_in": "heal_in",
-               "partition_out": "heal_out"}
+               "partition_out": "heal_out", "byzantine": "honest"}
     for _ in range(episodes):
         kind = rng.choice(list(kinds))
         start = rng.uniform(0.05, duration_s * 0.6)
@@ -272,6 +367,12 @@ async def _apply_event(ev: ChaosEvent,
     elif ev.action == "unwedge":
         if m is not None:
             m.unwedge()
+    elif ev.action == "byzantine":
+        if m is not None:
+            m.go_byzantine()
+    elif ev.action == "honest":
+        if m is not None:
+            m.go_honest()
     elif ev.action == "partition_in":
         if m is not None and m.alive:
             faults.partition_conn(m.conn_id, inbound=True, outbound=False)
@@ -458,6 +559,7 @@ async def run_schedule(schedule: Sequence[ChaosEvent],
         faults.reset_all_faults()
         for m in miners.values():
             m.unwedge()
+            m.go_honest()
         for m in miners.values():
             if not m.alive:
                 await m.restart()

@@ -459,6 +459,45 @@ Environment variables:
   storm, replay it, embed the side-by-side fidelity report (capture's
   own admitted/s, shed rate, p50/p99, span medians vs each replay
   round's, plus the ``within`` verdict).
+- ``DBM_VERIFY`` (default 1): the verification tier's claim checks
+  (ISSUE 16). 1 = every claimed winning ``(hash, nonce)`` is
+  recomputed host-side (one SHA-256 via ``bitcoin.hash_op``) BEFORE it
+  may merge; a mismatch (or, in difficulty mode, a claimed hit above
+  the target) is rejected as a ``claim_failed`` lease event, the
+  liar's trust decays, and the chunk is re-granted to another miner.
+  0 = bit-for-bit stock: Results are believed verbatim (pinned in the
+  knob-off matrix leg). Cost is microseconds per WINNER, not per
+  nonce — bench-geometry throughput is unaffected within noise.
+- ``DBM_AUDIT_P`` (default 0, clamped to [0, 1]): probabilistic
+  audit rate. With probability p per completed (merged) chunk, a
+  random subwindow of it is re-granted to a DISJOINT miner and the
+  sub-argmin cross-checked against the original claim over that
+  window — a strictly better hash inside the window proves the
+  original never scanned it (the "sentinel-without-scan" lazy-miner
+  class that claim checks cannot see) and fires ``audit_failed``.
+  0 disables audits entirely (no RNG draw, no bookkeeping).
+- ``DBM_AUDIT_MAX`` (default 65536, floor 1): audit subwindow size
+  cap in nonces — audits must stay launch-overhead-scale, never a
+  second full scan.
+- ``DBM_TRUST_DECAY`` (default 0.25, clamped to (0, 1)): multiplier
+  applied to a miner's trust score on each claim/audit failure.
+- ``DBM_TRUST_RECOVER`` (default 0.05, clamped to (0, 1)): per
+  confirmed-result step of trust recovery toward 1.0 (new miners
+  start at full trust; the score only matters once they misbehave).
+- ``DBM_TRUST_FLOOR`` (default 0.01): lower clamp on trust, so a
+  repeat liar's score can still recover through confirmed work.
+- ``DBM_TRUST_BAR`` (default 0.2): grant-eligibility bar — a miner
+  whose trust falls below it is excluded from new grants exactly like
+  a quarantined miner (desperation dispatch still floors
+  availability when the WHOLE pool is below the bar/quarantined).
+  Trust also weights striping share (effective rate x trust) and
+  clamps the unauthenticated JOIN rate hint (PR 14 bugfix), so a
+  byzantine miner cannot inflate its grant share by overclaiming.
+- ``DBM_TIER1_BYZ`` (0 disables): scripts/tier1.sh's byzantine leg —
+  dbmcheck's ``byzantine_*`` scenario family (wrong-hash fabricators,
+  colluding duplicates, sentinel-without-scan and selectively-correct
+  liars) under the exactly-once oracle-exact invariant pack, with the
+  same >=500 distinct-schedule floor as the other dbmcheck legs.
 """
 
 from __future__ import annotations
@@ -793,6 +832,36 @@ class QosParams:
 
 
 @dataclass(frozen=True)
+class VerifyParams:
+    """Verification-tier knobs (ISSUE 16; apps/scheduler.py claim checks
+    + audits, apps/miner_plane.py trust plane).
+
+    Miners so far could crash, wedge, or vanish — never LIE. With
+    ``enabled``, every claimed winning ``(hash, nonce)`` is recomputed
+    host-side (one ``bitcoin.hash_op`` SHA-256 per winner) before it may
+    merge; mismatches are rejected as ``claim_failed`` lease events and
+    the chunk re-granted. ``audit_p`` re-grants a random subwindow
+    (capped at ``audit_max_nonces``) of a completed chunk to a disjoint
+    miner with that probability and cross-checks the sub-argmin — the
+    only defense against a lazy miner that returns a VALID but
+    non-minimal pair without scanning. Trust starts at 1.0 per miner,
+    multiplies by ``trust_decay`` per failure, steps back by
+    ``trust_recover`` per confirmed result (clamped to
+    ``[trust_floor, 1.0]``); below ``trust_bar`` a miner is ineligible
+    for new grants (desperation dispatch still floors availability).
+    ``enabled=False`` with ``audit_p=0`` is bit-for-bit stock: no
+    recompute, no RNG draw, no trust bookkeeping on any hot path.
+    """
+    enabled: bool = True
+    audit_p: float = 0.0
+    audit_max_nonces: int = 1 << 16
+    trust_decay: float = 0.25
+    trust_recover: float = 0.05
+    trust_floor: float = 0.01
+    trust_bar: float = 0.2
+
+
+@dataclass(frozen=True)
 class RetryParams:
     """Client submit-with-retry knobs (apps/client.py submit_with_retry).
 
@@ -927,6 +996,24 @@ def adapt_from_env() -> AdaptParams:
         coalesce=_int_env("DBM_ADAPT_COALESCE", 1) != 0,
         admit=_int_env("DBM_ADAPT_ADMIT", 1) != 0,
         per_miner=_int_env("DBM_ADAPT_PER_MINER", 0) != 0,
+    )
+
+
+def verify_from_env() -> VerifyParams:
+    d = VerifyParams()
+    return VerifyParams(
+        enabled=_int_env("DBM_VERIFY", 1) != 0,
+        audit_p=min(1.0, max(0.0, _float_env("DBM_AUDIT_P", d.audit_p))),
+        audit_max_nonces=max(1, _int_env("DBM_AUDIT_MAX",
+                                         d.audit_max_nonces)),
+        trust_decay=min(0.99, max(0.01, _float_env("DBM_TRUST_DECAY",
+                                                   d.trust_decay))),
+        trust_recover=min(0.99, max(0.001, _float_env("DBM_TRUST_RECOVER",
+                                                      d.trust_recover))),
+        trust_floor=min(1.0, max(0.0, _float_env("DBM_TRUST_FLOOR",
+                                                 d.trust_floor))),
+        trust_bar=min(1.0, max(0.0, _float_env("DBM_TRUST_BAR",
+                                               d.trust_bar))),
     )
 
 

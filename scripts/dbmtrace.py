@@ -130,6 +130,11 @@ def summarize(paths: list, top: int) -> int:
     phases = {}                   # phase -> [seconds]
     slowest = []                  # (elapsed_s, label, detail)
     n_traces = n_spans = 0
+    # Verification-tier outcomes (ISSUE 16): tallied from trace event
+    # names; the line below only prints when any fired, so stock
+    # captures summarize byte-identically to before.
+    verif = {"claim_failed": 0, "audit": 0, "audit_passed": 0,
+             "audit_failed": 0, "audit_repair": 0}
     for path in paths:
         for kind, obj in _iter_records(path):
             if kind == "capture":
@@ -151,7 +156,12 @@ def summarize(paths: list, top: int) -> int:
                           if e.get("event") == "reply"), None)
             worst_phase, worst_v = None, 0.0
             for ev in events:
-                if ev.get("event") != "miner_span":
+                name = ev.get("event")
+                if name in verif:
+                    verif[name] += 1
+                elif name == "merge" and ev.get("audit_repair"):
+                    verif["audit_repair"] += 1
+                if name != "miner_span":
                     continue
                 n_spans += 1
                 for ph in SPAN_PHASES:
@@ -184,6 +194,12 @@ def summarize(paths: list, top: int) -> int:
                 continue
             print(f"{ph[:-2]:<10} {len(xs):>7} {_pctl(xs, 0.5):>10.6f} "
                   f"{_pctl(xs, 0.9):>10.6f} {xs[-1]:>10.6f}")
+    if any(verif.values()):
+        print(f"\nverification: {verif['claim_failed']} claim(s) "
+              f"rejected, {verif['audit']} audit(s) issued "
+              f"({verif['audit_passed']} passed, "
+              f"{verif['audit_failed']} failed, "
+              f"{verif['audit_repair']} repair merge(s))")
     if slowest:
         slowest.sort(key=lambda r: -r[0])
         print(f"\nslowest {min(top, len(slowest))} request(s):")

@@ -166,6 +166,28 @@ if [ "${DBM_TIER1_REPLAY:-1}" != "0" ]; then
     echo "REPLAY_LEG_RC=$replay_rc"
 fi
 
+# Byzantine leg (ISSUE 16): dbmcheck's byzantine_miner scenario family
+# alone — wrong-hash fabricators, colluding duplicates, sentinel
+# without-scan and selectively-correct liars under the exactly-once
+# oracle-exact invariant pack — with the same >=500 distinct-schedule
+# floor as the other dbmcheck legs (a verification tier that explored
+# nothing proves nothing). No JAX import. DBM_TIER1_BYZ=0 skips.
+byz_rc=0
+if [ "${DBM_TIER1_BYZ:-1}" != "0" ]; then
+    rm -f /tmp/_t1_byz.log
+    timeout -k 5 150 python scripts/dbmcheck.py \
+        --scenario byzantine_wrong_hash,byzantine_collude,byzantine_sentinel,byzantine_selective \
+        --seeds 200 2>&1 | tee /tmp/_t1_byz.log
+    byz_rc=${PIPESTATUS[0]}
+    bdistinct=$(grep -a '^DBMCHECK_DISTINCT=' /tmp/_t1_byz.log | tail -1 | cut -d= -f2)
+    if [ "$byz_rc" -eq 0 ] && [ "${bdistinct:-0}" -lt 500 ]; then
+        echo "BYZ_FLOOR: only ${bdistinct:-0} distinct schedules" \
+             "explored (< 500) — treating as failure"
+        byz_rc=3
+    fi
+    echo "BYZ_LEG_RC=$byz_rc"
+fi
+
 # Multi-process smoke leg (ISSUE 12): the REAL process topology on
 # localhost — router + 2 replica processes on their own LSP sockets +
 # 1 miner agent — with a kill -9 of the replica owning an in-flight
@@ -226,17 +248,21 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # (the default, pinned EXPLICITLY so an env leak cannot arm it)
     # with test_capture.py — whose parity pin asserts byte-identical
     # replies capture-on vs capture-off — in the module list.
+    # ISSUE 16 addition: DBM_VERIFY=0 pins the believe-every-Result
+    # stock merge (no recompute, no trust bookkeeping, no audit state)
+    # with test_verify.py — whose parity pin asserts byte-identical
+    # write streams verify-off vs claim-checks-on — in the module list.
     timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
         DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 DBM_MESH=0 \
-        DBM_CAPTURE=0 \
+        DBM_CAPTURE=0 DBM_VERIFY=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
         tests/test_apps.py tests/test_qos.py tests/test_batch.py \
         tests/test_trace.py tests/test_plane_split.py \
-        tests/test_adapt.py tests/test_capture.py \
+        tests/test_adapt.py tests/test_capture.py tests/test_verify.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}
@@ -249,5 +275,6 @@ fi
 [ "$adapt_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$adapt_rc
 [ "$replay_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$replay_rc
 [ "$mesh_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$mesh_rc
+[ "$byz_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$byz_rc
 [ "$procs_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$procs_rc
 exit $rc

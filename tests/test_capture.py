@@ -33,7 +33,8 @@ from distributed_bitcoinminer_tpu.bitcoin.message import (Message,
 from distributed_bitcoinminer_tpu.utils import metrics as umetrics
 from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
                                                        LeaseParams,
-                                                       QosParams)
+                                                       QosParams,
+                                                       VerifyParams)
 
 MINER_A, MINER_B = 1, 2
 TEN_X, TEN_Y = 10, 11
@@ -204,9 +205,12 @@ def _drive(sched):
 
 
 def _sched(capture=None, max_queued=0):
+    # _drive feeds synthetic hashes the claim check would reject;
+    # verification has its own suite (test_verify.py), so pin it off.
     return Scheduler(FakeServer(), lease=LeaseParams(),
                      cache=CacheParams(enabled=False),
                      qos=QosParams(enabled=True, max_queued=max_queued),
+                     verify=VerifyParams(enabled=False),
                      capture=capture)
 
 

@@ -115,11 +115,11 @@ class ChaosCluster:
     def hostport(self):
         return f"127.0.0.1:{self.server.port}"
 
-    async def add_miner(self, name, delay=0.02, factory=None):
+    async def add_miner(self, name, delay=0.02, factory=None, **kw):
         m = chaos.ChaosMiner(self.hostport, params=self.params,
                              searcher_factory=factory or
                              oracle_factory(delay),
-                             name=name)
+                             name=name, **kw)
         await m.start()
         # The JOIN rides an async datagram; wait until the scheduler has
         # registered the miner so tests split work deterministically.
@@ -417,6 +417,52 @@ def test_seeded_chaos_schedule_invariants(seed):
             assert await c.settle(timeout=12.0)
             assert c.scheduler.queue == []
             assert c.scheduler.parked == []
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("seed", [29])
+def test_seeded_byzantine_storm_mixed_with_faults(seed):
+    """Verification tier under crash-fault pressure (ISSUE 16): a seeded
+    storm draws from BYZ_EPISODES' byzantine turn-coat episode PLUS
+    wedges and packet delay, over a pool where two miners carry lie
+    modes (one fabricates hashes, one returns real-but-unscanned
+    sentinels) and one is honest. Claim checks, reply-holding audits,
+    and repair merges must keep every answer oracle-exact even while
+    leases blow and audits expire on wedged auditors — then the pool
+    converges once the schedule heals itself."""
+    from distributed_bitcoinminer_tpu.utils.config import VerifyParams
+
+    async def scenario():
+        chaos.seed_packet_faults(seed)
+        async with ChaosCluster(lease=tight_lease(quarantine_after=3)) as c:
+            c.scheduler.verify = VerifyParams(
+                enabled=True, audit_p=1.0, audit_max_nonces=1 << 20)
+            await c.add_miner("alpha", byzantine="wrong_hash")
+            await c.add_miner("beta", byzantine="sentinel")
+            await c.add_miner("gamma")         # the honest floor
+            schedule = chaos.generate_schedule(
+                seed, 3.0, ["alpha", "beta"], episodes=5, max_percent=20,
+                kinds=("byzantine", "wedge", "delay"))
+            assert any(e.action == "byzantine" for e in schedule)
+            storm = asyncio.create_task(
+                chaos.run_schedule(schedule, c.miners))
+            jobs = [("turncoat one", 399), ("turncoat two", 299),
+                    ("turncoat three", 449)]
+            retry = RetryParams(attempts=8, timeout_s=2.5, backoff_s=0.1,
+                                backoff_cap_s=0.5)
+            try:
+                for data, max_nonce in jobs:
+                    got = await asyncio.wait_for(submit_with_retry(
+                        c.hostport, data, max_nonce, 0, c.params, retry),
+                        40)
+                    assert got is not None, f"{data} never answered"
+                    # Never a wrong pair — not even mid-storm.
+                    assert got[:2] == expected(data, max_nonce)
+            finally:
+                await asyncio.wait_for(storm, 20)
+            assert await c.settle(timeout=12.0)
+            assert c.scheduler.stats["claims_checked"] > 0
+            assert c.scheduler.stats["audits_issued"] > 0
     asyncio.run(scenario())
 
 

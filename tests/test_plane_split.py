@@ -36,7 +36,8 @@ from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
                                                        CoalesceParams,
                                                        LeaseParams,
                                                        QosParams,
-                                                       StripeParams)
+                                                       StripeParams,
+                                                       VerifyParams)
 from distributed_bitcoinminer_tpu.utils.metrics import NULL_TRACE, Registry
 from distributed_bitcoinminer_tpu.utils.trace import sample_hit
 from tests.test_scheduler_recovery import (CLIENT_X, FakeServer, MINER_A,
@@ -474,7 +475,8 @@ def test_sample_hit_deterministic_and_calibrated():
 def test_trace_sample_zero_allocates_no_traces():
     server = FakeServer()
     sched = Scheduler(server, lease=LeaseParams(), trace_sample=0.0,
-                      qos=QosParams(enabled=False))
+                      qos=QosParams(enabled=False),
+                      verify=VerifyParams(enabled=False))
     join(sched, MINER_A)
     request(sched, CLIENT_X, "s0", 39)
     req = sched.current
@@ -488,7 +490,8 @@ def test_trace_sample_zero_allocates_no_traces():
 def test_trace_sample_one_is_stock():
     server = FakeServer()
     sched = Scheduler(server, lease=LeaseParams(), trace_sample=1.0,
-                      qos=QosParams(enabled=False))
+                      qos=QosParams(enabled=False),
+                      verify=VerifyParams(enabled=False))
     join(sched, MINER_A)
     request(sched, CLIENT_X, "s1", 39)
     job = sched.current.job_id
@@ -815,7 +818,8 @@ def test_lazy_hook_seeds_existing_backlog_on_reconfigure():
     from tests.test_qos import FakeServer, pop_next
     server = FakeServer()
     sched = Scheduler(server, lease=LeaseParams(queue_alarm_s=0.0),
-                      qos=QosParams(enabled=False))
+                      qos=QosParams(enabled=False),
+                      verify=VerifyParams(enabled=False))
     sched._on_join(MINER_A)
     # Queue a second tenant's request behind an in-flight one (stock
     # FIFO: one in flight at a time).

@@ -18,7 +18,8 @@ from distributed_bitcoinminer_tpu.bitcoin.hash import MAX_U64
 from distributed_bitcoinminer_tpu.bitcoin.message import (
     Message, MsgType, new_join, new_request, new_result)
 from distributed_bitcoinminer_tpu.utils.config import (CacheParams,
-                                                       LeaseParams)
+                                                       LeaseParams,
+                                                       VerifyParams)
 
 
 class FakeServer:
@@ -36,9 +37,13 @@ class FakeServer:
 
 
 def make_scheduler(**lease_kw):
+    # The scripted result() helper answers with synthetic hashes the
+    # claim check would reject; verification has its own suite
+    # (test_verify.py), so this rig pins it off.
     lease = LeaseParams(**lease_kw) if lease_kw else LeaseParams()
     server = FakeServer()
-    return Scheduler(server, lease=lease), server
+    return Scheduler(server, lease=lease,
+                     verify=VerifyParams(enabled=False)), server
 
 
 def join(sched, conn_id):
@@ -227,7 +232,8 @@ def test_empty_range_burst_drains_iteratively():
     # not the ISSUE 5 overload shed — which would (correctly) cut a
     # 2000-deep same-conn burst down to DBM_QOS_MAX_QUEUED first.
     sched = Scheduler(server, lease=LeaseParams(),
-                      qos=QosParams(max_queued=0))
+                      qos=QosParams(max_queued=0),
+                      verify=VerifyParams(enabled=False))
     join(sched, MINER_A)
     bad = Message(type=MsgType.REQUEST, data="void", lower=5, upper=3)
     for _ in range(2000):
@@ -325,7 +331,8 @@ def test_weak_difficulty_merge_is_not_cached():
 
 
 def test_cache_disabled_knob():
-    sched = Scheduler(FakeServer(), cache=CacheParams(enabled=False))
+    sched = Scheduler(FakeServer(), cache=CacheParams(enabled=False),
+                      verify=VerifyParams(enabled=False))
     assert sched.results is None
     join(sched, MINER_A)
     request(sched, CLIENT_X, "off", 99)

@@ -1,0 +1,423 @@
+"""Verification-tier tests (ISSUE 16): claim checks, audits, trust.
+
+Unit tier: the same synchronous FakeServer rig as
+test_scheduler_recovery.py drives the scheduler's event handlers
+directly, so every verdict branch of the claim check and the audit
+cross-check is pinned without timing — a Result is a CLAIM here, and
+the tests play both honest and byzantine miners by hand.
+
+Storm tier: seeded end-to-end byzantine storms over real UDP (the
+chaos harness of test_chaos.py with ``ChaosMiner(byzantine=...)``),
+asserting the acceptance property: a client never receives a wrong
+``(hash, nonce)`` while any honest miner remains.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op, scan_min
+from distributed_bitcoinminer_tpu.bitcoin.message import Message, MsgType
+from distributed_bitcoinminer_tpu.utils.config import (LeaseParams,
+                                                       RetryParams,
+                                                       VerifyParams)
+from tests.test_scheduler_recovery import (CLIENT_X, FakeServer, MINER_A,
+                                           MINER_B, MINER_C, join, request,
+                                           result)
+
+AUDIT_ALL = VerifyParams(enabled=True, audit_p=1.0,
+                         audit_max_nonces=1 << 20)
+
+
+def make_sched(verify=VerifyParams(), seed=7, **lease_kw):
+    """Verify-tier scheduler over a recording fake server.
+
+    ``verify`` is always passed explicitly so the suite is immune to
+    the tier-1 matrix leg's DBM_VERIFY=0 environment; the seeded
+    ``audit_rng`` makes every audit coin flip and window draw
+    deterministic."""
+    server = FakeServer()
+    sched = Scheduler(server, lease=LeaseParams(**lease_kw),
+                      verify=verify, audit_rng=random.Random(seed))
+    return sched, server
+
+
+def chunk_bounds(server, conn_id, n=0):
+    """(lower, upper) of the n-th REQUEST granted to ``conn_id``."""
+    m = server.sent_to(conn_id, MsgType.REQUEST)[n]
+    return m.lower, m.upper
+
+
+# ------------------------------------------------------------ claim checks
+
+
+def test_honest_claim_accepted_and_counted():
+    sched, server = make_sched()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "honest work", 99)
+    lo, hi = chunk_bounds(server, MINER_A)
+    h, n = scan_min("honest work", lo, hi)
+    result(sched, MINER_A, h=h, nonce=n)
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(h, n)]
+    assert sched.stats["claims_checked"] == 1
+    assert sched.stats["claims_failed"] == 0
+    assert sched.miners[0].trust == 1.0
+
+
+def test_fabricated_hash_rejected_and_regranted():
+    """A wrong-hash claim (the colluding-duplicates class too: the
+    recompute never counts votes) is rejected before any merge state
+    moves, the liar's trust decays, and the range re-executes on a
+    different miner — the client still gets the true arg-min."""
+    sched, server = make_sched()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "audit storm", 199)
+    lo0, hi0 = chunk_bounds(server, MINER_A)
+    lo1, hi1 = chunk_bounds(server, MINER_B)
+    result(sched, MINER_A, h=1, nonce=lo0)       # fabricated: hash_op != 1
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []
+    assert sched.stats["claims_failed"] == 1
+    assert sched.stats["trust_decays_claim"] == 1
+    liar = sched._find_miner(MINER_A)
+    assert liar.trust == pytest.approx(0.25)
+    # B is busy with its own chunk, so the rejected range parks and is
+    # absorbed the moment B frees (the lease plane's park machinery).
+    assert len(sched.parked) == 1
+    h1, n1 = scan_min("audit storm", lo1, hi1)
+    result(sched, MINER_B, h=h1, nonce=n1)       # B's own chunk
+    retry = server.sent_to(MINER_B, MsgType.REQUEST)
+    assert [(m.lower, m.upper) for m in retry] == [(lo1, hi1), (lo0, hi0)]
+    h0, n0 = scan_min("audit storm", lo0, hi0)
+    result(sched, MINER_B, h=h0, nonce=n0)       # the re-executed range
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == \
+        [scan_min("audit storm", 0, 200)]
+
+
+def test_real_pair_outside_range_rejected():
+    """A REAL (hash, nonce) lifted from outside the assigned range must
+    not pass: the recompute alone would accept it."""
+    sched, server = make_sched()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "range theft", 199)
+    lo0, hi0 = chunk_bounds(server, MINER_A)
+    lo1, hi1 = chunk_bounds(server, MINER_B)
+    stolen = hi1 if hi1 > hi0 else hi0           # a nonce outside A's chunk
+    assert not (lo0 <= stolen <= hi0)
+    result(sched, MINER_A, h=hash_op("range theft", stolen), nonce=stolen)
+    assert sched.stats["claims_failed"] == 1
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []
+
+
+def test_difficulty_fabricated_qualifier_rejected():
+    """Difficulty mode: a fabricated below-target hash must never enter
+    the qualifying set (no early prefix release off a lie); the request
+    parks with no spare miner and completes honestly off a joiner."""
+    target = 1 << 40                             # ~never hit in 100 nonces
+    sched, server = make_sched()
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "fake gold", 99, target=target)
+    lo, hi = chunk_bounds(server, MINER_A)
+    result(sched, MINER_A, h=5, nonce=lo + 3, target=target)  # "qualifies"
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []
+    assert sched.stats["claims_failed"] == 1
+    assert len(sched.parked) == 1                # no spare: range parks
+    join(sched, MINER_B)                         # joiner absorbs it
+    h, n = scan_min("fake gold", lo, hi)
+    result(sched, MINER_B, h=h, nonce=n, target=target)
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(h, n)]
+
+
+# ------------------------------------------------------------------ audits
+
+
+def test_audit_catches_sentinel_and_repairs_reply():
+    """The sentinel-without-scan lie — a REAL in-range pair that is not
+    the arg-min — passes the claim check by construction; only the
+    audit re-execution can catch it. The reply HOLDS until every audit
+    resolves, and the honest auditor's full-window find both convicts
+    the liar and repairs the merged answer to the exact arg-min."""
+    data = "audit storm"                         # global arg-min in chunk 0
+    sched, server = make_sched(verify=AUDIT_ALL)
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, data, 199)
+    lo0, hi0 = chunk_bounds(server, MINER_A)
+    lo1, hi1 = chunk_bounds(server, MINER_B)
+    h0, n0 = scan_min(data, lo0, hi0)
+    assert (h0, n0) != (hash_op(data, lo0), lo0)  # the lie is not the min
+    # A answers with the sentinel: real, in range, never scanned.
+    result(sched, MINER_A, h=hash_op(data, lo0), nonce=lo0)
+    assert sched.stats["claims_failed"] == 0     # claim check can't see it
+    assert sched.stats["audits_issued"] == 1     # p=1: audit granted to B
+    # B answers its own chunk honestly -> B's chunk audited on A (the
+    # only disjoint miner). All chunks answered, but two holds remain.
+    h1, n1 = scan_min(data, lo1, hi1)
+    result(sched, MINER_B, h=h1, nonce=n1)
+    assert sched.stats["audits_issued"] == 2
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []
+    # A honestly re-executes B's window: the claim checks out.
+    result(sched, MINER_A, h=h1, nonce=n1)
+    assert sched.stats["audits_passed"] == 1
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []   # one hold left
+    # B re-executes A's window and finds the true min: lie convicted,
+    # answer repaired, last hold released -> the client sees the oracle.
+    result(sched, MINER_B, h=h0, nonce=n0)
+    assert sched.stats["audits_failed"] == 1
+    assert sched.stats["trust_decays_audit"] == 1
+    assert sched._find_miner(MINER_A).trust == pytest.approx(0.25)
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [scan_min(data, 0, 200)]
+
+
+def test_byzantine_auditor_cannot_launder_a_lie():
+    """An audit answered with a fabricated pair convicts the AUDITOR
+    and re-issues the same subwindow to another disjoint miner — a
+    byzantine auditor must not burn the only spot check on its
+    accomplice's sentinel."""
+    data = "audit storm"
+    sched, server = make_sched(verify=AUDIT_ALL)
+    for m in (MINER_A, MINER_B, MINER_C):
+        join(sched, m)
+    request(sched, CLIENT_X, data, 299)
+    bounds = {m: chunk_bounds(server, m) for m in (MINER_A, MINER_B,
+                                                   MINER_C)}
+    lo0, hi0 = bounds[MINER_A]
+    # A lies with the sentinel; the audit lands on the least-loaded
+    # disjoint miner. B and C tie on load, so join order picks B.
+    result(sched, MINER_A, h=hash_op(data, lo0), nonce=lo0)
+    assert sched.stats["audits_issued"] == 1
+    for m in (MINER_B, MINER_C):                 # honest own-chunk answers
+        lo, hi = bounds[m]
+        h, n = scan_min(data, lo, hi)
+        result(sched, m, h=h, nonce=n)
+    # B's FIFO now fronts the audit of A's window: B answers it with a
+    # fabricated hash. B is convicted at the audit claim check and the
+    # window re-audits on C instead of releasing the held reply.
+    failed_before = sched.stats["claims_failed"]
+    result(sched, MINER_B, h=1, nonce=lo0)
+    assert sched.stats["claims_failed"] == failed_before + 1
+    assert sched._find_miner(MINER_B).trust < 1.0
+    assert sched.stats["audits_issued"] >= 4     # 3 first-issue + re-audit
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []
+    # Drain every remaining audit honestly (C and A answer whatever
+    # windows sit in their FIFOs) until the reply releases.
+    pending = {m: 1 for m in (MINER_A, MINER_C)}
+    for _ in range(8):
+        if server.sent_to(CLIENT_X, MsgType.RESULT):
+            break
+        for m in (MINER_A, MINER_B, MINER_C):
+            ms = sched._find_miner(m)
+            if ms is None or not ms.pending:
+                continue
+            c = ms.pending[0]
+            h, n = scan_min(data, c.lower, c.upper)
+            result(sched, m, h=h, nonce=n)
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [scan_min(data, 0, 300)]
+    assert sched.stats["audits_failed"] >= 1     # the sentinel was caught
+
+
+def test_dead_auditor_releases_hold_as_inconclusive():
+    """Liveness beats a spot check: when the auditor drops and no
+    disjoint replacement exists, the audit records inconclusive and the
+    held reply releases — the claim-checked merge stands."""
+    data = "spot check"                          # global arg-min in chunk 1
+    sched, server = make_sched(verify=AUDIT_ALL)
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, data, 199)
+    lo0, hi0 = chunk_bounds(server, MINER_A)
+    lo1, hi1 = chunk_bounds(server, MINER_B)
+    h0, n0 = scan_min(data, lo0, hi0)
+    result(sched, MINER_A, h=h0, nonce=n0)       # honest; audited on B
+    h1, n1 = scan_min(data, lo1, hi1)
+    result(sched, MINER_B, h=h1, nonce=n1)       # honest; audited on A
+    assert sched.stats["audits_issued"] == 2
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []
+    sched._on_drop(MINER_B)                      # auditor of chunk 0 dies
+    # A is the suspect of that audit: no disjoint replacement exists.
+    assert sched.stats["audits_inconclusive"] == 1
+    # A's own outstanding audit (of B's chunk) still holds the reply...
+    assert server.sent_to(CLIENT_X, MsgType.RESULT) == []
+    result(sched, MINER_A, h=h1, nonce=n1)       # ...until A answers it
+    assert sched.stats["audits_passed"] == 1
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [scan_min(data, 0, 200)]
+
+
+# ------------------------------------------------------------------- trust
+
+
+def test_trust_decay_recovery_curve_and_desperation():
+    """The trust curve end to end: multiplicative decay to the floor,
+    grant ineligibility below the bar, desperation dispatch flooring
+    availability for a fully-distrusted pool, and additive recovery
+    through confirmed work back above the bar."""
+    sched, server = make_sched()
+    join(sched, MINER_A)
+    mp = sched.miner_plane
+    ms = sched.miners[0]
+    v = VerifyParams()
+    assert ms.trust == 1.0 and not mp.distrusted(ms)
+    assert mp.trust_fail(ms, "claim") == pytest.approx(0.25)
+    assert not mp.distrusted(ms)                 # one strike: still in
+    assert mp.trust_fail(ms, "audit") == pytest.approx(0.0625)
+    assert mp.distrusted(ms)                     # two strikes: out
+    for _ in range(10):
+        mp.trust_fail(ms, "claim")
+    assert ms.trust == v.trust_floor             # clamped, never zero
+    assert sched.stats["trust_decays_claim"] == 11
+    assert sched.stats["trust_decays_audit"] == 1
+    # The whole pool is distrusted: desperation still grants (waiting
+    # for nobody beats failing the request outright)...
+    n_jobs = 4                       # distinct data: the result memo
+    datas = [f"redemption {i}" for i in range(n_jobs)]  # replays repeats
+    request(sched, CLIENT_X, datas[0], 99)
+    assert len(server.sent_to(MINER_A, MsgType.REQUEST)) == 1
+    assert sched.stats["desperation_dispatch"] >= 1
+    # ...and each confirmed honest answer steps trust back up.
+    seen = [ms.trust]
+    for i, data in enumerate(datas):
+        if i:
+            request(sched, CLIENT_X, data, 99)
+        lo, hi = chunk_bounds(server, MINER_A, n=i)
+        h, n = scan_min(data, lo, hi)
+        result(sched, MINER_A, h=h, nonce=n)
+        seen.append(ms.trust)
+    assert seen == sorted(seen)                  # monotone recovery
+    assert ms.trust == pytest.approx(v.trust_floor
+                                     + n_jobs * v.trust_recover)
+    assert not mp.distrusted(ms)                 # back above the bar
+
+
+def test_distrusted_miner_excluded_while_honest_pool_remains():
+    sched, server = make_sched()
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    mp = sched.miner_plane
+    liar = sched._find_miner(MINER_A)
+    mp.trust_fail(liar, "claim")
+    mp.trust_fail(liar, "claim")
+    assert mp.distrusted(liar)
+    request(sched, CLIENT_X, "clean hands", 99)
+    # The whole request lands on B; the distrusted miner gets nothing.
+    assert server.sent_to(MINER_A, MsgType.REQUEST) == []
+    assert len(server.sent_to(MINER_B, MsgType.REQUEST)) == 1
+    lo, hi = chunk_bounds(server, MINER_B)
+    h, n = scan_min("clean hands", lo, hi)
+    result(sched, MINER_B, h=h, nonce=n)
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(h, n)]
+    assert sched.stats["desperation_dispatch"] == 0
+
+
+# ------------------------------------------------------------- parity pin
+
+
+class RawServer:
+    """Records raw payload bytes — the byte-for-byte parity witness."""
+
+    def __init__(self):
+        self.writes = []
+
+    def write(self, conn_id, payload):
+        self.writes.append((conn_id, payload))
+
+
+def _scripted_run(verify):
+    """One fixed honest script against a given verify config; returns
+    the raw write stream."""
+    server = RawServer()
+    sched = Scheduler(server, lease=LeaseParams(), verify=verify,
+                      audit_rng=random.Random(3))
+    join(sched, MINER_A)
+    join(sched, MINER_B)
+    request(sched, CLIENT_X, "parity pin", 199)
+    reqs = {c: Message.from_json(p) for c, p in server.writes
+            if c in (MINER_A, MINER_B)}
+    for conn_id, m in reqs.items():
+        h, n = scan_min("parity pin", m.lower, m.upper)
+        result(sched, conn_id, h=h, nonce=n)
+    return server.writes
+
+
+def test_verify_off_is_bit_for_bit_stock(monkeypatch):
+    """DBM_VERIFY=0 pins the stock believe-every-Result path: zero
+    recomputes, zero trust bookkeeping, fabrications believed verbatim
+    — and for honest traffic the claim-checks-on write stream is
+    byte-identical to the stock one (checks reject, never mutate)."""
+    monkeypatch.setenv("DBM_VERIFY", "0")
+    server = FakeServer()
+    sched = Scheduler(server, lease=LeaseParams(),
+                      audit_rng=random.Random(3))   # verify from env
+    assert not sched.verify.enabled
+    join(sched, MINER_A)
+    request(sched, CLIENT_X, "gullible", 99)
+    result(sched, MINER_A, h=1, nonce=0)         # a lie, believed verbatim
+    replies = server.sent_to(CLIENT_X, MsgType.RESULT)
+    assert [(m.hash, m.nonce) for m in replies] == [(1, 0)]
+    assert sched.stats["claims_checked"] == 0
+    assert sched.stats["audits_issued"] == 0
+    assert sched.miners[0].trust == 1.0
+    # Byte-for-byte: same script, verify off vs claim-checks-on.
+    off = _scripted_run(VerifyParams(enabled=False))
+    on = _scripted_run(VerifyParams(enabled=True, audit_p=0.0))
+    assert off == on
+
+
+# ----------------------------------------------------- byzantine storms
+
+
+@pytest.mark.parametrize("seed", [7, 21])
+def test_byzantine_storm_never_answers_wrong(seed):
+    """THE acceptance storm: a seeded byzantine schedule flips a
+    wrong-hash liar and a sentinel liar on and off over real UDP while
+    clients keep submitting; with one honest miner always present,
+    every answer must be the exact oracle arg-min — claim checks kill
+    the fabrications, audits + reply holds + repair merges kill the
+    sentinels."""
+    from distributed_bitcoinminer_tpu.lspnet import chaos
+    from tests.test_chaos import ChaosCluster, expected, tight_lease
+
+    async def scenario():
+        chaos.seed_packet_faults(seed)
+        async with ChaosCluster(lease=tight_lease()) as c:
+            c.scheduler.verify = AUDIT_ALL
+            await c.add_miner("wrong", byzantine="wrong_hash")
+            await c.add_miner("sentinel", byzantine="sentinel")
+            await c.add_miner("honest")
+            schedule = chaos.generate_schedule(
+                seed, 3.0, ["wrong", "sentinel"], episodes=4,
+                kinds=("byzantine",))
+            assert any(e.action == "byzantine" for e in schedule)
+            storm = asyncio.create_task(chaos.run_schedule(
+                schedule, c.miners))
+            jobs = [("byz storm one", 399), ("byz storm two", 499),
+                    ("byz storm three", 299)]
+            retry = RetryParams(attempts=8, timeout_s=2.5, backoff_s=0.1,
+                                backoff_cap_s=0.5)
+            try:
+                from distributed_bitcoinminer_tpu.apps.client import \
+                    submit_with_retry
+                for data, max_nonce in jobs:
+                    got = await asyncio.wait_for(submit_with_retry(
+                        c.hostport, data, max_nonce, 0, c.params, retry),
+                        40)
+                    assert got is not None, f"{data} never answered"
+                    # Never a wrong pair — not even mid-storm.
+                    assert got[:2] == expected(data, max_nonce)
+            finally:
+                await asyncio.wait_for(storm, 20)
+            assert await c.settle(timeout=12.0)
+            stats = c.scheduler.stats
+            # The storm actually exercised the tier.
+            assert stats["claims_checked"] > 0
+            assert stats["audits_issued"] > 0
+    asyncio.run(scenario())
