@@ -1141,6 +1141,20 @@ def _mesh_probe() -> dict:
 
 
 def main() -> int:
+    # Transport datapath modes (ISSUE 17) FIRST — both are socket-only
+    # measurements with no JAX involved, and the child leg IS the timed
+    # window, so neither may pay backend probing or the jax import below.
+    if "--transport-child" in sys.argv:
+        from distributed_bitcoinminer_tpu.apps.transportbench import (
+            echo_storm_child)
+        print(json.dumps(echo_storm_child()), flush=True)
+        return 0
+    if "--transport-only" in sys.argv:
+        from distributed_bitcoinminer_tpu.apps.transportbench import (
+            standalone_artifact)
+        print(json.dumps(standalone_artifact(_REPO)), flush=True)
+        return 0
+
     from distributed_bitcoinminer_tpu.utils.config import probe_backend
     from distributed_bitcoinminer_tpu.utils.metrics import ensure_emitter
     # Metrics plane live during the measurement (DBM_METRICS_INTERVAL_S;
@@ -1461,6 +1475,19 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             mesh_detail = {"mesh": {"error": repr(exc)[:300]}}
 
+    # Transport datapath A/B (ISSUE 17): echo-storm msgs/s fast vs stock
+    # (DBM_MMSG=0 DBM_WIRE_FAST=0) in subprocess legs, syscall economics,
+    # per-conn memory — sockets only, no JAX, so it runs on any box.
+    # DBM_BENCH_TRANSPORT=0 skips it.
+    transport_detail = {}
+    if _str_env("DBM_BENCH_TRANSPORT", "1") != "0":
+        try:
+            from distributed_bitcoinminer_tpu.apps.transportbench import (
+                transport_probe)
+            transport_detail = {"transport": transport_probe(_REPO)}
+        except Exception as exc:  # noqa: BLE001
+            transport_detail = {"transport": {"error": repr(exc)[:300]}}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
@@ -1496,6 +1523,7 @@ def main() -> int:
         **adapt_detail,
         **replay_detail,
         **mesh_detail,
+        **transport_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
         # (midstate cache behavior, until-tier degradations) stay

@@ -137,8 +137,8 @@ def _analyzers():
     # Finding/SourceFile from THIS module, so the catalog can only be
     # built once core's classes exist (the call at module bottom runs
     # after every definition above it).
-    from . import (cardinality, jitstatic, knobs, lockdiscipline,
-                   loopblock, threadstate)
+    from . import (cardinality, hotpathalloc, jitstatic, knobs,
+                   lockdiscipline, loopblock, threadstate)
     return {
         "loop-block": loopblock.analyze,
         "cardinality": cardinality.analyze,
@@ -146,6 +146,7 @@ def _analyzers():
         "jit-static": jitstatic.analyze,
         "thread-state": threadstate.analyze,
         "lock-discipline": lockdiscipline.analyze,
+        "hotpath-alloc": hotpathalloc.analyze,
     }
 
 

@@ -833,14 +833,18 @@ class WideMiner(Scenario):
 _REPLAY_CAPS: dict = {}
 
 
-def _replay_capture():
-    """The capture the ``replayed_storm`` scenario replays:
-    ``DBM_CHECK_CAPTURE`` (the tier-1 replay leg points it at the storm
-    it just captured), or the checked-in fixture — a real
-    mice-stampede run captured on the detnet harness."""
-    path = str_env("DBM_CHECK_CAPTURE", "") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "replay_fixture.jsonl")
+def _replay_capture(fixture: str = "replay_fixture.jsonl",
+                    env_override: bool = True):
+    """The capture a replayed scenario replays: ``DBM_CHECK_CAPTURE``
+    (the tier-1 replay leg points it at the storm it just captured;
+    honored only when ``env_override``), or the checked-in ``fixture``
+    — ``replay_fixture.jsonl`` is a real mice-stampede run captured on
+    the detnet harness, ``replay_transport_fixture.jsonl`` a
+    transport-bound ``loadharness --procs`` storm over real UDP
+    sockets (ISSUE 17)."""
+    path = (str_env("DBM_CHECK_CAPTURE", "") if env_override
+            else "") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), fixture)
     cap = _REPLAY_CAPS.get(path)
     if cap is None:
         from ...apps.capture import load_capture
@@ -872,10 +876,16 @@ class ReplayedStorm(Scenario):
     MAX_NONCES = 512
     MAX_WINDOW_VTIME = 2.5
 
+    #: Which checked-in capture drives the shape, and whether the
+    #: ``DBM_CHECK_CAPTURE`` override applies (the tier-1 replay leg
+    #: retargets only the base scenario at its fresh capture).
+    FIXTURE = "replay_fixture.jsonl"
+    ENV_OVERRIDE = True
+
     def build(self, ctx: Ctx) -> None:
         from ...apps.capture import replay_plan
         rng = ctx.rng
-        cap = _replay_capture()
+        cap = _replay_capture(self.FIXTURE, self.ENV_OVERRIDE)
         plan = replay_plan(cap)
         n_ten = rng.randint(4, self.MAX_TENANTS)
         if len(plan) > n_ten:
@@ -938,6 +948,26 @@ class ReplayedStorm(Scenario):
         out = self.check_replies(ctx)
         out += self.check_accounting(ctx)
         return out
+
+
+class ReplayedTransportStorm(ReplayedStorm):
+    """ISSUE 17: the ``replayed_storm`` machinery over a TRANSPORT-BOUND
+    capture — a ``loadharness --procs`` storm recorded with
+    ``DBM_CAPTURE=1`` on the real multi-process topology (router +
+    replica processes + fake miner agents over real localhost UDP at
+    the batched-syscall datapath's admitted/s ceiling), checked in as
+    ``replay_transport_fixture.jsonl``. The detnet replay keeps the
+    measured arrival pacing and burst shape of traffic that saturated
+    the REAL wire, so interleaving exploration covers the burst
+    patterns the mmsg datapath actually produces (deep recv bursts,
+    ack flushes at pump exit) rather than hand-scripted pacing. The
+    fixture is pinned (no ``DBM_CHECK_CAPTURE`` override): the tier-1
+    replay leg retargets the base scenario, while this one always
+    explores the checked-in transport storm."""
+
+    name = "replayed_transport_storm"
+    FIXTURE = "replay_transport_fixture.jsonl"
+    ENV_OVERRIDE = False
 
 
 # -------------------------------------------------------- health_takeover
@@ -1399,6 +1429,7 @@ SCENARIOS = {
     "plane_split": PlaneSplit,
     "wide_miner": WideMiner,
     "replayed_storm": ReplayedStorm,
+    "replayed_transport_storm": ReplayedTransportStorm,
     "replica_takeover": ReplicaTakeover,
     "adaptive_control": AdaptiveControl,
     "health_takeover": HealthTakeover,

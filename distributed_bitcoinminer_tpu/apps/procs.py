@@ -643,6 +643,14 @@ class ProcCluster:
         self.env.update({"JAX_PLATFORMS": "cpu",
                          "DBM_METRICS_INTERVAL_S": "0",
                          "DBM_QUEUE_ALARM_S": "0"})
+        if fake_miners:
+            # Fake miners fabricate hashes by construction, so the
+            # verification tier would reject every Result and quarantine
+            # the whole pool (the in-process harness legs pass
+            # verify=VerifyParams(enabled=False) for the same reason) —
+            # the control plane is the thing measured here. An explicit
+            # env override still wins.
+            self.env["DBM_VERIFY"] = "0"
         self.env.update(env or {})
         self.procs: Dict[str, object] = {}      # name -> Popen
 

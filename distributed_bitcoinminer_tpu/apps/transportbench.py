@@ -1,0 +1,316 @@
+"""Transport-datapath bench probe (ISSUE 17): ``detail.transport``.
+
+Measures the real UDP datapath — ``AsyncServer``/``AsyncClient`` over
+loopback, the exact code production traffic takes — under an echo storm:
+every client keeps a fixed number of round-trips in flight, the server
+echoes every payload back, and the probe reports application messages
+per second BOTH directions plus the syscall economics the batched
+datapath (``DBM_MMSG``) and the allocation-free wire codec
+(``DBM_WIRE_FAST``) were built to change:
+
+- ``echo_storm.throughput`` — app msgs/s both directions, fast datapath
+  (the tier-1 gated number; ``benchdiff`` classifies the literal key
+  ``throughput`` higher-better);
+- ``echo_storm.speedup`` — fast vs stock (``DBM_MMSG=0
+  DBM_WIRE_FAST=0``) medians over interleaved, order-swapped rounds
+  (same noise discipline as the pipeline probe: a 2-core bench box
+  swings single legs more than the win itself);
+- ``syscalls_per_msg`` — from the ``net.syscalls``/``net.datagrams``
+  counter deltas across the timed window (stock truthfully reports
+  ~1.0 each direction; the mmsg path amortizes);
+- ``bytes_per_msg`` — wire bytes per datagram from ``net.bytes``;
+- ``p99_ack_rtt_s`` — send→ack latency from the ``lsp.msg_rtt_s``
+  histogram (bucket upper-bound estimate, Karn-filtered samples);
+- ``conn_memory`` — resident bytes per live ``ConnCore`` pair at
+  10k/50k/100k connections (the flattened slotted-struct + ring-window
+  state, measured as VmRSS deltas — no sockets involved).
+
+Each leg runs in a SUBPROCESS (``bench.py --transport-child``) so the
+``DBM_MMSG``/``DBM_WIRE_FAST`` knobs bind at import/endpoint-creation
+time exactly as they do in production, and so the two legs never share
+a warmed allocator or event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from ..lsp.params import Params
+from ..utils._env import float_env as _float_env, int_env as _int_env
+
+#: Child geometry knobs (documented in utils/config.py).
+_DEF_CONNS = 32
+_DEF_INFLIGHT = 8
+_DEF_PAYLOAD = 128
+_DEF_MEASURE_S = 1.0
+_DEF_WARMUP_S = 0.3
+_DEF_WINDOW = 64
+
+
+# --------------------------------------------------------------- child leg
+
+def _counter(snap: dict, key: str) -> float:
+    return float(snap.get("counters", {}).get(key, 0))
+
+
+def _hist_p99(snap: dict, key: str) -> Optional[float]:
+    """Bucket upper-bound p99 estimate of a cumulative-``le`` histogram."""
+    h = snap.get("histograms", {}).get(key)
+    if not h or not h.get("count"):
+        return None
+    want = 0.99 * h["count"]
+    for bound, cum in zip(h["le"], h["counts"]):
+        if cum >= want:
+            return float(bound)
+    return float("inf")
+
+
+def _net_stats(snap: dict) -> dict:
+    return {
+        "sys_recv": _counter(snap, "net.syscalls{dir=recv}"),
+        "sys_send": _counter(snap, "net.syscalls{dir=send}"),
+        "dg_recv": _counter(snap, "net.datagrams{dir=recv}"),
+        "dg_send": _counter(snap, "net.datagrams{dir=send}"),
+        "bytes_recv": _counter(snap, "net.bytes{dir=recv}"),
+        "bytes_send": _counter(snap, "net.bytes{dir=send}"),
+    }
+
+
+async def _echo_storm() -> dict:
+    from ..lsp.client import new_async_client
+    from ..lsp.errors import ConnectionClosed
+    from ..lsp.server import new_async_server
+    from ..utils.metrics import registry
+
+    conns = max(1, _int_env("DBM_BENCH_TRANSPORT_CONNS", _DEF_CONNS))
+    inflight = max(1, _int_env("DBM_BENCH_TRANSPORT_INFLIGHT",
+                               _DEF_INFLIGHT))
+    payload = b"n" * max(1, _int_env("DBM_BENCH_TRANSPORT_PAYLOAD",
+                                     _DEF_PAYLOAD))
+    measure_s = _float_env("DBM_BENCH_TRANSPORT_SECS", _DEF_MEASURE_S)
+    warmup_s = _float_env("DBM_BENCH_TRANSPORT_WARMUP_S", _DEF_WARMUP_S)
+    params = Params(window_size=_DEF_WINDOW)
+
+    server = await new_async_server(0, params)
+
+    async def echo() -> None:
+        # One awaited read per burst, then drain — the scheduler's
+        # batched recv idiom; every inbound payload turns around.
+        try:
+            item: Optional[Tuple[int, object]] = await server.read()
+            while True:
+                while item is not None:
+                    cid, body = item
+                    if isinstance(body, (bytes, bytearray)):
+                        try:
+                            server.write(cid, bytes(body))
+                        except ConnectionClosed:
+                            pass
+                    item = server.read_nowait()
+                item = await server.read()
+        except (ConnectionClosed, asyncio.CancelledError):
+            return
+
+    echo_task = asyncio.get_running_loop().create_task(echo())
+    clients = []
+    for _ in range(conns):
+        clients.append(await new_async_client(f"127.0.0.1:{server.port}",
+                                              params))
+
+    completed = [0]
+
+    async def drive(client) -> None:
+        try:
+            for _ in range(inflight):
+                client.write(payload)
+            while True:
+                await client.read()
+                completed[0] += 1
+                client.write(payload)
+        except (ConnectionClosed, asyncio.CancelledError):
+            return
+
+    tasks = [asyncio.get_running_loop().create_task(drive(c))
+             for c in clients]
+
+    await asyncio.sleep(warmup_s)
+    snap0 = registry().snapshot()
+    n0, t0 = completed[0], time.monotonic()
+    await asyncio.sleep(measure_s)
+    snap1 = registry().snapshot()
+    n1, t1 = completed[0], time.monotonic()
+
+    for task in tasks + [echo_task]:
+        task.cancel()
+    await asyncio.gather(*tasks, echo_task, return_exceptions=True)
+
+    elapsed = max(t1 - t0, 1e-9)
+    roundtrips = n1 - n0
+    d0, d1 = _net_stats(snap0), _net_stats(snap1)
+    delta = {k: d1[k] - d0[k] for k in d1}
+    datagrams = delta["dg_recv"] + delta["dg_send"]
+    syscalls = delta["sys_recv"] + delta["sys_send"]
+    wire_bytes = delta["bytes_recv"] + delta["bytes_send"]
+    return {
+        # App msgs/s both directions: each round-trip is one client->
+        # server message plus one echo back.
+        "throughput": round(2.0 * roundtrips / elapsed, 1),
+        "roundtrips": roundtrips,
+        "elapsed_s": round(elapsed, 4),
+        "conns": conns,
+        "inflight": inflight,
+        "payload_bytes": len(payload),
+        "syscalls_per_msg": (round(syscalls / datagrams, 4)
+                             if datagrams else None),
+        "bytes_per_msg": (round(wire_bytes / datagrams, 1)
+                          if datagrams else None),
+        "datagrams_per_s": round(datagrams / elapsed, 1),
+        "p99_ack_rtt_s": _hist_p99(snap1, "lsp.msg_rtt_s"),
+        "mmsg_active": _int_env("DBM_MMSG", 1) != 0,
+        "wire_fast_active": _int_env("DBM_WIRE_FAST", 1) != 0,
+    }
+
+
+def echo_storm_child() -> dict:
+    """One echo-storm leg in THIS process (``bench.py --transport-child``);
+    the knobs are whatever the environment says."""
+    return asyncio.run(_echo_storm())
+
+
+# -------------------------------------------------------- conn-memory probe
+
+def _vm_rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def conn_memory_probe(counts=(10_000, 50_000, 100_000),
+                      window: int = 8) -> dict:
+    """Resident bytes per live connection: bare ``ConnCore`` pairs (one
+    server-side + one client-side core per logical conn — both ends live
+    in-process under detnet and the load harness), measured as VmRSS
+    growth. No sockets, no loop: this is the flattened conn-table state
+    ISSUE 17's slotted structs + ring windows exist to shrink."""
+    import gc
+
+    from ..lsp.core import ConnCore
+
+    rss0 = _vm_rss_bytes()
+    if rss0 is None:
+        return {"error": "VmRSS unavailable"}
+    params = Params(window_size=window)
+    cores: List[ConnCore] = []
+    out = {}
+    for target in sorted(counts):
+        while len(cores) < 2 * target:
+            cid = len(cores) // 2 + 1
+            cores.append(ConnCore(params, cid))
+            cores.append(ConnCore(params, cid, connect=True))
+        gc.collect()
+        rss = _vm_rss_bytes()
+        if rss is None:
+            break
+        out[f"rss_per_conn_at_{target}"] = round((rss - rss0) / target, 1)
+    out["window"] = window
+    return out
+
+
+# ------------------------------------------------------------ orchestration
+
+_FAST_ENV = {"DBM_MMSG": "1", "DBM_WIRE_FAST": "1"}
+_STOCK_ENV = {"DBM_MMSG": "0", "DBM_WIRE_FAST": "0"}
+
+
+def _run_child(repo_root: str, overrides: dict,
+               timeout_s: float = 60.0) -> dict:
+    env = dict(os.environ)
+    env.update(overrides)
+    # The child is a pure transport measurement: keep the metrics
+    # emitter and capture planes out of the timed window.
+    env.setdefault("DBM_METRICS_INTERVAL_S", "0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo_root, "bench.py"),
+         "--transport-child"],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=repo_root, check=False)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"transport child produced no JSON (rc={proc.returncode}): "
+        f"{proc.stderr.strip()[-300:]}")
+
+
+def transport_probe(repo_root: str) -> dict:
+    """The full ``detail.transport`` dict: interleaved A/B echo-storm legs
+    (fast datapath vs ``DBM_MMSG=0 DBM_WIRE_FAST=0`` stock), medians,
+    plus the conn-memory scaling measurement."""
+    from ..lsp import _mmsg
+
+    rounds = max(1, _int_env("DBM_BENCH_TRANSPORT_ROUNDS", 3))
+    fast_legs: List[dict] = []
+    stock_legs: List[dict] = []
+    for i in range(rounds):
+        # Order swapped each round: kills slow-box order bias.
+        order = [(_FAST_ENV, fast_legs), (_STOCK_ENV, stock_legs)]
+        if i % 2:
+            order.reverse()
+        for overrides, legs in order:
+            legs.append(_run_child(repo_root, overrides))
+
+    def med(legs: List[dict], key: str) -> Optional[float]:
+        vals = [leg[key] for leg in legs if leg.get(key) is not None]
+        return round(statistics.median(vals), 4) if vals else None
+
+    fast_tp = med(fast_legs, "throughput") or 0.0
+    stock_tp = med(stock_legs, "throughput") or 0.0
+    return {
+        "schema": "transport_datapath_v1",
+        "mmsg_available": _mmsg.available(),
+        "rounds": rounds,
+        "echo_storm": {
+            "throughput": fast_tp,
+            "stock_msgs_per_s": stock_tp,
+            "speedup": (round(fast_tp / stock_tp, 3) if stock_tp else None),
+        },
+        "fast": {
+            "syscalls_per_msg": med(fast_legs, "syscalls_per_msg"),
+            "bytes_per_msg": med(fast_legs, "bytes_per_msg"),
+            "p99_ack_rtt_s": med(fast_legs, "p99_ack_rtt_s"),
+        },
+        "stock": {
+            "syscalls_per_msg": med(stock_legs, "syscalls_per_msg"),
+            "bytes_per_msg": med(stock_legs, "bytes_per_msg"),
+            "p99_ack_rtt_s": med(stock_legs, "p99_ack_rtt_s"),
+        },
+        "conn_memory": conn_memory_probe(),
+        "samples": {"fast": fast_legs, "stock": stock_legs},
+    }
+
+
+def standalone_artifact(repo_root: str) -> dict:
+    """The ``bench.py --transport-only`` artifact (the tier-1 transport-
+    regression leg's input): the probe dict nested under ``transport``
+    so its paths line up with the full BENCH artifact's
+    ``detail/transport/...`` leaves for ``benchdiff``."""
+    probe = transport_probe(repo_root)
+    return {
+        "metric": "transport_datapath",
+        "value": probe["echo_storm"]["throughput"],
+        "unit": "msgs/sec",
+        "detail": {"transport": probe},
+    }

@@ -1,91 +1,44 @@
-"""The LSP connection state machine, shared by client and server endpoints.
+"""Asyncio shell around the sans-io LSP core (:mod:`.core`).
 
-One :class:`Conn` owns all state for a single connection — send window +
-overflow buffer, retransmit backoff bookkeeping, receive reordering, epoch
-heartbeat/loss timers, and the close handshake. All methods run on a single
-asyncio event loop, so the structure is race-free by construction (the
-equivalent of the reference's one-goroutine-owns-the-state channel design;
-ref: lsp/client_impl.go mainRoutine, lsp/server_impl.go clientMain).
+The protocol state machine — window/backoff/reorder/epoch/close semantics
+— lives entirely in :class:`~.core.ConnCore`; see its module docstring
+for the contract. This module adapts it to an event loop: every core
+input runs on the loop (race-free by construction, the equivalent of the
+reference's one-goroutine-owns-the-state design), the core's ``outbox``
+is flushed to the owner's ``send_raw`` after each input (the flush is
+one syscall burst under ``sendmmsg``), the core's one timer request is
+serviced by the shared per-loop timer wheel (or a per-conn task under
+``DBM_TIMER_WHEEL=0``), and the core's app-event callbacks are mapped to
+the asyncio surface endpoints await (``connected`` future,
+``closed_event``).
 
-State machine (explicit, replacing the reference's boolean-flag interplay):
-
-    CONNECTING --ack(0)--> UP --begin_close--> CLOSING --flushed--> CLOSED
-         |                 |                      |
-         +--epoch limit--> LOST <--epoch limit----+
-
-Retransmission reproduces the reference's observable backoff pattern
-XXOXOOX0000X (ref: lsp/client_impl.go resendRoutine:230-257): a message is
-sent, then resent when ``epochs_passed >= cur_backoff``; the backoff goes
-0 -> 1 -> 2x thereafter, capped at ``max_backoff_interval``.
+:class:`Conn`'s public surface is unchanged from when it WAS the state
+machine — ``write`` / ``on_message`` / ``resume_delivery`` /
+``begin_close`` / ``abort`` / ``flushed`` / ``state`` / ``conn_id`` /
+``connected`` / ``closed_event`` — so ``server.py``/``client.py`` drive
+it exactly as before. ``ConnState`` and ``integrity_check`` re-export
+from :mod:`.core` for the same reason.
 """
 
 from __future__ import annotations
 
 import asyncio
-import enum
-import time
-from collections import deque
 from typing import Callable, Optional
 
-from .checksum import make_checksum
-from .errors import ConnectionClosed, ConnectionLost, ConnectTimeout
-from .message import Message, MsgType, new_ack, new_data
+from .core import ConnCore, ConnState, integrity_check
+from .message import Message
 from .params import Params
 from .timerwheel import wheel_enabled, wheel_for
-from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS,
-                             registry as _registry)
 
-# Process-wide transport metrics (utils/metrics.py). Handles are hoisted to
-# module scope: the receive path runs per packet, so per-call registry
-# lookups would be the one avoidable cost. Counts aggregate over every Conn
-# in the process — per-conn labels would be unbounded cardinality for a
-# long-lived server.
-_M = _registry()
-_MET_EPOCHS = _M.counter("lsp.epochs")
-_MET_HEARTBEATS = _M.counter("lsp.heartbeats_sent")
-_MET_RECV_DUP = _M.counter("lsp.recv_discards", reason="duplicate")
-_MET_CONN_LOST = _M.counter("lsp.conns_lost")
-_MET_SEND_WINDOW = _M.histogram("lsp.send_window_occupancy",
-                                buckets=OCCUPANCY_BUCKETS)
-_MET_RECV_PENDING = _M.histogram("lsp.recv_pending_occupancy",
-                                 buckets=OCCUPANCY_BUCKETS)
-_MET_RTT = _M.histogram("lsp.msg_rtt_s", buckets=LATENCY_BUCKETS_S)
-_MET_DROP_LENGTH = _M.counter("lsp.integrity_drops", reason="length")
-_MET_DROP_CHECKSUM = _M.counter("lsp.integrity_drops", reason="checksum")
-
-
-class ConnState(enum.Enum):
-    CONNECTING = "connecting"
-    UP = "up"
-    CLOSING = "closing"
-    CLOSED = "closed"
-    LOST = "lost"
-
-
-class _Pending:
-    """One unacknowledged outbound message and its retransmit schedule."""
-
-    __slots__ = ("seq", "raw", "cur_backoff", "epochs_passed", "fresh",
-                 "sent_at", "retransmitted")
-
-    def __init__(self, seq: int, raw: bytes):
-        self.seq = seq
-        self.raw = raw
-        self.cur_backoff = 0
-        self.epochs_passed = 0
-        # Sent between epoch ticks: the first tick after the send does not
-        # count toward the retransmit schedule (approximates the reference's
-        # per-message timer phase within the graded 4-6 sends/14 epochs law).
-        self.fresh = True
-        # RTT metric plane: stamp of the (latest) first transmission; a
-        # retransmitted message's eventual ack is ambiguous (Karn's rule),
-        # so only never-retransmitted messages contribute RTT samples.
-        self.sent_at = 0.0
-        self.retransmitted = False
+__all__ = ["Conn", "ConnState", "integrity_check"]
 
 
 class Conn:
-    """One LSP connection. Owner provides I/O + delivery callbacks."""
+    """One LSP connection on an event loop. Owner provides I/O + delivery
+    callbacks; protocol logic is the sans-io core's."""
+
+    __slots__ = ("_core", "_send_raw", "connected", "closed_event",
+                 "_epoch_task", "_wheel", "_wheel_handle")
 
     def __init__(
         self,
@@ -97,59 +50,24 @@ class Conn:
         connect_msg: Optional[Message] = None,
         deliver_ready: Optional[Callable[[], bool]] = None,
     ):
-        self.params = params
-        self.conn_id = conn_id
         self._send_raw = send_raw
-        self._deliver = deliver
-        self._broken = broken
-        # Delivery back-pressure probe (server read-queue bound, ref:
-        # lsp/server_impl.go:112): when it returns False, the next in-order
-        # message is parked in ``_recv_pending`` WITHOUT an ack — the
-        # peer's send window cannot slide past an unacked head, so it
-        # stalls at W outstanding and memory stays bounded end-to-end
-        # without blocking the event loop (the asyncio analog of the
-        # reference's goroutine blocking on its full 500-chan). The owner
-        # calls :meth:`resume_delivery` when the app frees queue room; the
-        # parked head is acked at delivery time.
-        self._deliver_ready = deliver_ready or (lambda: True)
+        loop = asyncio.get_running_loop()
+        self.connected: asyncio.Future = loop.create_future()
+        self.closed_event = asyncio.Event()
 
-        self.state = ConnState.CONNECTING if connect_msg is not None else ConnState.UP
-
-        # Send side. Data sequence numbers start at 1 on both roles.
-        self._next_seq = 1
-        self._window: dict[int, _Pending] = {}
-        self._buffer: deque[_Pending] = deque()
-
-        # The in-flight Connect request, retransmitted like a window element.
-        self._connect_pending: Optional[_Pending] = None
-        self.connected: asyncio.Future = asyncio.get_running_loop().create_future()
-        if connect_msg is not None:
-            self._connect_pending = _Pending(0, connect_msg.to_json())
-            self._send_raw(self._connect_pending.raw)
-        else:
+        self._core = ConnCore(
+            params, conn_id,
+            connect=connect_msg is not None,
+            deliver=deliver,
+            broken=broken,
+            on_connected=self._when_connected,
+            on_connect_failed=self._when_connect_failed,
+            on_closed=self._when_closed,
+            deliver_ready=deliver_ready,
+        )
+        if connect_msg is None:
             self.connected.set_result(conn_id)
 
-        # Receive side: in-order reassembly. ``_recv_unacked`` holds the
-        # (at most one) parked back-pressure head whose ack is deferred to
-        # delivery; its retransmits must NOT take the duplicate re-ack
-        # path, or the peer's window would slide past an undelivered
-        # message the app might never get room for.
-        self._recv_expected = 1
-        self._recv_pending: dict[int, bytes] = {}
-        self._recv_unacked: set[int] = set()
-
-        # Epoch bookkeeping. Loss detection counts ALL inbound messages
-        # (ref connDropTimer resets on gotMessageChan); the heartbeat
-        # reminder is suppressed only by SUBSTANTIVE traffic (data / data
-        # acks), because on a mutually idle link the reference's reminder
-        # race resolves toward firing every epoch on both sides — a peer's
-        # heartbeat must not starve ours, or its loss detector (fed only
-        # by our sends) counts up to the epoch limit on a live link.
-        self._silent_epochs = 0
-        self._got_traffic = False
-        self._got_payload_traffic = False
-
-        self.closed_event = asyncio.Event()
         # Epoch timer: the shared per-loop timer wheel by default (one
         # sleeping task services every conn on this loop — 10k conns is
         # 10k heap entries, not 10k tasks; ISSUE 11), or the stock
@@ -160,243 +78,51 @@ class Conn:
         self._wheel = None
         self._wheel_handle = None
         if wheel_enabled():
-            self._wheel = wheel_for(asyncio.get_running_loop())
+            self._wheel = wheel_for(loop)
             self._wheel_handle = self._wheel.add(
-                self.params.epoch_millis / 1000.0, self._tick)
+                self._core.epoch_interval_s, self._tick)
         else:
-            self._epoch_task = asyncio.get_running_loop().create_task(
-                self._epoch_loop())
+            self._epoch_task = loop.create_task(self._epoch_loop())
 
-    # ------------------------------------------------------------- send path
+        self._flush()
 
-    def write(self, payload: bytes) -> None:
-        if self.state in (ConnState.CLOSING, ConnState.CLOSED, ConnState.LOST):
-            raise ConnectionClosed(f"conn {self.conn_id}: write after close/loss")
-        seq = self._next_seq
-        self._next_seq += 1
-        checksum = make_checksum(self.conn_id, seq, len(payload), payload)
-        msg = new_data(self.conn_id, seq, len(payload), payload, checksum)
-        pending = _Pending(seq, msg.to_json())
-        if self._can_admit(seq):
-            self._window[seq] = pending
-            pending.sent_at = time.monotonic()
-            self._send_raw(pending.raw)
-            _MET_SEND_WINDOW.observe(len(self._window))
-        else:
-            self._buffer.append(pending)
+    # ------------------------------------------------------- core adaptation
 
-    def _can_admit(self, seq: int) -> bool:
-        # Window rule (ref: lsp/client_impl.go:381-389): at most W unacked
-        # messages, all within [oldest_unacked, oldest_unacked + W).
-        if len(self._window) >= self.params.window_size:
-            return False
-        base = min(self._window) if self._window else seq
-        return seq < base + self.params.window_size
+    @property
+    def params(self) -> Params:
+        return self._core.params
 
-    def _refill_window(self) -> None:
-        while self._buffer and self._can_admit(self._buffer[0].seq):
-            pending = self._buffer.popleft()
-            self._window[pending.seq] = pending
-            pending.sent_at = time.monotonic()   # first real transmission
-            self._send_raw(pending.raw)
-            _MET_SEND_WINDOW.observe(len(self._window))
+    @property
+    def conn_id(self) -> int:
+        return self._core.conn_id
+
+    @property
+    def state(self) -> ConnState:
+        return self._core.state
 
     @property
     def flushed(self) -> bool:
-        return not self._window and not self._buffer
+        return self._core.flushed
 
-    # ---------------------------------------------------------- receive path
+    def _flush(self) -> None:
+        """Drain the core's outbound burst to the socket layer. A batching
+        endpoint turns the whole burst into one ``sendmmsg`` at pump exit."""
+        outbox = self._core.outbox
+        if outbox:
+            send = self._send_raw
+            for raw in outbox:
+                send(raw)
+            outbox.clear()
 
-    def on_message(self, msg: Message) -> None:
-        """Handle one integrity-checked inbound message."""
-        self._got_traffic = True
-        if msg.type != MsgType.ACK or msg.seq_num != 0:
-            self._got_payload_traffic = True
-        if msg.type == MsgType.DATA:
-            self._on_data(msg)
-        elif msg.type == MsgType.ACK:
-            self._on_ack(msg)
+    def _when_connected(self, conn_id: int) -> None:
+        if not self.connected.done():
+            self.connected.set_result(conn_id)
 
-    def _on_data(self, msg: Message) -> None:
-        if self.state in (ConnState.CLOSED, ConnState.LOST):
-            return
-        if self.state == ConnState.CONNECTING:
-            # Data from the server implies our Connect was accepted (the
-            # explicit Ack(id, 0) was lost/delayed): establish implicitly so
-            # the ack below carries the right conn id and delivery proceeds.
-            self.conn_id = msg.conn_id
-            self.state = ConnState.UP
-            self._connect_pending = None
-            if not self.connected.done():
-                self.connected.set_result(msg.conn_id)
-        seq = msg.seq_num
-        if seq < self._recv_expected or seq in self._recv_pending:
-            # Duplicates of ACKED messages are re-acked (exactly-once
-            # delivery comes from receive-side dedup, not ack suppression;
-            # ref: lsp/server_impl.go:462-470). A retransmit of the parked
-            # unacked back-pressure head stays unacked until delivery.
-            _MET_RECV_DUP.inc()
-            if seq not in self._recv_unacked:
-                self._send_raw(new_ack(self.conn_id, seq).to_json())
-            return
-        if seq == self._recv_expected and self.state == ConnState.UP and \
-                not self._deliver_ready():
-            # Back-pressure: park the head unacked; see the __init__ note.
-            # Out-of-order messages are still admitted (and acked) below —
-            # they are bounded by the peer's window, which cannot slide
-            # past this unacked head.
-            self._recv_pending[seq] = msg.payload or b""
-            self._recv_unacked.add(seq)
-            return
-        self._send_raw(new_ack(self.conn_id, seq).to_json())
-        self._recv_pending[seq] = msg.payload or b""
-        _MET_RECV_PENDING.observe(len(self._recv_pending))
-        self._drain()
-
-    def _drain(self) -> None:
-        """Deliver the in-order run while the owner's queue has room; the
-        parked back-pressure head is acked here, at delivery time."""
-        while self._recv_expected in self._recv_pending and (
-                self.state != ConnState.UP or self._deliver_ready()):
-            seq = self._recv_expected
-            payload = self._recv_pending.pop(seq)
-            if seq in self._recv_unacked:
-                self._recv_unacked.discard(seq)
-                self._send_raw(new_ack(self.conn_id, seq).to_json())
-            self._recv_expected += 1
-            if self.state == ConnState.UP:
-                self._deliver(payload)
-
-    def resume_delivery(self) -> None:
-        """Owner hook: queue room reappeared (the app read); deliver any
-        messages that stranded when :meth:`_drain` hit the cap — inbound
-        traffic is NOT guaranteed to re-trigger it (an acked out-of-order
-        backlog has no retransmits coming)."""
-        if self.state in (ConnState.UP, ConnState.CLOSING):
-            self._drain()
-
-    def _on_ack(self, msg: Message) -> None:
-        if msg.seq_num == 0:
-            # Heartbeat — or the connect ack while CONNECTING.
-            if self.state == ConnState.CONNECTING:
-                self.conn_id = msg.conn_id
-                self.state = ConnState.UP
-                self._connect_pending = None
-                if not self.connected.done():
-                    self.connected.set_result(msg.conn_id)
-            return
-        pending = self._window.pop(msg.seq_num, None)
-        if pending is None:
-            return
-        if not pending.retransmitted and pending.sent_at:
-            # Send->ack RTT, Karn-filtered (see _Pending).
-            _MET_RTT.observe(time.monotonic() - pending.sent_at)
-        self._refill_window()
-        if self.state == ConnState.CLOSING and self.flushed:
-            self._finish(ConnState.CLOSED)
-
-    # ------------------------------------------------------------ epoch loop
-
-    async def _epoch_loop(self) -> None:
-        epoch = self.params.epoch_millis / 1000.0
-        while True:
-            await asyncio.sleep(epoch)
-            if not self._tick():
-                return
-
-    def _tick(self) -> bool:
-        """One epoch. Returns False when the connection is finished."""
-        _MET_EPOCHS.inc()
-        # Loss detection (ref: lsp/client_impl.go timeRoutine:258-286).
-        if self._got_traffic:
-            self._silent_epochs = 0
-            self._got_traffic = False
-        else:
-            self._silent_epochs += 1
-            if self._silent_epochs >= self.params.epoch_limit:
-                if self.state == ConnState.CONNECTING:
-                    self._fail_connect(ConnectTimeout(
-                        f"no connect ack after {self.params.epoch_limit} epochs"))
-                else:
-                    self._declare_lost()
-                return False
-
-        # Heartbeat, idle-only (VERDICT r4): the reference re-arms its
-        # reminder timer on every inbound message and sends Ack(connID, 0)
-        # only after a receive-silent epoch (ref: lsp/client_impl.go:268-281,
-        # server_impl.go:396-420) — so a BUSY link emits no reminder acks.
-        # On an idle link, peer heartbeats arrive one epoch + latency apart,
-        # so the reference's reminder reliably fires anyway: idleness is
-        # judged on substantive traffic only (see __init__ note).
-        if not self._got_payload_traffic and \
-                self.state in (ConnState.UP, ConnState.CLOSING):
-            self._send_raw(new_ack(self.conn_id, 0).to_json())
-            _MET_HEARTBEATS.inc()
-        self._got_payload_traffic = False
-
-        # Retransmits: the Connect request and every unacked window element.
-        retransmit = list(self._window.values())
-        if self._connect_pending is not None:
-            retransmit.append(self._connect_pending)
-        for pending in retransmit:
-            if pending.fresh:
-                pending.fresh = False
-            elif pending.epochs_passed >= pending.cur_backoff:
-                self._send_raw(pending.raw)
-                pending.retransmitted = True
-                # Labeled by the backoff level that TRIGGERED this resend
-                # (0, 1, 2, 4, ... capped): the distribution is the
-                # XXOXOOX retransmission-law shape, observable per process.
-                _M.counter(   # dbmlint: ok[cardinality] bounded:
-                    # backoff levels are 0, 1, 2, 4, ... capped at the
-                    # max_backoff_interval knob — log2(cap)+2 values.
-                    "lsp.retransmits",
-                    backoff=str(pending.cur_backoff)).inc()
-                pending.epochs_passed = 0
-                if pending.cur_backoff == 0:
-                    pending.cur_backoff = min(1, self.params.max_backoff_interval)
-                else:
-                    pending.cur_backoff = min(pending.cur_backoff * 2,
-                                              self.params.max_backoff_interval)
-            else:
-                pending.epochs_passed += 1
-        return True
-
-    # ----------------------------------------------------------- termination
-
-    def begin_close(self) -> None:
-        """Graceful close: flush window + buffer, then finish (ref: §3.5)."""
-        if self.state in (ConnState.CLOSED, ConnState.LOST):
-            self.closed_event.set()
-            return
-        if self.state == ConnState.CONNECTING:
-            self._fail_connect(ConnectionClosed("closed during connect"))
-            return
-        self.state = ConnState.CLOSING
-        if self.flushed:
-            self._finish(ConnState.CLOSED)
-
-    def abort(self) -> None:
-        """Immediate teardown with no flush (endpoint shutdown path)."""
-        if self.state not in (ConnState.CLOSED, ConnState.LOST):
-            self._finish(ConnState.CLOSED)
-
-    def _declare_lost(self) -> None:
-        _MET_CONN_LOST.inc()
-        self._finish(ConnState.LOST)
-        self._broken(ConnectionLost(f"conn {self.conn_id}: epoch limit reached"))
-
-    def _fail_connect(self, exc: Exception) -> None:
-        self._finish(ConnState.LOST)
+    def _when_connect_failed(self, exc: Exception) -> None:
         if not self.connected.done():
             self.connected.set_exception(exc)
 
-    def _finish(self, final_state: ConnState) -> None:
-        self.state = final_state
-        self._window.clear()
-        self._buffer.clear()
-        self._recv_unacked.clear()
-        self._connect_pending = None
+    def _when_closed(self) -> None:
         self.closed_event.set()
         task = self._epoch_task
         if task is not None and task is not asyncio.current_task():
@@ -406,25 +132,44 @@ class Conn:
             self._wheel.cancel(self._wheel_handle)
             self._wheel_handle = None
 
+    # --------------------------------------------------------- input surface
 
-def integrity_check(msg: Message) -> bool:
-    """Validate (and possibly truncate) an inbound message.
+    def write(self, payload: bytes) -> None:
+        self._core.write(payload)
+        self._flush()
 
-    Rules (ref: lsp/client_impl.go integrityCheck:200-213): Connect/Ack are
-    exempt; short payloads are rejected; long payloads are truncated to
-    ``Size`` before the checksum is verified.
-    """
-    if msg.type in (MsgType.CONNECT, MsgType.ACK):
-        return True
-    payload = msg.payload if msg.payload is not None else b""
-    if len(payload) < msg.size:
-        _MET_DROP_LENGTH.inc()
-        return False
-    if len(payload) > msg.size:
-        payload = payload[: msg.size]
-        msg.payload = payload
-    ok = make_checksum(msg.conn_id, msg.seq_num, msg.size,
-                       payload) == msg.checksum
-    if not ok:
-        _MET_DROP_CHECKSUM.inc()
-    return ok
+    def on_message(self, msg: Message) -> None:
+        """Handle one integrity-checked inbound message."""
+        self._core.on_message(msg)
+        self._flush()
+
+    def resume_delivery(self) -> None:
+        """Owner hook: app read freed queue room; deliver stranded backlog."""
+        self._core.resume_delivery()
+        self._flush()
+
+    # ------------------------------------------------------------ epoch loop
+
+    async def _epoch_loop(self) -> None:
+        epoch = self._core.epoch_interval_s
+        while True:
+            await asyncio.sleep(epoch)
+            if not self._tick():
+                return
+
+    def _tick(self) -> bool:
+        """One epoch. Returns False when the connection is finished."""
+        alive = self._core.on_epoch()
+        self._flush()
+        return alive
+
+    # ----------------------------------------------------------- termination
+
+    def begin_close(self) -> None:
+        """Graceful close: flush window + buffer, then finish (ref: §3.5)."""
+        self._core.begin_close()
+        self._flush()
+
+    def abort(self) -> None:
+        """Immediate teardown with no flush (endpoint shutdown path)."""
+        self._core.abort()

@@ -11,10 +11,11 @@ import asyncio
 from typing import Optional, Union
 
 from .. import lspnet
+from . import wire
 from ._engine import Conn, ConnState, integrity_check
 from ._loop import run_sync
 from .errors import ConnectionClosed, LspError
-from .message import Message, MsgType, new_connect
+from .message import MsgType, new_connect
 from .params import Params
 
 
@@ -55,20 +56,29 @@ class AsyncClient:
                 ConnectionClosed(f"receive loop crashed: {exc!r}"))
 
     async def _recv_loop(self) -> None:
+        # Burst drain (ISSUE 17): one awaited recv per burst, then
+        # recv_nowait until momentarily dry — a recvmmsg batch is
+        # processed in one synchronous sweep, not one loop round-trip
+        # per datagram.
         while True:
             item = await self._ep.recv()
             if item is None:
                 return
-            raw, _addr = item
-            try:
-                msg = Message.from_json(raw)
-            except ValueError:
-                continue
-            if not integrity_check(msg):
-                continue
-            if msg.type == MsgType.CONNECT:
-                continue  # clients never accept connects
-            self._conn.on_message(msg)
+            while item is not None:
+                self._on_datagram(item)
+                item = self._ep.recv_nowait()
+
+    def _on_datagram(self, item: tuple) -> None:
+        raw, _addr = item
+        try:
+            msg = wire.decode(raw)
+        except ValueError:
+            return
+        if not integrity_check(msg):
+            return
+        if msg.type == MsgType.CONNECT:
+            return  # clients never accept connects
+        self._conn.on_message(msg)
 
     # ------------------------------------------------------------ public API
 

@@ -1,0 +1,542 @@
+"""Sans-io LSP protocol core: the state machine with the I/O cut off.
+
+One :class:`ConnCore` owns ALL protocol state for one connection — send
+window + overflow buffer, retransmit backoff bookkeeping, receive
+reordering, epoch heartbeat/loss accounting, and the close handshake —
+and touches NOTHING else: no sockets, no awaits, no asyncio, no wall
+clock it didn't get injected. Inputs are plain method calls (an
+integrity-checked inbound :class:`~.message.Message`, an app write, an
+epoch-timer event); outputs are
+
+- **outbound packets**: wire frames appended to :attr:`ConnCore.outbox`
+  (a plain list the driving shell drains after every input — one drain
+  per input is one syscall burst under ``sendmmsg``);
+- **timer requests**: :attr:`epoch_interval_s` names the one periodic
+  timer the core needs; the shell calls :meth:`on_epoch` at that period
+  until it returns False (connection finished) — the sans-io analog of
+  the reference's per-conn epoch goroutine;
+- **app events**: synchronous callbacks (``deliver``, ``broken``,
+  ``on_connected`` / ``on_connect_failed``, ``on_closed``). Delivery is
+  a callback rather than a polled queue because back-pressure is
+  consulted MID-DRAIN: ``deliver_ready()`` must observe the app queue
+  as each message lands, or a backlog drain would overshoot the cap.
+
+Two shells drive it: ``_engine.Conn`` (asyncio — real UDP endpoints,
+timer wheel or per-conn tasks) and ``lspnet/detnet.py`` (the
+deterministic explorer — synchronous pumps, zero-clock, no timers), so
+dbmcheck explores the REAL protocol code, and a C/Rust shell stays
+possible without forking protocol logic (ISSUE 17).
+
+State machine, retransmission law, heartbeat/loss semantics are the
+reference's, unchanged — see the docstrings below and the original
+notes in ``_engine.py`` history (ref: lsp/client_impl.go mainRoutine,
+lsp/server_impl.go clientMain):
+
+    CONNECTING --ack(0)--> UP --begin_close--> CLOSING --flushed--> CLOSED
+         |                 |                      |
+         +--epoch limit--> LOST <--epoch limit----+
+
+Flattened state (ISSUE 17, 100k-live-conn budget): the send window is a
+ring of ``window_size`` slots (``seq % W`` — the window rule keeps live
+seqs within [base, base+W), so the mapping is collision-free) instead
+of a dict with an O(W) ``min()`` on every admit; the receive reorder
+buffer is the same ring shape with a lazily-created spillover dict for
+frames beyond the ring (a peer with a wider window than ours — never
+hit by our own endpoints, kept for safety); everything is ``__slots__``
+and the overflow deque is lazily allocated (an idle conn is one slotted
+object + two small lists).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from . import wire
+from .errors import ConnectionClosed, ConnectionLost, ConnectTimeout
+from .message import Message, MsgType
+from .params import Params
+from ..utils.metrics import (LATENCY_BUCKETS_S, OCCUPANCY_BUCKETS,
+                             registry as _registry)
+
+__all__ = ["ConnCore", "ConnState", "integrity_check"]
+
+# Process-wide transport metrics (utils/metrics.py). Handles are hoisted
+# to module scope: the receive path runs per packet, so per-call registry
+# lookups would be the one avoidable cost. Counts aggregate over every
+# conn in the process — per-conn labels would be unbounded cardinality
+# for a long-lived server.
+_M = _registry()
+_MET_EPOCHS = _M.counter("lsp.epochs")
+_MET_HEARTBEATS = _M.counter("lsp.heartbeats_sent")
+_MET_RECV_DUP = _M.counter("lsp.recv_discards", reason="duplicate")
+_MET_CONN_LOST = _M.counter("lsp.conns_lost")
+_MET_SEND_WINDOW = _M.histogram("lsp.send_window_occupancy",
+                                buckets=OCCUPANCY_BUCKETS)
+_MET_RECV_PENDING = _M.histogram("lsp.recv_pending_occupancy",
+                                 buckets=OCCUPANCY_BUCKETS)
+_MET_RTT = _M.histogram("lsp.msg_rtt_s", buckets=LATENCY_BUCKETS_S)
+_MET_DROP_LENGTH = _M.counter("lsp.integrity_drops", reason="length")
+_MET_DROP_CHECKSUM = _M.counter("lsp.integrity_drops", reason="checksum")
+
+
+class ConnState(enum.Enum):
+    CONNECTING = "connecting"
+    UP = "up"
+    CLOSING = "closing"
+    CLOSED = "closed"
+    LOST = "lost"
+
+
+class _Pending:
+    """One unacknowledged outbound message and its retransmit schedule."""
+
+    __slots__ = ("seq", "raw", "cur_backoff", "epochs_passed", "fresh",
+                 "sent_at", "retransmitted")
+
+    def __init__(self, seq: int, raw: bytes):
+        self.seq = seq
+        self.raw = raw
+        self.cur_backoff = 0
+        self.epochs_passed = 0
+        # Sent between epoch ticks: the first tick after the send does not
+        # count toward the retransmit schedule (approximates the reference's
+        # per-message timer phase within the graded 4-6 sends/14 epochs law).
+        self.fresh = True
+        # RTT metric plane: stamp of the (latest) first transmission; a
+        # retransmitted message's eventual ack is ambiguous (Karn's rule),
+        # so only never-retransmitted messages contribute RTT samples.
+        self.sent_at = 0.0
+        self.retransmitted = False
+
+
+def _true() -> bool:
+    return True
+
+
+def _ignore(_arg=None) -> None:
+    return None
+
+
+class ConnCore:
+    """One LSP connection's pure state machine. See the module docstring
+    for the input/output contract; a shell MUST drain :attr:`outbox`
+    after every input call (``write`` / ``on_message`` / ``on_epoch`` /
+    ``begin_close`` / ``resume_delivery`` / construction)."""
+
+    __slots__ = (
+        "params", "conn_id", "state", "outbox",
+        "_deliver", "_broken", "_on_connected", "_on_connect_failed",
+        "_on_closed", "_deliver_ready", "_clock",
+        "_next_seq", "_win_slots", "_win_count", "_win_base", "_buffer",
+        "_connect_pending",
+        "_recv_expected", "_recv_ring", "_recv_spill", "_recv_count",
+        "_recv_unacked_seq",
+        "_silent_epochs", "_got_traffic", "_got_payload_traffic",
+    )
+
+    def __init__(
+        self,
+        params: Params,
+        conn_id: int,
+        *,
+        connect: bool = False,
+        deliver: Callable[[bytes], None] = _ignore,
+        broken: Callable[[Exception], None] = _ignore,
+        on_connected: Callable[[int], None] = _ignore,
+        on_connect_failed: Callable[[Exception], None] = _ignore,
+        on_closed: Callable[[], None] = _ignore,
+        deliver_ready: Optional[Callable[[], bool]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.params = params
+        self.conn_id = conn_id
+        self.outbox: List[bytes] = []
+        self._deliver = deliver
+        self._broken = broken
+        self._on_connected = on_connected
+        self._on_connect_failed = on_connect_failed
+        self._on_closed = on_closed
+        # Delivery back-pressure probe (server read-queue bound, ref:
+        # lsp/server_impl.go:112): when it returns False, the next in-order
+        # message is parked in the reorder ring WITHOUT an ack — the
+        # peer's send window cannot slide past an unacked head, so it
+        # stalls at W outstanding and memory stays bounded end-to-end
+        # without blocking the shell. The owner calls
+        # :meth:`resume_delivery` when the app frees queue room; the
+        # parked head is acked at delivery time.
+        self._deliver_ready = deliver_ready or _true
+        # Injected clock feeds ONLY the RTT metric plane (Karn-filtered
+        # send->ack samples). detnet injects a zero clock: ``sent_at``
+        # stays falsy, no samples are recorded, and the core performs no
+        # syscalls at all — fully deterministic.
+        self._clock = clock
+
+        self.state = ConnState.CONNECTING if connect else ConnState.UP
+
+        # Send side. Data sequence numbers start at 1 on both roles.
+        # Ring window: live seqs sit in [base, base+W) at slot seq % W.
+        w = params.window_size
+        self._next_seq = 1
+        self._win_slots: List[Optional[_Pending]] = [None] * w
+        self._win_count = 0
+        self._win_base = 1
+        self._buffer: Optional[deque] = None   # lazily-created overflow
+
+        # The in-flight Connect request, retransmitted like a window element.
+        self._connect_pending: Optional[_Pending] = None
+        if connect:
+            self._connect_pending = _Pending(0, wire.encode_connect())
+            self.outbox.append(self._connect_pending.raw)
+
+        # Receive side: in-order reassembly ring + spillover.
+        # ``_recv_unacked_seq`` is the (at most one) parked back-pressure
+        # head whose ack is deferred to delivery; its retransmits must
+        # NOT take the duplicate re-ack path, or the peer's window would
+        # slide past an undelivered message the app might never get room
+        # for.
+        self._recv_expected = 1
+        self._recv_ring: List[Optional[bytes]] = [None] * w
+        self._recv_spill: Optional[dict] = None
+        self._recv_count = 0
+        self._recv_unacked_seq = -1
+
+        # Epoch bookkeeping. Loss detection counts ALL inbound messages
+        # (ref connDropTimer resets on gotMessageChan); the heartbeat
+        # reminder is suppressed only by SUBSTANTIVE traffic (data / data
+        # acks), because on a mutually idle link the reference's reminder
+        # race resolves toward firing every epoch on both sides — a peer's
+        # heartbeat must not starve ours, or its loss detector (fed only
+        # by our sends) counts up to the epoch limit on a live link.
+        self._silent_epochs = 0
+        self._got_traffic = False
+        self._got_payload_traffic = False
+
+    # --------------------------------------------------------- timer surface
+
+    @property
+    def epoch_interval_s(self) -> float:
+        """The one periodic timer this core requests of its shell."""
+        return self.params.epoch_millis / 1000.0
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (ConnState.CLOSED, ConnState.LOST)
+
+    # ------------------------------------------------------------- send path
+
+    def write(self, payload: bytes) -> None:
+        if self.state in (ConnState.CLOSING, ConnState.CLOSED, ConnState.LOST):
+            raise ConnectionClosed(f"conn {self.conn_id}: write after close/loss")
+        seq = self._next_seq
+        self._next_seq += 1
+        cksum = wire.checksum(self.conn_id, seq, len(payload), payload)
+        pending = _Pending(seq, wire.encode_data(
+            self.conn_id, seq, len(payload), cksum, payload))
+        if self._can_admit(seq):
+            self._admit(pending)
+        else:
+            if self._buffer is None:
+                self._buffer = deque()
+            self._buffer.append(pending)
+
+    def _can_admit(self, seq: int) -> bool:
+        # Window rule (ref: lsp/client_impl.go:381-389): at most W unacked
+        # messages, all within [oldest_unacked, oldest_unacked + W). The
+        # ring keeps ``_win_base`` at the oldest live seq, so the old
+        # O(W) ``min(window)`` is one attribute read.
+        w = self.params.window_size
+        if self._win_count >= w:
+            return False
+        return self._win_count == 0 or seq < self._win_base + w
+
+    def _admit(self, pending: _Pending) -> None:
+        """Place one message in the ring and transmit it."""
+        if self._win_count == 0:
+            self._win_base = pending.seq
+        self._win_slots[pending.seq % self.params.window_size] = pending
+        self._win_count += 1
+        pending.sent_at = self._clock()
+        self.outbox.append(pending.raw)
+        _MET_SEND_WINDOW.observe(self._win_count)
+
+    def _refill_window(self) -> None:
+        buf = self._buffer
+        while buf and self._can_admit(buf[0].seq):
+            self._admit(buf.popleft())
+
+    @property
+    def flushed(self) -> bool:
+        return self._win_count == 0 and not self._buffer
+
+    # ---------------------------------------------------------- receive path
+
+    def on_message(self, msg: Message) -> None:
+        """Handle one integrity-checked inbound message."""
+        self._got_traffic = True
+        if msg.type != MsgType.ACK or msg.seq_num != 0:
+            self._got_payload_traffic = True
+        if msg.type == MsgType.DATA:
+            self._on_data(msg)
+        elif msg.type == MsgType.ACK:
+            self._on_ack(msg)
+
+    # -- reorder-ring helpers. The ring covers [expected, expected+R);
+    # an entry stored to spill stays there until drained even if the
+    # ring window slides over its seq, so both stores are checked.
+
+    def _recv_has(self, seq: int) -> bool:
+        ring = self._recv_ring
+        r = len(ring)
+        if self._recv_expected <= seq < self._recv_expected + r \
+                and ring[seq % r] is not None:
+            return True
+        spill = self._recv_spill
+        return spill is not None and seq in spill
+
+    def _recv_put(self, seq: int, payload: bytes) -> None:
+        ring = self._recv_ring
+        r = len(ring)
+        if self._recv_expected <= seq < self._recv_expected + r:
+            ring[seq % r] = payload
+        else:
+            if self._recv_spill is None:
+                self._recv_spill = {}
+            self._recv_spill[seq] = payload
+        self._recv_count += 1
+
+    def _recv_pop_expected(self) -> bytes:
+        seq = self._recv_expected
+        ring = self._recv_ring
+        payload = ring[seq % len(ring)]
+        if payload is not None:
+            ring[seq % len(ring)] = None
+        else:
+            payload = self._recv_spill.pop(seq)
+        self._recv_count -= 1
+        return payload
+
+    def _on_data(self, msg: Message) -> None:
+        if self.state in (ConnState.CLOSED, ConnState.LOST):
+            return
+        if self.state == ConnState.CONNECTING:
+            # Data from the server implies our Connect was accepted (the
+            # explicit Ack(id, 0) was lost/delayed): establish implicitly so
+            # the ack below carries the right conn id and delivery proceeds.
+            self.conn_id = msg.conn_id
+            self.state = ConnState.UP
+            self._connect_pending = None
+            self._on_connected(msg.conn_id)
+        seq = msg.seq_num
+        if seq < self._recv_expected or self._recv_has(seq):
+            # Duplicates of ACKED messages are re-acked (exactly-once
+            # delivery comes from receive-side dedup, not ack suppression;
+            # ref: lsp/server_impl.go:462-470). A retransmit of the parked
+            # unacked back-pressure head stays unacked until delivery.
+            _MET_RECV_DUP.inc()
+            if seq != self._recv_unacked_seq:
+                self.outbox.append(wire.encode_ack(self.conn_id, seq))
+            return
+        if seq == self._recv_expected and self.state == ConnState.UP and \
+                not self._deliver_ready():
+            # Back-pressure: park the head unacked; see the __init__ note.
+            # Out-of-order messages are still admitted (and acked) below —
+            # they are bounded by the peer's window, which cannot slide
+            # past this unacked head.
+            self._recv_put(seq, msg.payload or b"")
+            self._recv_unacked_seq = seq
+            return
+        self.outbox.append(wire.encode_ack(self.conn_id, seq))
+        self._recv_put(seq, msg.payload or b"")
+        _MET_RECV_PENDING.observe(self._recv_count)
+        self._drain()
+
+    def _drain(self) -> None:
+        """Deliver the in-order run while the owner's queue has room; the
+        parked back-pressure head is acked here, at delivery time."""
+        while self._recv_has(self._recv_expected) and (
+                self.state != ConnState.UP or self._deliver_ready()):
+            seq = self._recv_expected
+            payload = self._recv_pop_expected()
+            if seq == self._recv_unacked_seq:
+                self._recv_unacked_seq = -1
+                self.outbox.append(wire.encode_ack(self.conn_id, seq))
+            self._recv_expected += 1
+            if self.state == ConnState.UP:
+                self._deliver(payload)
+
+    def resume_delivery(self) -> None:
+        """Owner hook: queue room reappeared (the app read); deliver any
+        messages that stranded when :meth:`_drain` hit the cap — inbound
+        traffic is NOT guaranteed to re-trigger it (an acked out-of-order
+        backlog has no retransmits coming)."""
+        if self.state in (ConnState.UP, ConnState.CLOSING):
+            self._drain()
+
+    def _on_ack(self, msg: Message) -> None:
+        if msg.seq_num == 0:
+            # Heartbeat — or the connect ack while CONNECTING.
+            if self.state == ConnState.CONNECTING:
+                self.conn_id = msg.conn_id
+                self.state = ConnState.UP
+                self._connect_pending = None
+                self._on_connected(msg.conn_id)
+            return
+        seq = msg.seq_num
+        w = self.params.window_size
+        if self._win_count == 0 or not \
+                self._win_base <= seq < self._win_base + w:
+            return
+        pending = self._win_slots[seq % w]
+        if pending is None or pending.seq != seq:
+            return
+        self._win_slots[seq % w] = None
+        self._win_count -= 1
+        if self._win_count and seq == self._win_base:
+            # Advance base to the next live slot (<= W-1 probes; every
+            # live seq is in (base, base+W) at its unique slot).
+            b = seq + 1
+            while self._win_slots[b % w] is None:
+                b += 1
+            self._win_base = b
+        if not pending.retransmitted and pending.sent_at:
+            # Send->ack RTT, Karn-filtered (see _Pending).
+            _MET_RTT.observe(self._clock() - pending.sent_at)
+        self._refill_window()
+        if self.state == ConnState.CLOSING and self.flushed:
+            self._finish(ConnState.CLOSED)
+
+    # ------------------------------------------------------------ epoch tick
+
+    def on_epoch(self) -> bool:
+        """One epoch-timer event. Returns False when the connection is
+        finished (the shell stops the timer)."""
+        _MET_EPOCHS.inc()
+        # Loss detection (ref: lsp/client_impl.go timeRoutine:258-286).
+        if self._got_traffic:
+            self._silent_epochs = 0
+            self._got_traffic = False
+        else:
+            self._silent_epochs += 1
+            if self._silent_epochs >= self.params.epoch_limit:
+                if self.state == ConnState.CONNECTING:
+                    self._fail_connect(ConnectTimeout(
+                        f"no connect ack after {self.params.epoch_limit} epochs"))
+                else:
+                    self._declare_lost()
+                return False
+
+        # Heartbeat, idle-only (VERDICT r4): the reference re-arms its
+        # reminder timer on every inbound message and sends Ack(connID, 0)
+        # only after a receive-silent epoch (ref: lsp/client_impl.go:268-281,
+        # server_impl.go:396-420) — so a BUSY link emits no reminder acks.
+        # On an idle link, peer heartbeats arrive one epoch + latency apart,
+        # so the reference's reminder reliably fires anyway: idleness is
+        # judged on substantive traffic only (see __init__ note).
+        if not self._got_payload_traffic and \
+                self.state in (ConnState.UP, ConnState.CLOSING):
+            self.outbox.append(wire.encode_ack(self.conn_id, 0))
+            _MET_HEARTBEATS.inc()
+        self._got_payload_traffic = False
+
+        # Retransmits: the Connect request and every unacked window
+        # element, in seq order from the ring base (the dict the ring
+        # replaced iterated in insertion == seq order).
+        w = self.params.window_size
+        if self._win_count:
+            base = self._win_base
+            for off in range(w):
+                pending = self._win_slots[(base + off) % w]
+                if pending is not None:
+                    self._retransmit_tick(pending)
+        if self._connect_pending is not None:
+            self._retransmit_tick(self._connect_pending)
+        return True
+
+    def _retransmit_tick(self, pending: _Pending) -> None:
+        if pending.fresh:
+            pending.fresh = False
+        elif pending.epochs_passed >= pending.cur_backoff:
+            self.outbox.append(pending.raw)
+            pending.retransmitted = True
+            # Labeled by the backoff level that TRIGGERED this resend
+            # (0, 1, 2, 4, ... capped): the distribution is the
+            # XXOXOOX retransmission-law shape, observable per process.
+            _M.counter(   # dbmlint: ok[cardinality] bounded:
+                # backoff levels are 0, 1, 2, 4, ... capped at the
+                # max_backoff_interval knob — log2(cap)+2 values.
+                "lsp.retransmits",
+                backoff=str(pending.cur_backoff)).inc()
+            pending.epochs_passed = 0
+            if pending.cur_backoff == 0:
+                pending.cur_backoff = min(1, self.params.max_backoff_interval)
+            else:
+                pending.cur_backoff = min(pending.cur_backoff * 2,
+                                          self.params.max_backoff_interval)
+        else:
+            pending.epochs_passed += 1
+
+    # ----------------------------------------------------------- termination
+
+    def begin_close(self) -> None:
+        """Graceful close: flush window + buffer, then finish (ref: §3.5)."""
+        if self.state in (ConnState.CLOSED, ConnState.LOST):
+            self._on_closed()
+            return
+        if self.state == ConnState.CONNECTING:
+            self._fail_connect(ConnectionClosed("closed during connect"))
+            return
+        self.state = ConnState.CLOSING
+        if self.flushed:
+            self._finish(ConnState.CLOSED)
+
+    def abort(self) -> None:
+        """Immediate teardown with no flush (endpoint shutdown path)."""
+        if self.state not in (ConnState.CLOSED, ConnState.LOST):
+            self._finish(ConnState.CLOSED)
+
+    def _declare_lost(self) -> None:
+        _MET_CONN_LOST.inc()
+        self._finish(ConnState.LOST)
+        self._broken(ConnectionLost(f"conn {self.conn_id}: epoch limit reached"))
+
+    def _fail_connect(self, exc: Exception) -> None:
+        self._finish(ConnState.LOST)
+        self._on_connect_failed(exc)
+
+    def _finish(self, final_state: ConnState) -> None:
+        self.state = final_state
+        if self._win_count:
+            w = self.params.window_size
+            for i in range(w):
+                self._win_slots[i] = None
+            self._win_count = 0
+        self._buffer = None
+        self._recv_unacked_seq = -1
+        self._connect_pending = None
+        self._on_closed()
+
+
+def integrity_check(msg: Message) -> bool:
+    """Validate (and possibly truncate) an inbound message.
+
+    Rules (ref: lsp/client_impl.go integrityCheck:200-213): Connect/Ack are
+    exempt; short payloads are rejected; long payloads are truncated to
+    ``Size`` before the checksum is verified.
+    """
+    if msg.type in (MsgType.CONNECT, MsgType.ACK):
+        return True
+    payload = msg.payload if msg.payload is not None else b""
+    if len(payload) < msg.size:
+        _MET_DROP_LENGTH.inc()
+        return False
+    if len(payload) > msg.size:
+        payload = payload[: msg.size]
+        msg.payload = payload
+    ok = wire.checksum(msg.conn_id, msg.seq_num, msg.size,
+                       payload) == msg.checksum
+    if not ok:
+        _MET_DROP_CHECKSUM.inc()
+    return ok

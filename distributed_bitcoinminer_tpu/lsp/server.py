@@ -13,10 +13,11 @@ import asyncio
 from typing import Optional, Tuple, Union
 
 from .. import lspnet
+from . import wire
 from ._engine import Conn, ConnState, integrity_check
 from ._loop import run_sync
 from .errors import ConnectionClosed, LspError
-from .message import Message, MsgType, new_ack
+from .message import MsgType
 from .params import Params
 
 ReadItem = Tuple[int, Union[bytes, Exception]]
@@ -68,23 +69,32 @@ class AsyncServer:
     # -------------------------------------------------------------- receive
 
     async def _recv_loop(self) -> None:
+        # Burst drain (ISSUE 17): one awaited recv per burst, then
+        # recv_nowait until momentarily dry — a recvmmsg batch is
+        # processed in one synchronous sweep, not one loop round-trip
+        # per datagram.
         while True:
             item = await self._ep.recv()
             if item is None:
                 return
-            raw, addr = item
-            try:
-                msg = Message.from_json(raw)
-            except ValueError:
-                continue
-            if not integrity_check(msg):
-                continue
-            if msg.type == MsgType.CONNECT:
-                self._on_connect(addr)
-                continue
-            conn = self._conns.get(msg.conn_id)
-            if conn is not None:
-                conn.on_message(msg)
+            while item is not None:
+                self._on_datagram(item)
+                item = self._ep.recv_nowait()
+
+    def _on_datagram(self, item: tuple) -> None:
+        raw, addr = item
+        try:
+            msg = wire.decode(raw)
+        except ValueError:
+            return
+        if not integrity_check(msg):
+            return
+        if msg.type == MsgType.CONNECT:
+            self._on_connect(addr)
+            return
+        conn = self._conns.get(msg.conn_id)
+        if conn is not None:
+            conn.on_message(msg)
 
     def _on_connect(self, addr: tuple) -> None:
         if self._closed:
@@ -93,7 +103,7 @@ class AsyncServer:
         if existing is not None:
             # Repeat Connect (our ack was lost): re-ack with the same id
             # (ref: lsp/server_impl.go searchClient dedup, :327-332).
-            self._ep.send(new_ack(existing, 0).to_json(), addr)
+            self._ep.send(wire.encode_ack(existing, 0), addr)
             return
         conn_id = self._next_conn_id
         self._next_conn_id += 1
@@ -109,7 +119,7 @@ class AsyncServer:
         self._conns[conn_id] = conn
         self._addr_map[addr] = conn_id
         self._conn_addr[conn_id] = addr
-        self._ep.send(new_ack(conn_id, 0).to_json(), addr)
+        self._ep.send(wire.encode_ack(conn_id, 0), addr)
 
     def _on_broken(self, conn_id: int, exc: Exception) -> None:
         self._read_queue.put_nowait((conn_id, exc))

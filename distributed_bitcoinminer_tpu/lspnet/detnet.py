@@ -1,14 +1,29 @@
-"""Deterministic in-process transport shim (dbmcheck, ISSUE 8).
+"""Deterministic in-process transport over the REAL LSP core (ISSUE 17).
 
-The real stack — UDP endpoints, the LSP sliding-window engine, its
-epoch timers — is what the conformance and chaos suites exercise. The
-deterministic-schedule explorer (``analysis/schedcheck``) needs the
-OPPOSITE trade: no sockets, no retransmission state, no timers of its
-own, just the scheduler-visible surface of :class:`..lsp.server.
-AsyncServer` and :class:`..lsp.client.AsyncClient` over plain asyncio
-queues — so every message delivery is an event-loop step the explorer's
-picker orders, and the only state machines under test are the CONTROL
-PLANE's (scheduler, QoS, miner pipeline), not the transport's.
+The deterministic-schedule explorer (``analysis/schedcheck``) needs a
+transport with no sockets, no wall-clock timers, and no scheduling of
+its own — every message delivery must be an event-loop step the
+explorer's picker orders. Before the sans-io split this forced a SHIM:
+plain queues impersonating the LSP surface, so the explorer never
+touched the protocol code. Now each conn is a pair of
+:class:`~..lsp.core.ConnCore` state machines — the byte-identical
+engine ``_engine.py`` drives in production — pumped synchronously:
+
+    chan.write(payload)
+      └ client core .write  → wire frame in its outbox
+          └ wire.decode + integrity_check      (the real parse path)
+              └ server core .on_message → deliver → read_queue   + ack
+                  └ wire.decode → client core .on_ack  (window slides)
+
+The whole exchange runs inside the caller's synchronous ``write`` — one
+explorer-visible step per app write, exactly like the old shim — but the
+window law, reorder ring, ack discipline, and integrity check en route
+are the production code, so dbmcheck explores the real protocol.
+Determinism: the in-process link is lossless and ordered, so the pump
+always drains (data → ack → done, no retransmit state left behind); the
+cores get a zero clock (no RTT samples, no syscalls) and their epoch
+timer is simply never driven — no timers means no retransmits, no
+heartbeats, no loss detection, which is the explorer's trade.
 
 Semantics preserved from the real stack (the scheduler depends on each):
 
@@ -30,7 +45,7 @@ Scale notes (ISSUE 11): any number of DetServers can share one loop —
 no module or loop-global state exists; conn ids are per-server (a
 channel is bound to its server, so overlapping ids across servers are
 fine), which is what the replica scenarios rely on. Every per-message
-operation is O(1) per conn (dict lookups, queue puts) — nothing scans
+operation is O(1) per conn (ring slots, queue puts) — nothing scans
 the conn table per delivery or per tick, so a 10k-conn storm costs
 10k× one message, not 10k× the table. The ``writes``/``_read_log``
 capture lists the scenario FIFO checks read are O(messages) MEMORY,
@@ -43,15 +58,23 @@ from __future__ import annotations
 import asyncio
 from typing import Dict, Optional, Tuple, Union
 
+from ..lsp import wire
+from ..lsp.core import ConnCore, integrity_check
 from ..lsp.errors import ConnectionClosed
+from ..lsp.params import Params
 
 __all__ = ["DetServer", "DetChannel"]
 
 ReadItem = Tuple[int, Union[bytes, Exception]]
 
 
+def _zero_clock() -> float:
+    return 0.0
+
+
 class DetChannel:
-    """One peer endpoint (a miner's or client's side of a conn).
+    """One peer endpoint (a miner's or client's side of a conn), backed
+    by its own :class:`ConnCore`.
 
     Duck-types the slice of ``AsyncClient`` the apps consume: async
     ``read()``, sync ``write(payload)``, async ``close()``.
@@ -65,6 +88,14 @@ class DetChannel:
         #: Every payload this endpoint wrote, in order (scenario checks;
         #: empty when the owning server was built ``record=False``).
         self.sent: list = []
+        #: The peer-side protocol state machine. Both cores of a pair
+        #: start UP with the assigned conn id (the Connect handshake is
+        #: the server demux's job in production, not the conn engine's).
+        self.core = ConnCore(
+            server._params, conn_id,
+            deliver=self._inbox.put_nowait,
+            clock=_zero_clock,
+        )
 
     async def read(self) -> bytes:
         if self.closed and self._inbox.empty():
@@ -81,7 +112,8 @@ class DetChannel:
             raise ConnectionClosed(f"conn {self.conn_id} closed")
         if self._server._record:
             self.sent.append(payload)
-        self._server._deliver(self.conn_id, payload)
+        self.core.write(payload)
+        self._server._pump(self.conn_id)
 
     async def close(self) -> None:
         """Peer-initiated close: the server side observes a drop."""
@@ -91,13 +123,15 @@ class DetChannel:
 
     def _kill(self) -> None:
         self.closed = True
+        self.core.abort()
+        self._server._abort_server_core(self.conn_id)
         self._inbox.put_nowait(
             ConnectionClosed(f"conn {self.conn_id} closed"))
 
 
 class DetServer:
     """Deterministic AsyncServer stand-in: same read/write/close_conn
-    surface, backed by per-conn :class:`DetChannel` endpoints.
+    surface, each conn a live :class:`ConnCore` pair (see module doc).
 
     ``record=False`` drops the ``writes``/``_read_log``/``sent``
     capture (O(messages) memory the invariant checks consume) for the
@@ -107,8 +141,10 @@ class DetServer:
     def __init__(self, record: bool = True) -> None:
         self._read_queue: asyncio.Queue = asyncio.Queue()
         self._chans: Dict[int, DetChannel] = {}
+        self._cores: Dict[int, ConnCore] = {}
         self._next_conn_id = 1
         self._record = record
+        self._params = Params()
         #: (conn_id, payload) of every server-side write, in order.
         self.writes: list = []
         #: (conn_id, payload) of every peer write, in DELIVERY order —
@@ -119,10 +155,38 @@ class DetServer:
 
     def connect(self) -> DetChannel:
         """A new peer conn (miner or client); returns its endpoint."""
-        chan = DetChannel(self, self._next_conn_id)
-        self._chans[chan.conn_id] = chan
+        conn_id = self._next_conn_id
         self._next_conn_id += 1
+        chan = DetChannel(self, conn_id)
+        self._chans[conn_id] = chan
+        self._cores[conn_id] = ConnCore(
+            self._params, conn_id,
+            deliver=lambda payload, cid=conn_id: self._deliver(cid, payload),
+            clock=_zero_clock,
+        )
         return chan
+
+    def _pump(self, conn_id: int) -> None:
+        """Exchange wire frames between the conn's two cores until both
+        outboxes drain (lossless link: data → ack → done). Runs the real
+        parse + integrity path on every frame."""
+        chan_core = self._chans[conn_id].core
+        server_core = self._cores[conn_id]
+        progress = True
+        while progress:
+            progress = False
+            for src, dst in ((chan_core, server_core),
+                             (server_core, chan_core)):
+                outbox = src.outbox
+                if not outbox:
+                    continue
+                progress = True
+                frames = outbox[:]
+                outbox.clear()
+                for raw in frames:
+                    msg = wire.decode(raw)
+                    if integrity_check(msg):
+                        dst.on_message(msg)
 
     def _deliver(self, conn_id: int, payload: bytes) -> None:
         if self._record:
@@ -133,6 +197,11 @@ class DetServer:
         if conn_id in self._chans:
             self._read_queue.put_nowait(
                 (conn_id, ConnectionClosed(f"conn {conn_id} dropped")))
+
+    def _abort_server_core(self, conn_id: int) -> None:
+        core = self._cores.get(conn_id)
+        if core is not None:
+            core.abort()
 
     # ------------------------------------------- AsyncServer surface
 
@@ -155,7 +224,8 @@ class DetServer:
                 f"conn {conn_id} does not exist or is closed")
         if self._record:
             self.writes.append((conn_id, payload))
-        chan._inbox.put_nowait(payload)
+        self._cores[conn_id].write(payload)
+        self._pump(conn_id)
 
     def close_conn(self, conn_id: int) -> None:
         chan = self._chans.get(conn_id)

@@ -13,7 +13,15 @@ from __future__ import annotations
 import logging
 import random
 
+from ..utils.metrics import registry as _registry
+
 log = logging.getLogger("lspnet")
+
+# Hoisted metric handle (ISSUE 17 audit, same fix sniff got in PR 3):
+# partition_conn sits on chaos-episode control paths that can fire per
+# scheduled event; the name->handle lookup happens once at import, not
+# per call.
+_MET_PARTITIONS_OPENED = _registry().counter("net.partitions_opened")
 
 DELAY_MILLIS = 500  # fixed injected delay, matches ref lspnet/conn.go:113
 
@@ -115,8 +123,7 @@ def partition_conn(conn_id: int, *, inbound: bool = True,
     # opens, so re-applying an existing partition doesn't make one long
     # partition read as flapping in a snapshot.
     if opened:
-        from ..utils.metrics import registry
-        registry().counter("net.partitions_opened").inc()
+        _MET_PARTITIONS_OPENED.inc()
 
 
 def heal_conn(conn_id: int, *, inbound: bool = True,
@@ -147,4 +154,10 @@ def enable_debug_logs(enable: bool) -> None:
 
 
 def sometimes(percentage: int) -> bool:
+    # Early out at 0 (the steady-state value of every knob): the datapath
+    # calls this three times per packet, and an RNG draw that can only
+    # answer False is pure per-packet overhead (ISSUE 17). Identical
+    # outcome distribution for every percentage.
+    if percentage <= 0:
+        return False
     return random.randrange(100) < percentage

@@ -498,6 +498,48 @@ Environment variables:
   colluding duplicates, sentinel-without-scan and selectively-correct
   liars) under the exactly-once oracle-exact invariant pack, with the
   same >=500 distinct-schedule floor as the other dbmcheck legs.
+- ``DBM_WIRE_FAST`` (default 1): the allocation-free wire codec
+  (lsp/wire.py, ISSUE 17). 1 = canonical LSP frames are serialized by
+  byte-template substitution and parsed by a positional scanner —
+  byte-for-byte identical output to ``Message.to_json`` and identical
+  accept/reject behavior to ``Message.from_json`` (fuzz-pinned in
+  tests/test_transport_fast.py; non-canonical frames fall back to the
+  stock parser). 0 = stock json/dataclass codec bit-for-bit (pinned
+  in the knob-off matrix leg).
+- ``DBM_MMSG`` (default 1): batched datagram syscalls (lsp/_mmsg.py +
+  lspnet/net.py ``MmsgEndpoint``, ISSUE 17). 1 = on Linux/IPv4 with
+  ``recvmmsg``/``sendmmsg`` present, every readable event drains up
+  to a batch of datagrams in ONE syscall and outbound sends queue and
+  flush as one ``sendmmsg`` per event-loop turn; wire bytes, fault
+  pipeline, and delivery order are unchanged. Falls back to the stock
+  one-syscall-per-packet endpoint when unavailable (non-Linux, IPv6,
+  missing libc symbols). 0 = stock endpoint bit-for-bit (knob-off
+  matrix leg pin).
+- ``DBM_MMSG_BATCH`` (default 32): max datagrams per batched syscall
+  in each direction — the recv buffer array (64 KiB per slot) is
+  preallocated at this size per endpoint.
+- ``DBM_BENCH_TRANSPORT`` (0 disables): the bench's
+  ``detail.transport`` probe (bench.py via apps/transportbench.py;
+  CPU-only): an echo-storm msgs/s A/B of the fast datapath
+  (``DBM_MMSG=1 DBM_WIRE_FAST=1``) vs stock (both 0) in subprocess
+  legs, interleaved order-swapped per round and median-aggregated
+  like ``detail.pipeline``, recording syscalls/msg, bytes/msg, p99
+  ack RTT, and per-conn RSS at 10k/50k/100k sans-io cores.
+- ``DBM_BENCH_TRANSPORT_CONNS`` (default 32) /
+  ``DBM_BENCH_TRANSPORT_INFLIGHT`` (default 8) /
+  ``DBM_BENCH_TRANSPORT_PAYLOAD`` (default 128) /
+  ``DBM_BENCH_TRANSPORT_SECS`` (default 1.0) /
+  ``DBM_BENCH_TRANSPORT_WARMUP_S`` (default 0.3) /
+  ``DBM_BENCH_TRANSPORT_ROUNDS`` (default 3): echo-storm geometry —
+  client count, per-client closed-loop inflight, payload bytes,
+  measured window and warmup seconds per leg, and interleaved round
+  count.
+- ``DBM_TIER1_TRANSPORT`` (0 disables): scripts/tier1.sh's
+  transport-regression leg — ``bench.py --transport-only`` diffed
+  against ``scripts/transport_floor.json`` by scripts/benchdiff.py at
+  ``--threshold 0.3``: echo-storm msgs/s may not fall below the floor
+  (set ~30-50% under measured medians, outside box noise) and the
+  fast-vs-stock speedup may not collapse toward 1.0.
 """
 
 from __future__ import annotations

@@ -202,6 +202,29 @@ if [ "${DBM_TIER1_PROCS:-1}" != "0" ]; then
     echo "PROCS_LEG_RC=$procs_rc"
 fi
 
+# Transport-regression leg (ISSUE 17): the echo-storm datapath probe
+# (bench.py --transport-only — sockets only, no JAX import) diffed
+# against the checked-in floor artifact with benchdiff. Gates two
+# leaves: fast-datapath msgs/s (a collapse of the batched/zero-alloc
+# path) and the fast-vs-stock speedup (near 1.0 = the DBM_MMSG /
+# DBM_WIRE_FAST knobs silently stopped mattering). Floors sit far
+# under the measured medians so box noise passes; a real datapath
+# regression does not. DBM_TIER1_TRANSPORT=0 skips.
+transport_rc=0
+if [ "${DBM_TIER1_TRANSPORT:-1}" != "0" ]; then
+    rm -f /tmp/_t1_transport.json
+    timeout -k 5 180 python bench.py --transport-only \
+        > /tmp/_t1_transport.json
+    transport_rc=$?
+    if [ "$transport_rc" -eq 0 ]; then
+        timeout -k 5 60 python scripts/benchdiff.py \
+            scripts/transport_floor.json /tmp/_t1_transport.json \
+            --threshold 0.3
+        transport_rc=$?
+    fi
+    echo "TRANSPORT_LEG_RC=$transport_rc"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
@@ -252,17 +275,24 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # stock merge (no recompute, no trust bookkeeping, no audit state)
     # with test_verify.py — whose parity pin asserts byte-identical
     # write streams verify-off vs claim-checks-on — in the module list.
+    # ISSUE 17 additions: DBM_MMSG=0 pins the stock asyncio datagram
+    # transport (one syscall per packet) and DBM_WIRE_FAST=0 pins the
+    # stock json/base64 codec (Message.to_json/from_json) — together
+    # the bit-for-bit pre-ISSUE-17 wire path — with test_wire.py and
+    # test_transport_fast.py (whose parity pins assert byte-identical
+    # frames fast-vs-stock) in the module list.
     timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
         DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 DBM_MESH=0 \
-        DBM_CAPTURE=0 DBM_VERIFY=0 \
+        DBM_CAPTURE=0 DBM_VERIFY=0 DBM_MMSG=0 DBM_WIRE_FAST=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
         tests/test_apps.py tests/test_qos.py tests/test_batch.py \
         tests/test_trace.py tests/test_plane_split.py \
         tests/test_adapt.py tests/test_capture.py tests/test_verify.py \
+        tests/test_wire.py tests/test_transport_fast.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}
@@ -277,4 +307,5 @@ fi
 [ "$mesh_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$mesh_rc
 [ "$byz_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$byz_rc
 [ "$procs_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$procs_rc
+[ "$transport_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$transport_rc
 exit $rc

@@ -1,0 +1,422 @@
+"""Transport fast-path conformance (ISSUE 17).
+
+Three planes, each pinned against the STOCK codepath it replaces:
+
+- the wire codec (``lsp/wire.py``): fuzzed round-trip equality against
+  ``Message.to_json``/``from_json`` — byte-for-byte frames, identical
+  accept/reject language on corrupt and truncated input;
+- the batched-syscall endpoint (``lsp/_mmsg.py`` + ``lspnet/net.py``
+  ``MmsgEndpoint``): burst send/recv through real sockets, knob gating,
+  burst drain via ``recv_nowait``, and the syscall/datagram counter
+  economics;
+- the hoisted metric handles (``lspnet/faults.py``/``net.py``) and the
+  ``hotpath-alloc`` dbmlint analyzer that keeps the marked functions
+  allocation-lean.
+
+The tier-1 knob-off matrix leg re-runs this module with ``DBM_MMSG=0
+DBM_WIRE_FAST=0``: every parity assertion then exercises stock-vs-stock
+(trivially equal) while the LIVE traffic tests cover the stock
+transport — both datapaths stay green both ways.
+"""
+
+import asyncio
+import base64
+import json
+import random
+
+import pytest
+
+from distributed_bitcoinminer_tpu import lspnet
+from distributed_bitcoinminer_tpu.analysis.core import run_source
+from distributed_bitcoinminer_tpu.lsp import _mmsg, wire
+from distributed_bitcoinminer_tpu.lsp.checksum import make_checksum
+from distributed_bitcoinminer_tpu.lsp.message import (Message, MsgType,
+                                                      new_ack, new_connect,
+                                                      new_data)
+from distributed_bitcoinminer_tpu.lspnet import faults
+from distributed_bitcoinminer_tpu.lspnet.net import (MmsgEndpoint,
+                                                     UDPEndpoint)
+from distributed_bitcoinminer_tpu.utils.metrics import registry
+
+_LSP_REL = "distributed_bitcoinminer_tpu/lsp/_fixture.py"
+
+
+def _random_message(rng):
+    kind = rng.randrange(3)
+    if kind == 0:
+        return new_connect()
+    conn_id = rng.choice([0, 1, 7, 65535, 2 ** 31 - 1])
+    seq = rng.choice([0, 1, 255, 10 ** 6])
+    if kind == 2:
+        return new_ack(conn_id, seq)
+    payload = bytes(rng.randrange(256)
+                    for _ in range(rng.choice([0, 1, 2, 3, 16, 127, 1400])))
+    return new_data(conn_id, seq, len(payload), payload,
+                    make_checksum(conn_id, seq, len(payload), payload))
+
+
+class TestWireFuzzConformance:
+    """Satellite 1: random valid Messages through the fast serializer and
+    parser must be indistinguishable from the stock codec."""
+
+    def test_encode_matches_to_json_bytes(self):
+        rng = random.Random(0x17)
+        for _ in range(500):
+            msg = _random_message(rng)
+            assert wire.encode(msg) == msg.to_json()
+
+    def test_hot_encoders_match_stock_constructors(self):
+        rng = random.Random(0x18)
+        for _ in range(200):
+            payload = bytes(rng.randrange(256)
+                            for _ in range(rng.randrange(64)))
+            cid, seq = rng.randrange(10 ** 6), rng.randrange(10 ** 6)
+            ck = make_checksum(cid, seq, len(payload), payload)
+            assert wire.encode_data(cid, seq, len(payload), ck, payload) \
+                == new_data(cid, seq, len(payload), payload, ck).to_json()
+            assert wire.encode_ack(cid, seq) == new_ack(cid, seq).to_json()
+        assert wire.encode_connect() == new_connect().to_json()
+
+    def test_decode_round_trip_equality(self):
+        rng = random.Random(0x19)
+        for _ in range(500):
+            msg = _random_message(rng)
+            raw = msg.to_json()
+            got = wire.decode(raw)
+            ref = Message.from_json(raw)
+            assert (got.type, got.conn_id, got.seq_num, got.size,
+                    got.checksum, got.payload) == \
+                   (ref.type, ref.conn_id, ref.seq_num, ref.size,
+                    ref.checksum, ref.payload)
+
+    def test_checksum_matches_stock(self):
+        rng = random.Random(0x1A)
+        cases = [b"", b"\x00", b"\x00\x00", b"\xff" * 64, b"ab"]
+        cases += [bytes(rng.randrange(256) for _ in range(rng.randrange(200)))
+                  for _ in range(300)]
+        for payload in cases:
+            cid, seq = rng.randrange(2 ** 16), rng.randrange(2 ** 16)
+            assert wire.checksum(cid, seq, len(payload), payload) == \
+                make_checksum(cid, seq, len(payload), payload)
+
+    def test_truncated_frames_drop_exactly_like_stock(self):
+        rng = random.Random(0x1B)
+        for _ in range(60):
+            raw = _random_message(rng).to_json()
+            for cut in range(0, len(raw)):
+                broken = raw[:cut]
+                try:
+                    ref = Message.from_json(broken)
+                except ValueError:
+                    with pytest.raises(ValueError):
+                        wire.decode(broken)
+                else:  # pragma: no cover — no truncation parses today
+                    got = wire.decode(broken)
+                    assert got.type == ref.type
+
+    def test_corrupt_frames_drop_exactly_like_stock(self):
+        rng = random.Random(0x1C)
+        for _ in range(200):
+            raw = bytearray(_random_message(rng).to_json())
+            pos = rng.randrange(len(raw))
+            raw[pos] = rng.randrange(256)
+            broken = bytes(raw)
+            try:
+                ref = Message.from_json(broken)
+            except ValueError:
+                with pytest.raises(ValueError):
+                    wire.decode(broken)
+            else:
+                got = wire.decode(broken)
+                assert (got.type, got.conn_id, got.seq_num, got.size,
+                        got.checksum, got.payload) == \
+                       (ref.type, ref.conn_id, ref.seq_num, ref.size,
+                        ref.checksum, ref.payload)
+
+    def test_invalid_base64_alphabet_rejected_like_stock(self):
+        msg = new_data(1, 2, 4, b"abcd", make_checksum(1, 2, 4, b"abcd"))
+        raw = msg.to_json()
+        bad = raw.replace(base64.b64encode(b"abcd"), b"a*cd=!")
+        with pytest.raises(ValueError):
+            Message.from_json(bad)
+        with pytest.raises(ValueError):
+            wire.decode(bad)
+
+    def test_non_canonical_layout_falls_back(self):
+        # Reordered keys and whitespace are valid stock JSON; the scanner
+        # must fall back, not reject.
+        obj = {"ConnID": 3, "Type": 2, "SeqNum": 9, "Size": 0,
+               "Checksum": 0, "Payload": None}
+        raw = json.dumps(obj).encode()
+        got = wire.decode(raw)
+        assert (got.type, got.conn_id, got.seq_num) == (MsgType.ACK, 3, 9)
+
+    def test_knob_off_routes_to_stock(self, monkeypatch):
+        monkeypatch.setenv("DBM_WIRE_FAST", "0")
+        wire.refresh()
+        try:
+            assert not wire.fast_enabled()
+            msg = new_data(1, 1, 2, b"ok", make_checksum(1, 1, 2, b"ok"))
+            assert wire.encode(msg) == msg.to_json()
+            assert wire.checksum(1, 1, 2, b"ok") == \
+                make_checksum(1, 1, 2, b"ok")
+        finally:
+            monkeypatch.delenv("DBM_WIRE_FAST")
+            wire.refresh()
+
+
+@pytest.mark.skipif(not _mmsg.available(),
+                    reason="recvmmsg/sendmmsg unavailable")
+class TestMmsgSocket:
+    """The raw syscall wrapper: one syscall per burst, both directions."""
+
+    def _socket_pair(self):
+        import socket
+        a = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        b = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        a.bind(("127.0.0.1", 0))
+        b.bind(("127.0.0.1", 0))
+        a.setblocking(False)
+        b.setblocking(False)
+        return a, b
+
+    def test_burst_round_trip_with_addrs(self):
+        a, b = self._socket_pair()
+        try:
+            ma = _mmsg.MmsgSocket(a.fileno(), 8)
+            mb = _mmsg.MmsgSocket(b.fileno(), 8)
+            addr_b = b.getsockname()
+            frames = [b"frame-%d" % i for i in range(5)]
+            sent = ma.send_burst([(f, addr_b) for f in frames])
+            assert sent == 5
+            import time
+            deadline = time.monotonic() + 2
+            got = []
+            while len(got) < 5 and time.monotonic() < deadline:
+                got.extend(mb.recv_burst())
+            assert sorted(data for data, _ in got) == sorted(frames)
+            # Every datagram came from a's bound address, via the cache.
+            addrs = {addr for _, addr in got}
+            assert addrs == {a.getsockname()}
+        finally:
+            a.close()
+            b.close()
+
+    def test_connected_socket_addr_none(self):
+        a, b = self._socket_pair()
+        try:
+            a.connect(b.getsockname())
+            ma = _mmsg.MmsgSocket(a.fileno(), 4)
+            mb = _mmsg.MmsgSocket(b.fileno(), 4)
+            assert ma.send_burst([(b"hello", None)]) == 1
+            import time
+            deadline = time.monotonic() + 2
+            got = []
+            while not got and time.monotonic() < deadline:
+                got = mb.recv_burst()
+            assert got[0][0] == b"hello"
+        finally:
+            a.close()
+            b.close()
+
+    def test_empty_socket_returns_empty(self):
+        a, _b = self._socket_pair()
+        try:
+            ma = _mmsg.MmsgSocket(a.fileno(), 4)
+            assert ma.recv_burst() == []
+        finally:
+            a.close()
+            _b.close()
+
+    def test_send_burst_caps_at_batch(self):
+        a, b = self._socket_pair()
+        try:
+            ma = _mmsg.MmsgSocket(a.fileno(), 3)
+            addr = b.getsockname()
+            sent = ma.send_burst([(b"x", addr)] * 7)
+            assert sent == 3
+        finally:
+            a.close()
+            b.close()
+
+
+class TestEndpointSelection:
+    """Knob gating and graceful fallback of the batched endpoint."""
+
+    def test_default_endpoint_kind_matches_knob(self, monkeypatch):
+        async def scenario(expect_mmsg):
+            server = await lspnet.listen_udp()
+            client = await lspnet.dial_udp("127.0.0.1", server.sockname[1])
+            try:
+                for ep in (server, client):
+                    assert isinstance(ep, MmsgEndpoint) == expect_mmsg
+                    assert isinstance(ep, UDPEndpoint)
+            finally:
+                server.close()
+                client.close()
+
+        import os
+        knob_on = os.environ.get("DBM_MMSG", "1") != "0"
+        if _mmsg.available() and knob_on:
+            asyncio.run(scenario(True))
+        monkeypatch.setenv("DBM_MMSG", "0")
+        asyncio.run(scenario(False))
+
+    def test_live_traffic_and_counters(self):
+        """Counter-equality pin (ISSUE 17 satellite): the per-direction
+        syscall/datagram/byte counters move together, and the stock path
+        is truthfully 1:1 while the mmsg path never exceeds it."""
+        def snap():
+            c = registry().snapshot()["counters"]
+            return {k: c.get(k, 0) for k in (
+                "net.syscalls{dir=send}", "net.datagrams{dir=send}",
+                "net.bytes{dir=send}", "net.datagrams{dir=recv}",
+                "net.bytes{dir=recv}")}
+
+        async def scenario():
+            server = await lspnet.listen_udp()
+            client = await lspnet.dial_udp("127.0.0.1", server.sockname[1])
+            before = snap()
+            n, frame = 10, b"y" * 33
+            for _ in range(n):
+                client.send(frame)
+            got = 0
+            while got < n:
+                item = await asyncio.wait_for(server.recv(), 2)
+                assert item is not None
+                got += 1
+                item = server.recv_nowait()
+                while item is not None:
+                    got += 1
+                    item = server.recv_nowait()
+            await asyncio.sleep(0.05)   # let any queued flush run
+            after = snap()
+            server.close()
+            client.close()
+            return before, after
+
+        before, after = asyncio.run(scenario())
+        d = {k: after[k] - before[k] for k in before}
+        assert d["net.datagrams{dir=send}"] >= 10
+        assert d["net.datagrams{dir=recv}"] >= 10
+        assert d["net.bytes{dir=send}"] >= 10 * 33
+        assert d["net.bytes{dir=recv}"] >= 10 * 33
+        # Syscalls never exceed datagrams (stock is exactly 1:1; the
+        # batched path amortizes below it).
+        assert 0 < d["net.syscalls{dir=send}"] <= d["net.datagrams{dir=send}"]
+
+    def test_recv_nowait_preserves_close_sentinel(self):
+        async def scenario():
+            server = await lspnet.listen_udp()
+            server.close()
+            # recv_nowait must not eat the sentinel...
+            assert server.recv_nowait() is None
+            # ...so the awaited recv still observes the close.
+            assert await asyncio.wait_for(server.recv(), 2) is None
+            return True
+
+        assert asyncio.run(scenario())
+
+
+class TestHoistedFaultHandles:
+    """Satellite 4: partition episodes count through the module-scope
+    handle — identical counter, no per-call registry lookup."""
+
+    def test_partition_episode_counter_equality(self):
+        handle = registry().counter("net.partitions_opened")
+        assert faults._MET_PARTITIONS_OPENED is handle
+        faults.heal_all_partitions()
+        base = handle.value
+        try:
+            faults.partition_conn(90001)
+            assert handle.value == base + 1
+            # Re-applying an open partition is NOT a new episode.
+            faults.partition_conn(90001)
+            assert handle.value == base + 1
+            faults.heal_conn(90001)
+            faults.partition_conn(90001, inbound=True, outbound=False)
+            assert handle.value == base + 2
+        finally:
+            faults.heal_all_partitions()
+
+
+class TestHotpathAllocAnalyzer:
+    """Satellite 2: the dbmlint analyzer that keeps marked functions
+    allocation-lean."""
+
+    def _findings(self, src):
+        return run_source("hotpath-alloc", src, rel=_LSP_REL)
+
+    def test_json_dumps_in_marked_function_flagged(self):
+        src = ("import json\n"
+               "# dbmlint: hotpath\n"
+               "def enc(x):\n"
+               "    return json.dumps(x)\n")
+        found = self._findings(src)
+        assert len(found) == 1 and "json.dumps" in found[0].message
+
+    def test_dict_and_list_literals_flagged_once_per_kind(self):
+        src = ("# dbmlint: hotpath\n"
+               "def enc(x):\n"
+               "    a = {'k': x}\n"
+               "    b = {'j': x}\n"
+               "    c = [x, x]\n"
+               "    return a, b, c\n")
+        codes = sorted(f.key.rsplit(":", 1)[1] for f in self._findings(src))
+        assert codes == ["dict-literal", "list-literal"]
+
+    def test_base64_call_flagged(self):
+        src = ("import base64\n"
+               "# dbmlint: hotpath\n"
+               "def enc(x):\n"
+               "    return base64.b64encode(x)\n")
+        found = self._findings(src)
+        assert len(found) == 1 and "binascii" in found[0].message
+
+    def test_unmarked_function_silent(self):
+        src = ("import json\n"
+               "def enc(x):\n"
+               "    return json.dumps({'k': x})\n")
+        assert self._findings(src) == []
+
+    def test_out_of_scope_file_silent(self):
+        src = ("import json\n"
+               "# dbmlint: hotpath\n"
+               "def enc(x):\n"
+               "    return json.dumps(x)\n")
+        rel = "distributed_bitcoinminer_tpu/apps/_fixture.py"
+        assert run_source("hotpath-alloc", src, rel=rel) == []
+
+    def test_marker_on_def_line_and_above_decorator(self):
+        src = ("def deco(f):\n"
+               "    return f\n"
+               "# dbmlint: hotpath\n"
+               "@deco\n"
+               "def enc(x):\n"
+               "    return [x]\n"
+               "def enc2(x):  # dbmlint: hotpath\n"
+               "    return [x]\n")
+        found = self._findings(src)
+        assert sorted(f.key.split(":")[-2] for f in found) == ["enc", "enc2"]
+
+    def test_suppression_comment_honored(self):
+        src = ("# dbmlint: hotpath\n"
+               "def enc(x):\n"
+               "    return [x]  # dbmlint: ok[hotpath-alloc] cold branch\n")
+        assert self._findings(src) == []
+
+    def test_nested_def_inside_marked_function_not_exempt(self):
+        src = ("# dbmlint: hotpath\n"
+               "def enc(x):\n"
+               "    def inner():\n"
+               "        return {'k': x}\n"
+               "    return inner\n")
+        found = self._findings(src)
+        assert len(found) == 1 and "dict" in found[0].message
+
+    def test_real_wire_module_is_clean(self):
+        import distributed_bitcoinminer_tpu.lsp.wire as wire_mod
+        with open(wire_mod.__file__, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = "distributed_bitcoinminer_tpu/lsp/wire.py"
+        assert run_source("hotpath-alloc", src, rel=rel) == []
