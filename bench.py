@@ -843,6 +843,75 @@ def _load_probe() -> dict:
     }
 
 
+def _rollup_probe() -> dict:
+    """Rollup-plane overhead A/B (ISSUE 18, ``detail.rollup``): the
+    multi-process loadharness leg with the cluster rollup plane ON vs
+    OFF (``DBM_ROLLUP`` pinned in the children's env), interleaved
+    order-swapped and median-aggregated — publish is one registry
+    snapshot + one small atomic file write per beat per process, and
+    the acceptance bar is that the A/B stays within storm noise. Plus
+    the micro costs: median ``publish()`` and ``aggregate()`` wall time
+    over a synthetic 4-source state directory, so the per-beat price is
+    measured directly rather than inferred from the storm.
+
+    ``DBM_BENCH_ROLLUP=0`` skips; ``DBM_BENCH_ROLLUP_ROUNDS`` (default
+    2) sets the A/B rounds.
+    """
+    import shutil
+    import tempfile
+    from statistics import median
+
+    from distributed_bitcoinminer_tpu.apps.loadharness import (
+        run_load_procs)
+    from distributed_bitcoinminer_tpu.apps.rollup import (
+        RollupPublisher, aggregate)
+    from distributed_bitcoinminer_tpu.utils.metrics import Registry
+
+    rounds = max(1, _int_env("DBM_BENCH_ROLLUP_ROUNDS", 2))
+    keys = ("makespan_s", "admitted_per_s", "p99_s",
+            "cpu_s_per_request", "shed_rate")
+    legs: dict = {"on": [], "off": []}
+    for rnd in range(rounds):
+        order = ("on", "off") if rnd % 2 == 0 else ("off", "on")
+        for name in order:
+            leg = run_load_procs(tenants=150, replicas=2, miners=2,
+                                 rollup=(name == "on"), timeout_s=120.0)
+            legs[name].append(leg)
+    out = {"rounds": rounds, "tenants": 150}
+    for name in ("on", "off"):
+        out[name] = {k: (round(median(v), 6) if v else None)
+                     for k in keys
+                     for v in [[leg[k] for leg in legs[name]
+                                if leg.get(k) is not None]]}
+    if out["on"]["makespan_s"] and out["off"]["makespan_s"]:
+        out["makespan_ratio"] = round(
+            out["on"]["makespan_s"] / out["off"]["makespan_s"], 4)
+    # Micro: direct per-call costs on a synthetic 4-source directory.
+    d = tempfile.mkdtemp(prefix="dbm_bench_rollup_")
+    try:
+        pubs = []
+        for rid in range(4):
+            reg = Registry()
+            for i in range(40):
+                reg.counter(f"sched.c{i}").inc(i)
+            reg.histogram("sched.queue_wait_s").observe(0.01)
+            pubs.append(RollupPublisher(d, "replica", rid, f"i{rid}",
+                                        registry=reg))
+        pub_times, agg_times = [], []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            pubs[0].publish()
+            pub_times.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            aggregate(d)
+            agg_times.append(time.perf_counter() - t0)
+        out["publish_ms"] = round(median(pub_times) * 1e3, 4)
+        out["aggregate_ms"] = round(median(agg_times) * 1e3, 4)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def _replay_probe() -> dict:
     """Workload capture→replay fidelity (ISSUE 15, ``detail.replay``):
     capture a synthesized uniform storm on the detnet harness
@@ -1488,6 +1557,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             transport_detail = {"transport": {"error": repr(exc)[:300]}}
 
+    # Cluster rollup plane overhead A/B (ISSUE 18): --procs storm with
+    # DBM_ROLLUP pinned on vs off + direct publish/aggregate micro
+    # costs — files and sockets only, no JAX. DBM_BENCH_ROLLUP=0 skips.
+    rollup_detail = {}
+    if _str_env("DBM_BENCH_ROLLUP", "1") != "0":
+        try:
+            rollup_detail = {"rollup": _rollup_probe()}
+        except Exception as exc:  # noqa: BLE001
+            rollup_detail = {"rollup": {"error": repr(exc)[:300]}}
+
     from distributed_bitcoinminer_tpu.ops.sha256_pallas import peel_enabled
     from distributed_bitcoinminer_tpu.utils.metrics import registry
 
@@ -1524,6 +1603,7 @@ def main() -> int:
         **replay_detail,
         **mesh_detail,
         **transport_detail,
+        **rollup_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and
         # JSON-native, so BENCH_r* diffs of kernel/dispatch counters
         # (midstate cache behavior, until-tier degradations) stay

@@ -30,6 +30,15 @@ the exact same churn failure mode, so ``.track("name", miner=conn_id)``
 sites obey the identical rule — a dynamic label needs a same-module
 ``.retire("name", ...)`` retirement path (miner drop / tenant GC) or a
 suppression with the boundedness argument.
+
+Rollup-source extension (ISSUE 18): the cluster rollup plane keeps
+per-source series under a ``proc`` label (one value per publishing
+process — unbounded under miner-agent churn, since agents key by pid).
+``.proc_series("family", proc=key)`` sites (``apps/rollup.SourceSet``)
+obey the same rule with their own retirement method: the module must
+also ``.retire_proc("family", ...)`` where a source dies (fence,
+long-stale expiry), so churned publishers cycle bound slots instead of
+exhausting them.
 """
 
 from __future__ import annotations
@@ -42,15 +51,17 @@ from .core import Finding, SourceFile, scope_map, str_const
 NAME = "cardinality"
 
 SCOPE_PREFIX = "distributed_bitcoinminer_tpu/"
-REGISTRY_METHODS = {"counter", "gauge", "histogram", "ewma", "track"}
+REGISTRY_METHODS = {"counter", "gauge", "histogram", "ewma", "track",
+                    "proc_series"}
 SHAPE_KWARGS = {"tau_s", "buckets"}
 #: Which retirement method covers which registration method: metric
 #: series retire via ``Registry.remove``, export tracks (ISSUE 10) via
-#: ``TrackSet.retire`` — a ``.remove`` cannot vouch for a ``.track``
-#: site or vice versa.
+#: ``TrackSet.retire``, rollup per-source series (ISSUE 18) via
+#: ``SourceSet.retire_proc`` — a ``.remove`` cannot vouch for a
+#: ``.track`` or ``.proc_series`` site or vice versa.
 RETIREMENT_FOR = {"counter": "remove", "gauge": "remove",
                   "histogram": "remove", "ewma": "remove",
-                  "track": "retire"}
+                  "track": "retire", "proc_series": "retire_proc"}
 
 
 def _removed_names(tree: ast.AST) -> dict:

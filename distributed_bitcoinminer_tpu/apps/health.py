@@ -50,7 +50,58 @@ from __future__ import annotations
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
-__all__ = ["Beat", "BeatMonitor", "Membership"]
+__all__ = ["Beat", "BeatMonitor", "Membership", "SeqFreshness"]
+
+
+class SeqFreshness:
+    """Generic seq-advance freshness tracking (the BeatMonitor core).
+
+    Tracks, per arbitrary hashable key, the last ``(gen, seq)`` pair seen
+    and WHEN it advanced: an observation advances iff the key is new, the
+    generation changed (a restart is always fresh), or the seq grew
+    within the same generation. ``stale(now)`` lists keys whose seq has
+    been frozen past ``window_s`` of the observer's clock — re-reading an
+    unchanged blob never refreshes the deadline, so a SIGSTOPped writer's
+    lingering file is a death, not a heartbeat. Extracted from
+    :class:`BeatMonitor` (which delegates here) so the metric-rollup
+    plane (ISSUE 18) applies the identical staleness rule to published
+    snapshot blobs, keyed by ``(role, rid)`` instead of rid.
+    """
+
+    def __init__(self, window_s: float):
+        self.window_s = max(1e-3, window_s)
+        self._last: Dict[object, tuple] = {}      # key -> (gen, seq)
+        self._fresh_at: Dict[object, float] = {}  # key -> when it advanced
+
+    def observe(self, key, gen, seq, now: float) -> bool:
+        """Record one observation; True when it ADVANCED the key."""
+        prev = self._last.get(key)
+        advanced = (prev is None or gen != prev[0] or seq > prev[1])
+        if advanced:
+            self._last[key] = (gen, seq)
+            self._fresh_at[key] = now
+        return advanced
+
+    def fresh_at(self, key) -> Optional[float]:
+        """When the key last advanced (observer clock), or None."""
+        return self._fresh_at.get(key)
+
+    def age_s(self, key, now: float) -> Optional[float]:
+        """Seconds since the key last advanced, or None when unseen."""
+        at = self._fresh_at.get(key)
+        return None if at is None else max(0.0, now - at)
+
+    def stale(self, now: float) -> List[object]:
+        """Keys whose seq has been frozen past the window."""
+        return [k for k, at in self._fresh_at.items()
+                if now - at > self.window_s]
+
+    def keys(self) -> List[object]:
+        return list(self._last.keys())
+
+    def forget(self, key) -> None:
+        self._last.pop(key, None)
+        self._fresh_at.pop(key, None)
 
 
 @dataclass
@@ -90,23 +141,21 @@ class BeatMonitor:
         self.beat_s = max(1e-3, beat_s)
         self.miss_k = max(1, miss_k)
         self._last: Dict[int, Beat] = {}      # rid -> newest beat
-        self._fresh_at: Dict[int, float] = {} # rid -> when seq advanced
+        self._fresh = SeqFreshness(self.beat_s * self.miss_k)
 
     @property
     def window_s(self) -> float:
         """Seconds of seq silence that mean death."""
-        return self.beat_s * self.miss_k
+        return self._fresh.window_s
 
     def observe(self, beat: Beat, now: float) -> bool:
         """Record one beat; True when it ADVANCED the replica's seq
         (same-or-older seqs, e.g. a re-read of a stale file, do not
         refresh the death deadline)."""
-        prev = self._last.get(beat.rid)
-        advanced = (prev is None or beat.incarnation != prev.incarnation
-                    or beat.seq > prev.seq)
+        advanced = self._fresh.observe(beat.rid, beat.incarnation,
+                                       beat.seq, now)
         if advanced:
             self._last[beat.rid] = beat
-            self._fresh_at[beat.rid] = now
         return advanced
 
     def last(self, rid: int) -> Optional[Beat]:
@@ -117,14 +166,13 @@ class BeatMonitor:
 
     def dead(self, now: float) -> List[int]:
         """Replica ids whose seq has been frozen past the window."""
-        return [rid for rid, at in self._fresh_at.items()
-                if now - at > self.window_s]
+        return self._fresh.stale(now)
 
     def forget(self, rid: int) -> None:
         """Stop watching a declared-dead replica (it re-enters the
         watch when a fresh incarnation beats)."""
         self._last.pop(rid, None)
-        self._fresh_at.pop(rid, None)
+        self._fresh.forget(rid)
 
 
 class Membership:

@@ -962,6 +962,7 @@ async def drive_ring_tenants(statedir: str, start: int, count: int,
 def run_load_procs(tenants: int = 200, replicas: int = 2,
                    miners: int = 4, *, requests_per_tenant: int = 1,
                    req_nonces: int = 256, drivers: int = 1,
+                   rollup: Optional[bool] = None,
                    timeout_s: float = 180.0) -> dict:
     """Multi-process topology leg (ISSUE 12, ``loadharness --procs``):
     the REAL process topology — router + one OS process per replica on
@@ -991,6 +992,10 @@ def run_load_procs(tenants: int = 200, replicas: int = 2,
                # Replica-plane measurement at static knobs (see the
                # in-process legs' adapt pin above).
                "DBM_ADAPT": "0"}
+        if rollup is not None:
+            # Pin the rollup plane for an A/B (bench detail.rollup);
+            # None inherits the parent env / default-on.
+            env["DBM_ROLLUP"] = "1" if rollup else "0"
         cluster = ProcCluster(statedir, replicas=replicas, miners=miners,
                               env=env, fake_miners=True)
         cluster.start()
@@ -1019,6 +1024,49 @@ def run_load_procs(tenants: int = 200, replicas: int = 2,
                     timed_out = timed_out or out.get("timed_out", False)
             makespan = time.monotonic() - t0
             cpu_s = _children_cpu_s(pids) - cpu0
+            rollup_summary = None
+            if cluster.env.get("DBM_ROLLUP", "1") != "0":
+                # Read the cluster's own published rollup while the
+                # processes are still alive: the --assert-rollup gate
+                # (scripts/loadharness.py) checks every live process
+                # published fresh and the cluster counter totals cover
+                # the storm the driver measured client-side. Publishers
+                # stamp at the BEAT cadence, so the blobs lag the final
+                # counters by up to one beat — poll a few beats until
+                # the totals cover the storm rather than snapshotting a
+                # mid-flight frame.
+                from .procs import health_beat_s
+                from .rollup import aggregate as _rollup_aggregate
+
+                def _fam(doc, family):
+                    pref = family + "{"
+                    sec = doc["cluster"]["counters"]
+                    return int(sum(v for k, v in sec.items()
+                                   if k == family or k.startswith(pref)))
+
+                try:
+                    beat = health_beat_s()
+                    doc = _rollup_aggregate(statedir)
+                    for _ in range(8):
+                        if _fam(doc, "sched.results_sent") \
+                                + _fam(doc, "sched.qos_shed") \
+                                >= len(latencies) + len(sheds):
+                            break
+                        await asyncio.sleep(max(0.05, beat / 2))
+                        doc = _rollup_aggregate(statedir)
+                    statuses = [p["status"] for p in doc["procs"]]
+                    rollup_summary = {
+                        "procs": len(statuses),
+                        "fresh": statuses.count("fresh"),
+                        "stale": statuses.count("stale"),
+                        "fenced": statuses.count("fenced"),
+                        "results_sent": _fam(doc, "sched.results_sent"),
+                        "qos_shed": _fam(doc, "sched.qos_shed"),
+                        "series_overflow":
+                            doc["cluster"]["series_overflow"],
+                    }
+                except Exception:  # noqa: BLE001 — summary, not gate
+                    rollup_summary = {"error": "aggregate failed"}
         finally:
             cluster.close()
             shutil.rmtree(statedir, ignore_errors=True)
@@ -1047,6 +1095,8 @@ def run_load_procs(tenants: int = 200, replicas: int = 2,
             if completed else None,
             "trace": {"sampled_traces": 0},
         }
+        if rollup_summary is not None:
+            out["rollup"] = rollup_summary
         if timed_out:
             out["timed_out"] = True
         return out

@@ -73,6 +73,7 @@ from ..lsp.errors import LspError
 from ..utils._env import float_env as _float_env, int_env as _int_env
 from .health import Beat, BeatMonitor, Membership, RouterState, router_tick
 from .replicas import HashRing
+from .rollup import RollupPublisher, gc_stale_blobs, rollup_enabled
 from .scheduler import ResultCache
 
 logger = logging.getLogger("dbm.procs")
@@ -375,6 +376,13 @@ class ReplicaProcess:
         self.sched = None
         self.fenced = False
         self._seq = 0
+        # Rollup plane (ISSUE 18): publish this process's registry
+        # snapshot into the state directory every beat. None when the
+        # knob is off — no blob, no extra write, bit-for-bit stock.
+        self._rollup = (RollupPublisher(statedir, "replica", rid,
+                                        self.incarnation,
+                                        beat_s=self.beat_s)
+                        if rollup_enabled() else None)
 
     async def run(self) -> str:
         from ..lsp.server import new_async_server
@@ -388,6 +396,8 @@ class ReplicaProcess:
         print(f"Replica {self.rid} listening on port {self.server.port}",
               flush=True)
         self._write_beat()                 # admit before first request
+        if self._rollup is not None:
+            self._rollup.publish()
         beat_task = asyncio.get_running_loop().create_task(
             self._beat_loop())
         try:
@@ -396,6 +406,8 @@ class ReplicaProcess:
         finally:
             beat_task.cancel()
             self._write_beat(final=True)
+            if self._rollup is not None:
+                self._rollup.publish(final=True)
             await self.server.close()
 
     def _write_beat(self, final: bool = False) -> None:
@@ -436,6 +448,8 @@ class ReplicaProcess:
             if isinstance(self.cache, SpoolResultCache):
                 self.cache.ingest(m)
             self._write_beat()
+            if self._rollup is not None:
+                self._rollup.publish(epoch_seen=m.epoch if m else 0)
 
 
 # ----------------------------------------------------------------- router
@@ -449,6 +463,11 @@ class Router:
         self.beat_s = beat_s if beat_s is not None else health_beat_s()
         self.miss_k = miss_k if miss_k is not None else health_miss_k()
         self.state = RouterState(BeatMonitor(self.beat_s, self.miss_k))
+        self.incarnation = f"{os.getpid()}-{int(time.time() * 1000)}"
+        self._rollup = (RollupPublisher(statedir, "router", 0,
+                                        self.incarnation,
+                                        beat_s=self.beat_s)
+                        if rollup_enabled() else None)
 
     async def run(self) -> None:
         os.makedirs(self.statedir, exist_ok=True)
@@ -479,8 +498,15 @@ class Router:
             if changed or ticks % 64 == 0:
                 # Fenced incarnations' leftover spools are a pure disk
                 # leak (their lines are refused at ingest): sweep them
-                # on every fence and periodically thereafter.
+                # on every fence and periodically thereafter. Metric
+                # blobs get the softer sweep: a fresh corpse stays
+                # VISIBLE (flagged stale/fenced by the rollup), only
+                # long-dead blobs are litter.
                 gc_fenced_spools(self.statedir, self.state.membership)
+                gc_stale_blobs(self.statedir)
+            if self._rollup is not None:
+                self._rollup.publish(
+                    epoch_seen=self.state.membership.epoch)
             await asyncio.sleep(self.beat_s)
 
 
@@ -519,6 +545,13 @@ class MinerAgent:
         self.joins = 0
         self.fence_pushes = 0
         self._pushed = False
+        self.incarnation = f"{os.getpid()}-{int(time.time() * 1000)}"
+        # Miner agents have no rid; the pid keys the blob (the rollup's
+        # SourceSet bounds + retires churned pids, and the router GCs
+        # their long-stale blobs).
+        self._rollup = (RollupPublisher(statedir, "miner", os.getpid(),
+                                        self.incarnation)
+                        if rollup_enabled() else None)
 
     def _pick(self) -> Optional[Tuple[int, str, str]]:
         """``(rid, incarnation, hostport)`` of the thinnest advertised
@@ -564,7 +597,27 @@ class MinerAgent:
                 await worker.close()
                 return
 
+    async def _publish_loop(self) -> None:
+        """Beat-cadence rollup publishing (the agent has no beat file of
+        its own — this task is its whole state-plane presence)."""
+        period = health_beat_s()
+        while True:
+            m = await asyncio.to_thread(read_membership, self.statedir)
+            self._rollup.publish(epoch_seen=m.epoch if m else 0)
+            await asyncio.sleep(period)
+
     async def run(self) -> None:
+        publisher = None
+        if self._rollup is not None:
+            publisher = asyncio.get_running_loop().create_task(
+                self._publish_loop())
+        try:
+            await self._run_inner()
+        finally:
+            if publisher is not None:
+                publisher.cancel()
+
+    async def _run_inner(self) -> None:
         from .miner import MinerWorker
         while True:
             picked = self._pick()
@@ -781,6 +834,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from ..utils import configure_logging, from_env
+    from ..utils.metrics import set_proc_identity
     configure_logging(logging.INFO)
     cfg = from_env()
     try:
@@ -789,16 +843,26 @@ def main(argv=None) -> int:
                                   port=args.port, params=cfg.params,
                                   lease=cfg.lease, cache=cfg.cache,
                                   stripe=cfg.stripe, qos=cfg.qos)
+            if rollup_enabled():
+                # Env-armed process: every emitter snapshot line and
+                # flight-recorder dump self-attributes (ISSUE 18).
+                set_proc_identity("replica", args.rid, proc.incarnation)
             outcome = asyncio.run(proc.run())
             return FENCED_EXIT if outcome == "fenced" else 0
         if args.role == "router":
-            asyncio.run(Router(args.statedir).run())
+            router = Router(args.statedir)
+            if rollup_enabled():
+                set_proc_identity("router", 0, router.incarnation)
+            asyncio.run(router.run())
             return 0
         factory = None
         if args.fake:
             factory = lambda d, b: _InstantSearcher(d)  # noqa: E731
-        asyncio.run(MinerAgent(args.statedir, params=cfg.params,
-                               searcher_factory=factory).run())
+        agent = MinerAgent(args.statedir, params=cfg.params,
+                           searcher_factory=factory)
+        if rollup_enabled():
+            set_proc_identity("miner", os.getpid(), agent.incarnation)
+        asyncio.run(agent.run())
         return 0
     except KeyboardInterrupt:
         return 0

@@ -360,6 +360,17 @@ class ReplicaSet:
                 out[k] = out.get(k, 0) + v
         return out
 
+    def cluster_rollup(self) -> dict:
+        """Merged metrics snapshot over EVERY replica's registry, via
+        the rollup plane's merge semantics (ISSUE 18) — the in-process
+        analog of ``apps/rollup.aggregate`` over published blobs, and
+        the live surface the counter-sum-equals-parts test pins against
+        :attr:`stats`."""
+        from .rollup import merge_snapshots
+        return merge_snapshots(
+            (f"replica{rid}", sched.metrics.snapshot())
+            for rid, sched in sorted(self.replicas.items()))
+
     @property
     def queue(self) -> list:
         """Queued requests across live replicas (harness/invariant

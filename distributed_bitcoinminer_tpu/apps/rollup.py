@@ -1,0 +1,482 @@
+"""Cluster metric rollup: per-process snapshot blobs -> one snapshot.
+
+Every observability plane before this one — metrics (PR 3), traces
+(PR 9), capture (PR 15) — is strictly per-process: a ``--procs``
+deployment emits N interleaved JSONL streams no tool merges, so "is the
+cluster healthy" means a human grepping router, replica, and miner-agent
+logs side by side. This module is the merge point (ISSUE 18): each
+env-armed process publishes a versioned snapshot blob of its metrics
+:class:`~..utils.metrics.Registry` into the health-beat state directory
+(same atomic tmp+rename discipline as beats and membership, stamped with
+role/rid/incarnation, a publish seq, and the membership epoch it has
+seen), and :func:`aggregate` merges the blobs into ONE coherent cluster
+snapshot that ``scripts/dbmtop.py``, the SLO tracker (``apps/slo.py``),
+the loadharness ``--procs`` gates, and ``dbmtrace summarize`` all read.
+
+Merge semantics, per metric kind:
+
+- **counters** — summed across sources per series key: the cluster's
+  ``sched.results_sent`` is exactly the sum of the per-process
+  registries (test-pinned in tests/test_rollup.py);
+- **histograms** — cumulative-``le`` buckets merged elementwise when the
+  bounds agree (they do for every built-in family — buckets are frozen
+  at construction), kept per-source under a ``proc`` label otherwise;
+- **gauges** — last-write-wins scalars cannot be meaningfully summed
+  across processes, so each stays per-source under a ``proc`` label;
+- **EWMAs** — combined sample-weighted (``sum(v*n)/sum(n)``): a replica
+  that has folded in 10x the samples carries 10x the weight.
+
+The ``proc`` label is a dynamic, churn-prone label (miner agents come
+and go with their pids), so it rides the same cardinality discipline as
+every other dynamic label in the tree: per-source series are admitted
+through a :class:`SourceSet` bounded by ``DBM_METRICS_MAX_SERIES``, a
+retired source (fenced replica, expired miner agent) frees its slot via
+``retire_proc``, and overflow is COUNTED in the merged snapshot's
+``series_overflow``, never silently dropped. The dbmlint cardinality
+analyzer knows ``proc_series``/``retire_proc`` as a registration/
+retirement pair (satellite of ISSUE 18).
+
+Staleness: a frozen publisher is FLAGGED, not averaged in. Stateless
+readers (``dbmtop --once``, the loadharness gate) age each blob's own
+wall stamp against the publisher's advertised beat cadence times
+``DBM_ROLLUP_STALE_K``; the long-lived console additionally runs a
+:class:`~.health.SeqFreshness` tracker (the BeatMonitor core, extracted
+for exactly this reuse) keyed by ``(role, rid)`` so a replayed stale
+blob never counts as life. A fenced replica incarnation's blob is
+dropped from cluster totals exactly like its cache spool lines
+(status ``fenced``), and blobs stale past many windows are garbage
+collected by the router alongside fenced spools.
+
+Everything is behind ``DBM_ROLLUP`` (default 1 for env-armed processes;
+the knob-off matrix leg pins 0 = bit-for-bit stock: no publisher
+construction, no blobs, no identity stamps).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..utils._env import float_env as _float_env, int_env as _int_env
+from .health import Membership, SeqFreshness
+
+__all__ = ["rollup_enabled", "stale_k", "blob_path", "read_blobs",
+           "RollupPublisher", "SourceSet", "merge_snapshots",
+           "hist_quantile", "aggregate", "RollupState",
+           "gc_stale_blobs"]
+
+#: Blob format version (readers skip versions they do not understand).
+BLOB_V = 1
+
+_PREFIX = "metrics_"
+
+
+def rollup_enabled() -> bool:
+    """``DBM_ROLLUP`` (default 1): the cluster rollup plane — env-armed
+    processes publish metric snapshot blobs into the state directory and
+    stamp their logs with process identity; 0 = bit-for-bit stock."""
+    return _int_env("DBM_ROLLUP", 1) != 0
+
+
+def stale_k() -> int:
+    """``DBM_ROLLUP_STALE_K`` (default = ``DBM_HEALTH_MISS_K``'s
+    default, 3): publish periods of silence before a source's blob is
+    flagged stale and dropped from cluster totals."""
+    return max(1, _int_env("DBM_ROLLUP_STALE_K",
+                           _int_env("DBM_HEALTH_MISS_K", 3)))
+
+
+def blob_path(statedir: str, role: str, rid) -> str:
+    """State-plane path of one source's snapshot blob. Keyed by (role,
+    rid) — NOT incarnation — so a respawned process overwrites its
+    predecessor's blob instead of leaking one file per restart."""
+    return os.path.join(statedir, f"{_PREFIX}{role}_{rid}.json")
+
+
+def read_blobs(statedir: str) -> List[dict]:
+    """Every well-formed snapshot blob in the state directory, sorted by
+    (role, rid) for deterministic aggregation."""
+    from .procs import read_json
+    out = []
+    try:
+        names = os.listdir(statedir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(_PREFIX) and name.endswith(".json")):
+            continue
+        d = read_json(os.path.join(statedir, name))
+        if (isinstance(d, dict) and d.get("v") == BLOB_V
+                and isinstance(d.get("snapshot"), dict)
+                and "role" in d and "rid" in d):
+            out.append(d)
+    out.sort(key=lambda d: (str(d["role"]), str(d["rid"])))
+    return out
+
+
+class RollupPublisher:
+    """One process's side of the rollup plane: periodic atomic snapshot
+    blobs into the state directory.
+
+    ``publish()`` is called from the process's existing beat/tick loop
+    (replica beat loop, router tick, miner-agent beat task) — no new
+    thread, one registry snapshot + one small file write per beat.
+    Never raises: metrics publishing must not take down a serving
+    process (a full disk degrades observability, not service).
+    """
+
+    def __init__(self, statedir: str, role: str, rid, incarnation: str,
+                 registry=None, beat_s: Optional[float] = None):
+        from ..utils import metrics as _metrics
+        self.statedir = statedir
+        self.role = str(role)
+        self.rid = rid
+        self.incarnation = str(incarnation)
+        self.registry = registry if registry is not None \
+            else _metrics.registry()
+        if beat_s is None:
+            beat_s = _float_env("DBM_HEALTH_BEAT_S", 0.5)
+        #: Advertised cadence: readers size the staleness window from
+        #: the blob itself, so a console run without the cluster's env
+        #: still judges freshness by the publisher's actual period.
+        self.beat_s = max(0.01, float(beat_s))
+        self.seq = 0
+        self.path = blob_path(statedir, self.role, self.rid)
+
+    def publish(self, epoch_seen: int = 0, final: bool = False) -> bool:
+        """Write one blob (seq advances per call). True on success."""
+        from .procs import write_json_atomic
+        self.seq += 1
+        doc = {"v": BLOB_V, "role": self.role, "rid": self.rid,
+               "inc": self.incarnation, "seq": self.seq,
+               "wall": time.time(), "beat_s": self.beat_s,
+               "epoch_seen": int(epoch_seen), "final": bool(final),
+               "snapshot": self.registry.snapshot()}
+        try:
+            write_json_atomic(self.path, doc)
+            return True
+        except OSError:
+            return False
+
+
+# ------------------------------------------------------------------ merging
+
+
+class SourceSet:
+    """Bounded admission of per-source (``proc``-labeled) series.
+
+    The ``proc`` label space is unbounded under miner-agent churn (one
+    value per agent pid), so it gets the registry's own cardinality
+    discipline: at most ``max_series`` distinct label sets per family,
+    further sets are refused and counted (``overflows``), and a retired
+    source frees its slot. ``proc_series``/``retire_proc`` mirror the
+    ``counter``/``remove`` and ``track``/``retire`` pairs the dbmlint
+    cardinality analyzer enforces — a dynamic ``proc`` label needs a
+    same-module retirement path.
+    """
+
+    def __init__(self, max_series: Optional[int] = None):
+        self.max_series = (max_series if max_series is not None
+                           else _int_env("DBM_METRICS_MAX_SERIES", 64))
+        self._families: Dict[str, set] = {}
+        self.overflows = 0
+
+    @staticmethod
+    def _key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def proc_series(self, family: str, **labels) -> bool:
+        """Admit one labeled series into ``family``; False (and counted)
+        past the cardinality bound."""
+        key = self._key(labels)
+        admitted = self._families.setdefault(family, set())
+        if key in admitted:
+            return True
+        if len(admitted) >= self.max_series:
+            self.overflows += 1
+            return False
+        admitted.add(key)
+        return True
+
+    def retire_proc(self, family: str, **labels) -> None:
+        """Free a retired source's slot (fenced replica / expired miner
+        agent) — churn cycles slots instead of exhausting them."""
+        self._families.get(family, set()).discard(self._key(labels))
+
+    def sources(self, family: str) -> List[Tuple[Tuple[str, str], ...]]:
+        return sorted(self._families.get(family, set()))
+
+
+def _with_proc(series_key: str, proc: str) -> str:
+    """``name`` -> ``name{proc=X}``; ``name{a=b}`` -> ``name{a=b,proc=X}``."""
+    if series_key.endswith("}"):
+        return f"{series_key[:-1]},proc={proc}}}"
+    return f"{series_key}{{proc={proc}}}"
+
+
+def merge_snapshots(sources: Iterable[Tuple[str, dict]],
+                    source_set: Optional[SourceSet] = None) -> dict:
+    """Merge per-process registry snapshots into one cluster snapshot.
+
+    ``sources`` is ``(proc_key, snapshot)`` pairs (snapshot as produced
+    by ``Registry.snapshot()``). Pure function of its inputs — merging
+    the same blobs twice yields the identical document (the idempotence
+    property tests/test_rollup.py pins). Counters sum; histograms merge
+    elementwise when bucket bounds agree, else fall back to per-source;
+    gauges stay per-source under a ``proc`` label; EWMAs combine
+    sample-weighted. Per-source series go through ``source_set`` (a
+    fresh bound when None) so ``proc`` cardinality is capped.
+    """
+    if source_set is None:
+        source_set = SourceSet()
+    out = {"counters": {}, "gauges": {}, "histograms": {}, "ewmas": {},
+           "series_overflow": 0, "sources": 0}
+    ewma_acc: Dict[str, list] = {}   # key -> [weighted_sum, samples]
+    for proc, snap in sources:
+        out["sources"] += 1
+        out["series_overflow"] += int(snap.get("series_overflow", 0))
+        admitted = source_set.proc_series("rollup_sources", proc=proc)
+        for key, v in (snap.get("counters") or {}).items():
+            out["counters"][key] = out["counters"].get(key, 0) + int(v)
+        for key, v in (snap.get("gauges") or {}).items():
+            if not admitted:
+                out["series_overflow"] += 1
+                continue
+            out["gauges"][_with_proc(key, proc)] = v
+        for key, h in (snap.get("histograms") or {}).items():
+            cur = out["histograms"].get(key)
+            if cur is not None and cur.get("le") == h.get("le"):
+                cur["counts"] = [a + b for a, b in
+                                 zip(cur["counts"], h["counts"])]
+                cur["count"] += int(h.get("count", 0))
+                cur["sum"] = round(cur["sum"] + float(h.get("sum", 0.0)),
+                                   6)
+            elif cur is None:
+                out["histograms"][key] = {
+                    "le": list(h.get("le") or []),
+                    "counts": list(h.get("counts") or []),
+                    "count": int(h.get("count", 0)),
+                    "sum": float(h.get("sum", 0.0))}
+            else:
+                # Bucket-bound mismatch (custom buckets on one source):
+                # summing would lie, so this source's copy stays
+                # attributed under its proc label.
+                if not admitted:
+                    out["series_overflow"] += 1
+                    continue
+                out["histograms"][_with_proc(key, proc)] = dict(h)
+        for key, e in (snap.get("ewmas") or {}).items():
+            v, n = e.get("value"), int(e.get("samples", 0))
+            acc = ewma_acc.setdefault(key, [0.0, 0])
+            if v is not None and n > 0:
+                acc[0] += float(v) * n
+                acc[1] += n
+    for key, (ws, n) in ewma_acc.items():
+        out["ewmas"][key] = {
+            "value": round(ws / n, 6) if n else None, "samples": n}
+    # series_overflow counts SERIES dropped in THIS merge (per skipped
+    # gauge/histogram) — not SourceSet.overflows, which is cumulative
+    # across refreshes and would inflate a long-lived console's totals.
+    for kind in ("counters", "gauges", "histograms", "ewmas"):
+        out[kind] = dict(sorted(out[kind].items()))
+    return out
+
+
+def hist_quantile(h: Optional[dict], q: float) -> Optional[float]:
+    """The ``q``-quantile upper bound from a cumulative-``le`` snapshot
+    histogram (the bound of the first bucket covering ``q`` of the
+    observations). None when empty/absent or when the quantile lies in
+    the +Inf bucket — the caller renders that as ``>max_bound``."""
+    if not h or not h.get("count"):
+        return None
+    target = q * h["count"]
+    for bound, cum in zip(h.get("le") or [], h.get("counts") or []):
+        if cum >= target:
+            return float(bound)
+    return None
+
+
+# ---------------------------------------------------------------- aggregate
+
+
+#: Headline per-source stats surfaced on each proc row (dbmtop columns,
+#: SLO worst-offender attribution) — family name -> row key. Counters
+#: and gauges sum across label sets within the family.
+_DETAIL_COUNTERS = (("sched.results_sent", "results"),
+                    ("sched.qos_shed", "shed"),
+                    ("sched.leases_blown", "leases_blown"))
+_DETAIL_GAUGES = (("sched.queue_depth", "queue"),
+                  ("sched.pool_size", "pool"),
+                  ("sched.lease_min_remaining_s", "lease_min_s"))
+
+
+def _family_values(section: dict, family: str) -> List[float]:
+    pref = family + "{"
+    return [float(v) for k, v in section.items()
+            if k == family or k.startswith(pref)
+            if isinstance(v, (int, float))]
+
+
+def _proc_detail(snap: dict) -> dict:
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    detail: dict = {}
+    for family, out_key in _DETAIL_COUNTERS:
+        vals = _family_values(counters, family)
+        if vals:
+            detail[out_key] = int(sum(vals))
+    for family, out_key in _DETAIL_GAUGES:
+        vals = _family_values(gauges, family)
+        if vals:
+            detail[out_key] = round(sum(vals), 3)
+    trust = _family_values(gauges, "sched.miner_trust")
+    if trust:
+        detail["trust_min"] = round(min(trust), 3)
+    p99 = hist_quantile((snap.get("histograms") or {})
+                        .get("sched.queue_wait_s"), 0.99)
+    if p99 is not None:
+        detail["queue_wait_p99_s"] = p99
+    for family in ("miner.nonces_per_s", "sched.pool_rate_nps"):
+        e = (snap.get("ewmas") or {}).get(family) or \
+            (snap.get("gauges") or {}).get(family)
+        v = e.get("value") if isinstance(e, dict) else e
+        if isinstance(v, (int, float)):
+            detail["nps"] = round(float(v), 1)
+            break
+    return detail
+
+
+def aggregate(statedir: str, *, now: Optional[float] = None,
+              membership: Optional[Membership] = None,
+              source_set: Optional[SourceSet] = None) -> dict:
+    """One cluster snapshot from the state directory's blobs.
+
+    Per source: status ``fenced`` (a fenced replica incarnation — its
+    numbers are dropped exactly like its cache spool lines), ``stale``
+    (wall stamp older than ``beat_s * DBM_ROLLUP_STALE_K`` — a frozen
+    publisher is flagged, not averaged in), or ``fresh`` (merged into
+    the cluster totals). Pure function of (files, now): re-reading the
+    same directory yields the identical document.
+    """
+    from .procs import read_membership
+    if now is None:
+        now = time.time()
+    if membership is None:
+        membership = read_membership(statedir)
+    k = stale_k()
+    procs_out: List[dict] = []
+    fresh: List[Tuple[str, dict]] = []
+    for blob in read_blobs(statedir):
+        role, rid = str(blob["role"]), blob["rid"]
+        inc = str(blob.get("inc", ""))
+        window_s = max(0.01, float(blob.get("beat_s", 0.5))) * k
+        age_s = max(0.0, now - float(blob.get("wall", 0.0)))
+        if role == "replica" and membership is not None \
+                and membership.is_fenced(int(rid), inc):
+            status = "fenced"
+        elif age_s > window_s:
+            status = "stale"
+        else:
+            status = "fresh"
+        proc_key = f"{role}{rid}"
+        procs_out.append({
+            "proc": proc_key, "role": role, "rid": rid, "inc": inc,
+            "seq": int(blob.get("seq", 0)), "status": status,
+            "age_s": round(age_s, 3), "window_s": round(window_s, 3),
+            "epoch_seen": int(blob.get("epoch_seen", 0)),
+            "detail": _proc_detail(blob["snapshot"])})
+        if status == "fresh":
+            fresh.append((proc_key, blob["snapshot"]))
+    doc = {"v": BLOB_V, "event": "rollup", "at": now,
+           "procs": procs_out,
+           "cluster": merge_snapshots(fresh, source_set=source_set)}
+    if membership is not None:
+        doc["membership"] = membership.to_dict()
+    return doc
+
+
+class RollupState:
+    """Long-lived aggregation state for the live console.
+
+    Adds what the stateless :func:`aggregate` cannot have: seq-advance
+    freshness (a SIGSTOPped publisher whose blob keeps being re-read
+    never counts as alive — same :class:`~.health.SeqFreshness` rule the
+    BeatMonitor runs), a shared :class:`SourceSet` so the ``proc`` label
+    bound holds across refreshes with retirement on fence/expiry, and
+    the membership epoch timeline dbmtop renders.
+    """
+
+    #: Windows of continuous staleness before a source's slot is retired
+    #: (its series bound slot frees; a revived source re-admits).
+    RETIRE_K = 20
+
+    def __init__(self, statedir: str, history: int = 32):
+        self.statedir = statedir
+        self.sources = SourceSet()
+        self._fresh: Optional[SeqFreshness] = None
+        self._epochs: List[Tuple[float, int]] = []   # (wall, epoch)
+        self.history = history
+
+    def epochs(self) -> List[Tuple[float, int]]:
+        return list(self._epochs)
+
+    def refresh(self, now: Optional[float] = None) -> dict:
+        """One console frame: aggregate + seq-freshness overlay."""
+        if now is None:
+            now = time.time()
+        doc = aggregate(self.statedir, now=now, source_set=self.sources)
+        window = max((p["window_s"] for p in doc["procs"]), default=1.0)
+        if self._fresh is None:
+            self._fresh = SeqFreshness(window)
+        self._fresh.window_s = max(1e-3, window)
+        stale_keys = set()
+        for p in doc["procs"]:
+            key = (p["role"], p["rid"])
+            self._fresh.observe(key, p["inc"], p["seq"], now)
+        stale_keys.update(self._fresh.stale(now))
+        for p in doc["procs"]:
+            key = (p["role"], p["rid"])
+            if p["status"] == "fresh" and key in stale_keys:
+                # Wall stamp advanced but seq did not (replayed/cloned
+                # blob): the seq rule wins, exactly as for beats.
+                p["status"] = "stale"
+            if p["status"] != "fresh":
+                age = self._fresh.age_s(key, now)
+                if p["status"] == "fenced" or (
+                        age is not None
+                        and age > self._fresh.window_s * self.RETIRE_K):
+                    self.sources.retire_proc("rollup_sources",
+                                             proc=p["proc"])
+        epoch = (doc.get("membership") or {}).get("epoch")
+        if epoch is not None and (not self._epochs
+                                  or self._epochs[-1][1] != epoch):
+            self._epochs.append((now, int(epoch)))
+            del self._epochs[:-self.history]
+        return doc
+
+
+def gc_stale_blobs(statedir: str, *, now: Optional[float] = None,
+                   retire_k: int = RollupState.RETIRE_K) -> int:
+    """Unlink snapshot blobs dead past ``retire_k`` staleness windows.
+
+    The router calls this alongside ``gc_fenced_spools``: a freshly
+    fenced/killed process's blob stays VISIBLE (flagged, excluded from
+    totals — the operator sees the death), but a blob nobody has
+    refreshed for many windows is litter from long-gone incarnations
+    (miner agents churn pids) and is removed. Returns blobs unlinked.
+    """
+    if now is None:
+        now = time.time()
+    removed = 0
+    for blob in read_blobs(statedir):
+        window_s = max(0.01, float(blob.get("beat_s", 0.5))) * stale_k()
+        age_s = now - float(blob.get("wall", 0.0))
+        if age_s > window_s * max(1, retire_k):
+            try:
+                os.unlink(blob_path(statedir, str(blob["role"]),
+                                    blob["rid"]))
+                removed += 1
+            except OSError:
+                pass
+    return removed

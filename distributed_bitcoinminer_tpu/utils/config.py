@@ -540,6 +540,57 @@ Environment variables:
   ``--threshold 0.3``: echo-storm msgs/s may not fall below the floor
   (set ~30-50% under measured medians, outside box noise) and the
   fast-vs-stock speedup may not collapse toward 1.0.
+- ``DBM_ROLLUP`` (default 1): the cluster observability plane
+  (apps/rollup.py, ISSUE 18). 1 = every env-armed process (replica,
+  router, miner agent under ``--procs``) publishes its metrics
+  registry as a versioned snapshot blob (``metrics_<role>_<rid>.json``,
+  atomic tmp+rename, stamped role/rid/incarnation + beat cadence)
+  into the health-beat state directory at every beat, and the
+  aggregator merges the fresh ones into one cluster snapshot
+  (``scripts/dbmtop.py``, ``dbmtrace summarize``, the loadharness
+  ``--assert-rollup`` gate). 0 = no publisher objects, no blobs, no
+  identity stamps — bit-for-bit stock (knob-off matrix leg pin).
+- ``DBM_ROLLUP_STALE_K`` (default: ``DBM_HEALTH_MISS_K``, 3): beat
+  windows without a FRESH snapshot (wall stamp within
+  ``beat_s * K``, seq advancing) before a source's blob is flagged
+  ``stale`` and excluded from cluster totals — a frozen publisher is
+  flagged, never silently averaged in. Fenced replica incarnations
+  are excluded the same way a fenced writer's cache spool lines are.
+- ``DBM_SLO_AVAIL`` (default 0.99): reply-availability SLO target
+  (apps/slo.py): fraction of decided requests answered rather than
+  shed, ``results_sent / (results_sent + qos_shed)``; the error
+  budget is ``1 - target``.
+- ``DBM_SLO_P99_S`` (default 60): queue-wait p99 SLO threshold in
+  seconds (mirrors the tier-1 mini-load leg's ``--assert-p99 60``
+  bar), read from the merged cumulative-``le`` ``sched.queue_wait_s``
+  buckets; budget 1% by the definition of a p99 objective.
+- ``DBM_SLO_SHED`` (default 0.25): shed-rate SLO budget — fraction of
+  admission decisions shed, ``qos_shed / (qos_grants + qos_shed)``
+  (the loadharness storm gates treat <=25% shed under deliberate
+  overload as healthy back-pressure).
+- ``DBM_SLO_WINDOW_S`` (default 300): the LONG burn-rate window in
+  seconds; the short window is long/12 (the classic fast-burn pair
+  ratio). An alert fires only on the transition into "both windows
+  burning" — the short window gates on sustained current pain, the
+  long one keeps a transient blip from paging.
+- ``DBM_SLO_BURN`` (default 4.0): burn-rate alert threshold — windowed
+  error fraction over budget; 4.0 = the error budget is being spent
+  4x faster than the SLO allows. Firing alerts are flight-recorder
+  events naming the burning objective and the worst-offending
+  process.
+- ``DBM_TIER1_BUDGET_S`` (default: nproc-derived — 870 on >=2 cores,
+  1740 on 1 core): scripts/tier1.sh's main pytest wall budget in
+  seconds; the knob-off matrix leg scales to ~55% of it (the
+  historical 480/870 ratio). The original 870 was calibrated on a
+  2-core runner — a 1-core box needs roughly double the wall for the
+  same suite.
+- ``DBM_BENCH_ROLLUP`` (0 disables) / ``DBM_BENCH_ROLLUP_ROUNDS``
+  (default 2): the bench's ``detail.rollup`` overhead probe — an
+  interleaved order-swapped A/B of the multi-process loadharness with
+  the rollup plane on vs off (makespan/admitted-per-s/cpu-per-request
+  medians + the makespan ratio; publish must be within noise), plus a
+  microbench of one publish and one aggregate over synthetic
+  4-process registries (``publish_ms`` / ``aggregate_ms``).
 """
 
 from __future__ import annotations

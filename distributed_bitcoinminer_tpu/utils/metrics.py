@@ -376,6 +376,12 @@ class Emitter(threading.Thread):
         info = capture_info()
         if info is not None:
             doc["capture"] = info
+        # Interleaved --procs logs attribute without pid cross-referencing
+        # (ISSUE 18): a multi-process run funnels N emitters into one
+        # stream, and "whose snapshot is this" must be on the line itself.
+        ident = proc_identity()
+        if ident is not None:
+            doc["identity"] = ident
         self.logger.info("%s", json.dumps(doc, sort_keys=True))
 
     def run(self) -> None:
@@ -437,6 +443,35 @@ def capture_info() -> Optional[dict]:
     except Exception:   # noqa: BLE001 — crash-artifact path, best effort
         return None
     return info if isinstance(info, dict) else None
+
+
+# Process identity (ISSUE 18): an env-armed process (router / replica /
+# miner agent) registers its role/rid/incarnation here so every emitter
+# snapshot line and flight-recorder dump self-attributes — the same
+# triple the rollup plane stamps onto published metric blobs. Same slot
+# discipline as the capture info above, and in this module for the same
+# layering reason.
+_proc_identity: Optional[dict] = None
+_proc_identity_lock = threading.Lock()
+
+
+def set_proc_identity(role: Optional[str], rid=None,
+                      incarnation: Optional[str] = None) -> None:
+    """Register this process's identity triple (``role=None`` clears)."""
+    global _proc_identity
+    with _proc_identity_lock:
+        if role is None:
+            _proc_identity = None
+        else:
+            _proc_identity = {"role": str(role), "rid": rid,
+                              "inc": incarnation}
+
+
+def proc_identity() -> Optional[dict]:
+    """A copy of the registered identity dict, or None (never raises)."""
+    with _proc_identity_lock:
+        ident = _proc_identity
+    return dict(ident) if ident is not None else None
 
 
 def registry() -> Registry:

@@ -62,7 +62,9 @@ from collections import deque
 from typing import Dict, Optional, Tuple
 
 from ._env import float_env as _float_env, int_env as _int_env
-from .metrics import capture_info as _capture_info, registry as _registry
+from .metrics import (capture_info as _capture_info,
+                      proc_identity as _proc_identity,
+                      registry as _registry)
 
 _log = logging.getLogger("dbm.trace")
 
@@ -184,6 +186,12 @@ class FlightRecorder:
         info = _capture_info()
         if info is not None:
             doc["capture"] = info
+        # Same contract as the metrics emitter (ISSUE 18): a --procs
+        # cluster interleaves N recorders into one stream, so the dump
+        # names the role/rid/incarnation it came from.
+        ident = _proc_identity()
+        if ident is not None:
+            doc["identity"] = ident
         _log.warning("flight recorder dump (%s): %s", why,
                      json.dumps(doc, sort_keys=True, default=str))
 

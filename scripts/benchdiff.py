@@ -23,6 +23,13 @@ Direction is classified by the LAST path segment (word-boundary
 matching against the pattern lists below); anything unmatched is
 ``info``. Sample lists (``*_samples``, ``samples``) and obvious
 config echoes are skipped entirely.
+
+Rollup snapshots (ISSUE 18): an input that is a cluster rollup
+document (``apps.rollup.aggregate`` / ``dbmtop --once --json`` output
+— keys ``cluster`` + ``procs``) is flattened into diffable leaves
+first: counters and gauges by metric key, EWMAs as ``value`` leaves,
+histograms as ``p50``/``p99`` quantiles, plus freshness counts — so
+two observability snapshots diff like two bench artifacts.
 """
 
 from __future__ import annotations
@@ -82,6 +89,57 @@ def _leaves(obj, path=()):
             yield path, float(obj)
     # Lists are samples/sweeps — per-element pairing across artifacts
     # is not stable, so they are never diffed.
+
+
+def _hist_quantile(h: dict, q: float):
+    """Quantile bound from the registry's cumulative-``le`` histogram
+    shape (kept local: benchdiff imports nothing from the package)."""
+    count = h.get("count", 0)
+    if not count:
+        return None
+    need = q * count
+    for bound, c in zip(h.get("le", ()), h.get("counts", ())):
+        if c >= need:
+            return float(bound)
+    return None            # lands in the +Inf bucket: unbounded
+
+
+def _is_rollup(doc) -> bool:
+    return isinstance(doc, dict) and "cluster" in doc and "procs" in doc
+
+
+def _flatten_rollup(doc: dict) -> dict:
+    """Cluster rollup doc -> diffable leaves (ISSUE 18). The raw doc
+    would mostly vanish into the ``snapshot``/``count`` skip rules;
+    this pins the comparable surface explicitly."""
+    procs = doc.get("procs", [])
+    cl = doc.get("cluster", {})
+    metrics = {}
+    for section in ("counters", "gauges"):
+        for key, v in cl.get(section, {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                metrics[key] = v
+    for key, e in cl.get("ewmas", {}).items():
+        if isinstance(e, dict) and isinstance(e.get("value"),
+                                              (int, float)):
+            metrics[key] = {"value": e["value"]}
+    for key, h in cl.get("histograms", {}).items():
+        if not isinstance(h, dict):
+            continue
+        entry = {}
+        for name, q in (("p50", 0.5), ("p99", 0.99)):
+            qv = _hist_quantile(h, q)
+            if qv is not None:
+                entry[name] = qv
+        if entry:
+            metrics[key] = entry
+    return {"rollup": {
+        "procs_total": len(procs),
+        "procs_fresh": sum(1 for p in procs
+                           if p.get("status") == "fresh"),
+        "series_overflow": cl.get("series_overflow", 0),
+        "cluster": metrics,
+    }}
 
 
 def diff(old: dict, new: dict, threshold: float) -> dict:
@@ -174,6 +232,10 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as exc:
         print(f"benchdiff: {exc}", file=sys.stderr)
         return 2
+    if _is_rollup(old):
+        old = _flatten_rollup(old)
+    if _is_rollup(new):
+        new = _flatten_rollup(new)
     result = diff(old, new, args.threshold)
     if args.json:
         print(json.dumps(result, sort_keys=True))

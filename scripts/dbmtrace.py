@@ -95,8 +95,12 @@ def convert(paths: list, out: str) -> int:
 
 def _iter_records(path: str):
     """Auto-detecting line reader: yields ``("trace", dict)`` for
-    stitched trace dicts (incl. ``trace dump (...)`` log lines) and
-    ``("capture", dict)`` for workload-capture records."""
+    stitched trace dicts (incl. ``trace dump (...)`` log lines),
+    ``("capture", dict)`` for workload-capture records,
+    ``("rollup", dict)`` for cluster rollup documents (ISSUE 18 —
+    ``apps.rollup.aggregate`` / ``dbmtop --once --json`` output), and
+    ``("blob", dict)`` for raw per-process ``metrics_*.json`` snapshot
+    blobs from a cluster state directory."""
     with open(path, encoding="utf-8", errors="replace") as fh:
         for line in fh:
             line = line.strip()
@@ -119,10 +123,49 @@ def _iter_records(path: str):
                 yield "trace", obj
             elif "k" in obj:
                 yield "capture", obj
+            elif "cluster" in obj and "procs" in obj:
+                yield "rollup", obj
+            elif "snapshot" in obj and "role" in obj:
+                yield "blob", obj
 
 
 def _pctl(xs: list, q: float) -> float:
     return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _print_rollups(rollups: list, blobs: list) -> None:
+    """Cluster headline from rollup docs / raw snapshot blobs
+    (ISSUE 18). Raw blobs merge here — the aggregate is pure — so a
+    state directory's ``metrics_*.json`` files summarize without a
+    running cluster."""
+    if not rollups and not blobs:
+        return
+    from distributed_bitcoinminer_tpu.apps.rollup import (hist_quantile,
+                                                          merge_snapshots)
+    if rollups:
+        doc = rollups[-1]            # newest wins: the live headline
+        cluster = doc.get("cluster", {})
+        procs = doc.get("procs", [])
+        fresh = sum(1 for p in procs if p.get("status") == "fresh")
+        head = (f"{len(rollups)} rollup doc(s); last: {fresh}/"
+                f"{len(procs)} procs fresh")
+    else:
+        cluster = merge_snapshots(
+            (f"{b.get('role')}{b.get('rid')}", b["snapshot"])
+            for b in blobs)
+        head = f"{len(blobs)} snapshot blob(s) merged"
+    counters = cluster.get("counters", {})
+
+    def _fam(family):
+        return sum(v for k, v in counters.items()
+                   if k == family or k.startswith(family + "{"))
+
+    wait = cluster.get("histograms", {}).get("sched.queue_wait_s")
+    p99 = hist_quantile(wait, 0.99) if wait else None
+    print(f"rollup: {head}; cluster results_sent="
+          f"{_fam('sched.results_sent')} shed={_fam('sched.qos_shed')} "
+          f"reissues={_fam('sched.reissues')} queue-wait p99="
+          f"{'n/a' if p99 is None else f'{p99}s'}\n")
 
 
 def summarize(paths: list, top: int) -> int:
@@ -135,8 +178,19 @@ def summarize(paths: list, top: int) -> int:
     # captures summarize byte-identically to before.
     verif = {"claim_failed": 0, "audit": 0, "audit_passed": 0,
              "audit_failed": 0, "audit_repair": 0}
+    # Observability-plane records (ISSUE 18): aggregate rollup docs and
+    # raw per-process snapshot blobs both summarize to the same cluster
+    # headline; blobs are merged here so
+    # ``dbmtrace summarize statedir/metrics_*.json`` works directly.
+    rollups, blobs = [], []
     for path in paths:
         for kind, obj in _iter_records(path):
+            if kind == "rollup":
+                rollups.append(obj)
+                continue
+            if kind == "blob":
+                blobs.append(obj)
+                continue
             if kind == "capture":
                 k = obj.get("k")
                 if k == "span":
@@ -179,12 +233,13 @@ def summarize(paths: list, top: int) -> int:
                           if worst_phase else "no spans folded")
                 slowest.append((float(reply["elapsed_s"]), label,
                                 detail))
-    if not phases and not slowest:
-        print("dbmtrace summarize: no spans or replies found in "
-              f"{paths}", file=sys.stderr)
+    if not phases and not slowest and not rollups and not blobs:
+        print("dbmtrace summarize: no spans, replies, or rollup "
+              f"snapshots found in {paths}", file=sys.stderr)
         return 1
     print(f"{n_traces} trace(s), {n_spans} span(s), "
           f"{len(slowest)} replied request(s)\n")
+    _print_rollups(rollups, blobs)
     if phases:
         print(f"{'phase':<10} {'count':>7} {'p50':>10} {'p90':>10} "
               f"{'max':>10}")

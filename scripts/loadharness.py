@@ -100,7 +100,15 @@ def main(argv=None) -> int:
                          "rule is replaced by this floor")
     ap.add_argument("--assert-series", type=int, default=None,
                     help="gate: max process metric series after the run")
+    ap.add_argument("--assert-rollup", action="store_true",
+                    help="gate (--procs): every cluster process must "
+                         "have published a FRESH rollup snapshot blob "
+                         "and the merged results_sent total must cover "
+                         "every completed request (ISSUE 18)")
     args = ap.parse_args(argv)
+    if args.assert_rollup and not args.procs:
+        ap.error("--assert-rollup applies only to --procs runs (the "
+                 "rollup plane is the multi-process state directory)")
 
     from distributed_bitcoinminer_tpu.apps.loadharness import (
         run_adversarial, run_load, run_load_procs, run_replay,
@@ -239,6 +247,26 @@ def main(argv=None) -> int:
               f"(bound {args.assert_series}) — unbounded label growth",
               file=sys.stderr)
         rc = 1
+    if args.assert_rollup:
+        ru = leg.get("rollup")
+        expected_procs = 1 + leg.get("replicas", 0) + leg.get("miners", 0)
+        if not isinstance(ru, dict) or "error" in ru:
+            print(f"LOAD_GATE: no rollup summary in the leg "
+                  f"(DBM_ROLLUP off, or aggregate failed: {ru})",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            if ru.get("fresh", 0) < expected_procs:
+                print(f"LOAD_GATE: only {ru.get('fresh')}/"
+                      f"{expected_procs} cluster processes published a "
+                      f"fresh rollup snapshot: {ru}", file=sys.stderr)
+                rc = 1
+            if ru.get("results_sent", 0) < leg["completed"]:
+                print(f"LOAD_GATE: rollup results_sent "
+                      f"{ru.get('results_sent')} under the "
+                      f"{leg['completed']} completed requests the "
+                      f"driver measured", file=sys.stderr)
+                rc = 1
     return rc
 
 

@@ -9,6 +9,14 @@ the replica that owns the in-flight request and asserts the reply still
 arrives EXACTLY ONCE and ORACLE-EXACT, with failover driven solely by
 the router's missed-beat detection (no test-hook kill path exists in
 this topology). Exit 0 on success, 1 on any violation.
+
+ISSUE 18 addition: the observability plane rides the same topology, so
+this leg also asserts ``dbmtop --once --json`` sees EVERY live process
+(router + both replicas + the miner agent) with a fresh rollup snapshot
+within one beat interval, and — after the kill — that the dead
+replica's snapshot reads fenced/stale instead of folding into cluster
+totals. Skipped when DBM_ROLLUP=0 in the ambient env (the knob-off
+matrix shape).
 """
 
 from __future__ import annotations
@@ -22,6 +30,51 @@ import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
+
+from distributed_bitcoinminer_tpu.utils._env import int_env  # noqa: E402
+
+_ROLLUP_ON = int_env("DBM_ROLLUP", 1) != 0
+
+
+async def _dbmtop_doc(statedir: str) -> dict:
+    """One ``dbmtop --once --json`` run as a real subprocess (the exact
+    operator entry point, not the library call)."""
+    import json
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, os.path.join(_REPO, "scripts", "dbmtop.py"),
+        statedir, "--once", "--json",
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE)
+    out, err = await asyncio.wait_for(proc.communicate(), 30)
+    if proc.returncode != 0:
+        raise RuntimeError(f"dbmtop rc={proc.returncode}: "
+                           f"{err.decode(errors='replace')[-500:]}")
+    return json.loads(out.decode())
+
+
+async def _assert_all_fresh(statedir: str, beat_s: float) -> int:
+    """Every live process visible and fresh, age within ~a beat.
+
+    Publishers stamp each beat, so a healthy cluster's blob ages sit
+    in [0, beat_s) plus write/read jitter; retry a few beats before
+    calling it a failure (one slow fsync is not an outage).
+    """
+    last = None
+    for _ in range(8):
+        doc = await _dbmtop_doc(statedir)
+        procs = doc.get("procs", [])
+        fresh = [p for p in procs if p["status"] == "fresh"
+                 and p["age_s"] <= beat_s * 2.0]
+        roles = sorted(p["role"] for p in fresh)
+        if roles.count("replica") >= 2 and "router" in roles \
+                and "miner" in roles:
+            print(f"PROCSMOKE: dbmtop sees {len(fresh)} fresh procs "
+                  f"({'/'.join(roles)}) within a beat", flush=True)
+            return 0
+        last = [(p["proc"], p["status"], p["age_s"]) for p in procs]
+        await asyncio.sleep(beat_s)
+    print(f"PROCSMOKE: dbmtop missing fresh procs within one beat: "
+          f"{last}", file=sys.stderr)
+    return 1
 
 
 async def smoke() -> int:
@@ -53,6 +106,9 @@ async def smoke() -> int:
             print(f"PROCSMOKE: warm request wrong: {got} != {want}",
                   file=sys.stderr)
             return 1
+        # ISSUE 18: the live console must see every process fresh.
+        if _ROLLUP_ON and await _assert_all_fresh(statedir, 0.15):
+            return 1
         # The headline: kill -9 the owner mid-request.
         owner = resolve_owner(statedir, "procsmoke kill")
         assert owner is not None
@@ -78,6 +134,18 @@ async def smoke() -> int:
             print(f"PROCSMOKE: killed replica never fenced: "
                   f"{m and m.to_dict()}", file=sys.stderr)
             return 1
+        if _ROLLUP_ON:
+            # The dead replica's snapshot must read fenced/stale, not
+            # fold silently into cluster totals.
+            doc = await _dbmtop_doc(statedir)
+            dead = [p for p in doc.get("procs", [])
+                    if p["role"] == "replica" and str(p["rid"]) == str(rid)]
+            if not dead or dead[0]["status"] not in ("fenced", "stale"):
+                print(f"PROCSMOKE: killed replica's rollup snapshot not "
+                      f"fenced/stale: {dead}", file=sys.stderr)
+                return 1
+            print(f"PROCSMOKE: dbmtop flags dead replica {rid} as "
+                  f"{dead[0]['status']}", flush=True)
         print(f"PROCSMOKE: ok — kill -9 of replica {rid} mid-request "
               f"recovered oracle-exact in {time.monotonic() - t0:.1f}s "
               f"(membership epoch {m.epoch})", flush=True)

@@ -3,10 +3,16 @@
 #
 # The pytest command is byte-identical to the ROADMAP.md "Tier-1 verify"
 # line (keep them in sync): CPU-pinned pytest over tests/, not-slow only,
-# collection errors surfaced but non-fatal, 870s wall budget, and a
+# collection errors surfaced but non-fatal, wall-budgeted, and a
 # DOTS_PASSED count (passing-test dots in the -q progress lines) printed
 # at the end so runs that time out mid-suite still yield a comparable
-# score. One deliberate addition over the ROADMAP line (ISSUE 3): the
+# score. The wall budget scales with the box (ISSUE 18): the original
+# 870s was calibrated on a 2-core runner, and a 1-core box needs roughly
+# double the wall for the same suite — so the default derives from
+# `nproc` (>=2 cores: 870s main / 480s matrix; 1 core: 1740s / 960s) and
+# DBM_TIER1_BUDGET_S overrides the main-leg budget explicitly (the
+# matrix leg stays proportional at ~55%). The ROADMAP line quotes the
+# 1740s figure — a cap, safe on any box. One deliberate addition over the ROADMAP line (ISSUE 3): the
 # suite runs with DBM_METRICS_INTERVAL_S set, so the periodic metrics
 # emitter is exercised under the full suite's load (every scheduler/miner
 # construction starts it) instead of only in its own unit tests.
@@ -25,6 +31,21 @@
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
 export DBM_METRICS_INTERVAL_S="${DBM_METRICS_INTERVAL_S:-2}"
+
+# Wall budgets, nproc-derived (ISSUE 18 satellite): the 870s main-leg
+# budget was set on a 2-core box; a 1-core box runs the same suite in
+# roughly twice the wall, so it timed out mid-suite and under-counted
+# DOTS_PASSED. DBM_TIER1_BUDGET_S pins the main budget explicitly; the
+# matrix leg scales proportionally (~55% of main, the historical
+# 480/870 ratio).
+cores=$(nproc 2>/dev/null || echo 2)
+if [ "${cores:-2}" -ge 2 ]; then
+    budget_default=870
+else
+    budget_default=1740
+fi
+budget="${DBM_TIER1_BUDGET_S:-$budget_default}"
+matrix_budget=$(awk -v b="$budget" 'BEGIN{printf "%d", (b*55)/100}')
 
 # dbmlint leg (ISSUE 7): the repo's AST invariant gate
 # (scripts/dbmlint.py vs analysis/baseline.json). New findings fail;
@@ -226,7 +247,7 @@ if [ "${DBM_TIER1_TRANSPORT:-1}" != "0" ]; then
 fi
 
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu \
+timeout -k 10 "$budget" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
@@ -281,11 +302,17 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # the bit-for-bit pre-ISSUE-17 wire path — with test_wire.py and
     # test_transport_fast.py (whose parity pins assert byte-identical
     # frames fast-vs-stock) in the module list.
-    timeout -k 10 480 env JAX_PLATFORMS=cpu DBM_PIPELINE=0 DBM_STRIPE=0 \
+    # ISSUE 18 addition: DBM_ROLLUP=0 pins the no-observability-plane
+    # shape (no publisher objects, no metrics_* blobs, no identity
+    # stamps — the bit-for-bit stock contract test_rollup.py's
+    # knob-off tests assert) with test_rollup.py in the module list.
+    timeout -k 10 "$matrix_budget" env JAX_PLATFORMS=cpu \
+        DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
         DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 DBM_MESH=0 \
         DBM_CAPTURE=0 DBM_VERIFY=0 DBM_MMSG=0 DBM_WIRE_FAST=0 \
+        DBM_ROLLUP=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
@@ -293,6 +320,7 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
         tests/test_trace.py tests/test_plane_split.py \
         tests/test_adapt.py tests/test_capture.py tests/test_verify.py \
         tests/test_wire.py tests/test_transport_fast.py \
+        tests/test_rollup.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}

@@ -176,6 +176,51 @@ def test_cardinality_track_not_vouched_by_remove():
     assert len(found) == 1 and ".retire(" in found[0].message
 
 
+# ISSUE 18: the rollup plane's per-source (``proc``-labeled) series
+# obey the same rule with their own pair — a dynamic ``proc`` label on
+# a .proc_series() site needs a same-module .retire_proc() path; no
+# other retirement method vouches for it.
+CARD_PROC_BAD = snip("""
+    class Console:
+        def fold(self, sources, blob):
+            sources.proc_series("rollup_sources", proc=blob.proc)
+""")
+
+CARD_PROC_GOOD = snip("""
+    class Console:
+        def fold(self, sources, blob):
+            sources.proc_series("rollup_sources", proc=blob.proc)
+
+        def on_fence(self, sources, blob):
+            sources.retire_proc("rollup_sources", proc=blob.proc)
+""")
+
+CARD_PROC_WRONG_RETIREMENT = snip("""
+    class Console:
+        def fold(self, sources, metrics, blob):
+            sources.proc_series("rollup_sources", proc=blob.proc)
+
+        def on_fence(self, metrics, blob):
+            metrics.remove("rollup_sources", proc=blob.proc)
+""")
+
+
+def test_cardinality_catches_unretired_proc_series():
+    found = run_source("cardinality", CARD_PROC_BAD)
+    assert len(found) == 1
+    assert "rollup_sources" in found[0].message
+    assert ".retire_proc(" in found[0].message
+
+
+def test_cardinality_accepts_proc_series_retirement_path():
+    assert run_source("cardinality", CARD_PROC_GOOD) == []
+
+
+def test_cardinality_proc_series_not_vouched_by_remove():
+    found = run_source("cardinality", CARD_PROC_WRONG_RETIREMENT)
+    assert len(found) == 1 and ".retire_proc(" in found[0].message
+
+
 # ----------------------------------------------------------- knob-hygiene
 
 KNOB_BAD = snip("""

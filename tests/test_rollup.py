@@ -1,0 +1,650 @@
+"""Cluster observability plane (ISSUE 18): rollup, SLO, dbmtop.
+
+Pins the tentpole contracts: cross-process snapshot merge exactness
+(counters sum to exactly the sum of parts, cumulative-``le`` histogram
+buckets merge elementwise, EWMAs combine sample-weighted), aggregate
+idempotence under re-read, fenced/stale-source exclusion from cluster
+totals, the ``proc``-label cardinality bound under miner-agent churn,
+process-identity stamps on emitter/flight-recorder lines, the
+multi-window SLO burn alert, and the one-attribute-per-hook knob-off
+shape (``DBM_ROLLUP=0`` constructs no publisher anywhere — the matrix
+leg runs this module with the knob off).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import pytest
+
+from distributed_bitcoinminer_tpu.apps.health import (Beat, BeatMonitor,
+                                                      Membership,
+                                                      SeqFreshness)
+from distributed_bitcoinminer_tpu.apps.rollup import (RollupPublisher,
+                                                      RollupState,
+                                                      SourceSet,
+                                                      aggregate,
+                                                      gc_stale_blobs,
+                                                      hist_quantile,
+                                                      merge_snapshots,
+                                                      read_blobs,
+                                                      rollup_enabled)
+from distributed_bitcoinminer_tpu.apps.slo import (SloTracker,
+                                                   default_objectives)
+from distributed_bitcoinminer_tpu.utils.metrics import (Emitter, Registry,
+                                                        proc_identity,
+                                                        set_proc_identity)
+
+T0 = 1_000_000.0
+
+
+def _hist(le, counts, total, s):
+    return {"le": list(le), "counts": list(counts), "count": total,
+            "sum": s}
+
+
+def _snap(counters=None, gauges=None, histograms=None, ewmas=None,
+          overflow=0):
+    return {"counters": dict(counters or {}), "gauges": dict(gauges or {}),
+            "histograms": dict(histograms or {}),
+            "ewmas": dict(ewmas or {}), "series_overflow": overflow}
+
+
+# ------------------------------------------------------------------- merge
+
+
+class TestMergeSnapshots:
+    def test_counter_sum_equals_parts(self):
+        r1, r2 = Registry(), Registry()
+        r1.counter("sched.results_sent").inc(10)
+        r2.counter("sched.results_sent").inc(32)
+        r1.counter("sched.qos_shed", tenant="a").inc(3)
+        r2.counter("sched.qos_shed", tenant="a").inc(4)
+        merged = merge_snapshots([("replica0", r1.snapshot()),
+                                  ("replica1", r2.snapshot())])
+        assert merged["counters"]["sched.results_sent"] == 42
+        assert merged["counters"]["sched.qos_shed{tenant=a}"] == 7
+        # Exactly the sum of the per-process registries, nothing else.
+        parts = sum(r.snapshot()["counters"]["sched.results_sent"]
+                    for r in (r1, r2))
+        assert merged["counters"]["sched.results_sent"] == parts
+
+    def test_histogram_bucket_merge_exact(self):
+        h1 = _hist([0.1, 1.0, 10.0], [1, 3, 5], 6, 7.5)
+        h2 = _hist([0.1, 1.0, 10.0], [2, 2, 4], 4, 2.5)
+        merged = merge_snapshots(
+            [("a", _snap(histograms={"w": h1})),
+             ("b", _snap(histograms={"w": h2}))])
+        got = merged["histograms"]["w"]
+        assert got["le"] == [0.1, 1.0, 10.0]
+        assert got["counts"] == [3, 5, 9]        # elementwise, exact
+        assert got["count"] == 10
+        assert got["sum"] == 10.0
+        # Inputs are never mutated (fresh dict copies).
+        assert h1["counts"] == [1, 3, 5]
+
+    def test_histogram_bound_mismatch_falls_back_per_source(self):
+        h1 = _hist([0.1, 1.0], [1, 2], 2, 1.0)
+        h2 = _hist([0.5, 5.0], [1, 1], 1, 0.4)
+        merged = merge_snapshots(
+            [("a", _snap(histograms={"w": h1})),
+             ("b", _snap(histograms={"w": h2}))])
+        assert merged["histograms"]["w"]["counts"] == [1, 2]
+        assert merged["histograms"]["w{proc=b}"]["le"] == [0.5, 5.0]
+
+    def test_ewma_sample_weighted(self):
+        merged = merge_snapshots(
+            [("a", _snap(ewmas={"nps": {"value": 100.0, "samples": 1}})),
+             ("b", _snap(ewmas={"nps": {"value": 200.0, "samples": 3}}))])
+        assert merged["ewmas"]["nps"] == {"value": 175.0, "samples": 4}
+
+    def test_ewma_empty_sources(self):
+        merged = merge_snapshots(
+            [("a", _snap(ewmas={"nps": {"value": None, "samples": 0}}))])
+        assert merged["ewmas"]["nps"] == {"value": None, "samples": 0}
+
+    def test_gauges_kept_per_source_under_proc_label(self):
+        merged = merge_snapshots(
+            [("replica0", _snap(gauges={"sched.queue_depth": 3})),
+             ("replica1", _snap(gauges={"sched.queue_depth": 5,
+                                        "t{m=x}": 1.0}))])
+        assert merged["gauges"]["sched.queue_depth{proc=replica0}"] == 3
+        assert merged["gauges"]["sched.queue_depth{proc=replica1}"] == 5
+        # Existing label sets gain proc INSIDE the braces.
+        assert merged["gauges"]["t{m=x,proc=replica1}"] == 1.0
+
+    def test_merge_is_pure_and_idempotent(self):
+        pairs = [("a", _snap(counters={"c": 1},
+                             histograms={"w": _hist([1.0], [1], 1, 0.5)},
+                             ewmas={"e": {"value": 2.0, "samples": 2}})),
+                 ("b", _snap(counters={"c": 2}, gauges={"g": 9}))]
+        assert merge_snapshots(pairs) == merge_snapshots(pairs)
+
+    def test_overflow_sums_input_overflows(self):
+        merged = merge_snapshots([("a", _snap(overflow=2)),
+                                  ("b", _snap(overflow=3))])
+        assert merged["series_overflow"] == 5
+
+
+class TestSourceSetCardinality:
+    def test_bound_under_miner_churn(self):
+        ss = SourceSet(max_series=4)
+        pairs = [(f"miner{pid}", _snap(gauges={"g": pid}))
+                 for pid in range(10)]
+        merged = merge_snapshots(pairs, source_set=ss)
+        # Only the admitted sources keep per-proc gauges; the rest are
+        # refused and COUNTED, not silently folded in.
+        assert len(merged["gauges"]) == 4
+        assert merged["series_overflow"] == 6
+        assert ss.overflows == 6
+        # Counters still sum over every source — the bound only guards
+        # the per-source (proc-labeled) series space.
+        assert merged["sources"] == 10
+
+    def test_retire_frees_slot(self):
+        ss = SourceSet(max_series=1)
+        assert ss.proc_series("rollup_sources", proc="miner1")
+        assert not ss.proc_series("rollup_sources", proc="miner2")
+        ss.retire_proc("rollup_sources", proc="miner1")
+        assert ss.proc_series("rollup_sources", proc="miner2")
+        assert ss.sources("rollup_sources") == [(("proc", "miner2"),)]
+
+    def test_readmission_is_free(self):
+        ss = SourceSet(max_series=1)
+        assert ss.proc_series("rollup_sources", proc="a")
+        assert ss.proc_series("rollup_sources", proc="a")
+        assert ss.overflows == 0
+
+
+class TestHistQuantile:
+    def test_quantiles(self):
+        h = _hist([0.1, 1.0, 10.0], [50, 90, 100], 100, 55.0)
+        assert hist_quantile(h, 0.5) == 0.1
+        assert hist_quantile(h, 0.9) == 1.0
+        assert hist_quantile(h, 0.99) == 10.0
+
+    def test_empty_and_inf_bucket(self):
+        assert hist_quantile(None, 0.5) is None
+        assert hist_quantile(_hist([1.0], [0], 0, 0.0), 0.5) is None
+        # All mass past the largest finite bound: unbounded quantile.
+        assert hist_quantile(_hist([1.0], [0], 5, 50.0), 0.5) is None
+
+
+# --------------------------------------------------------- publish/aggregate
+
+
+def _publish(statedir, role, rid, inc, registry, *, beat_s=0.5,
+             epoch_seen=0):
+    pub = RollupPublisher(statedir, role, rid, inc, registry=registry,
+                          beat_s=beat_s)
+    assert pub.publish(epoch_seen=epoch_seen)
+    return pub
+
+
+class TestPublishAggregate:
+    def test_blob_shape_and_atomic_discipline(self, tmp_path):
+        d = str(tmp_path)
+        r = Registry()
+        r.counter("sched.results_sent").inc(7)
+        _publish(d, "replica", 0, "i0", r)
+        blobs = read_blobs(d)
+        assert len(blobs) == 1
+        b = blobs[0]
+        assert (b["role"], b["rid"], b["inc"], b["seq"]) == \
+            ("replica", 0, "i0", 1)
+        assert b["snapshot"]["counters"]["sched.results_sent"] == 7
+        # No tmp litter: the writer goes through tmp+rename.
+        assert all(not f.startswith(".") and ".tmp" not in f
+                   for f in os.listdir(d))
+
+    def test_aggregate_idempotent_under_reread(self, tmp_path):
+        d = str(tmp_path)
+        for rid in (0, 1):
+            r = Registry()
+            r.counter("sched.results_sent").inc(10 + rid)
+            r.histogram("sched.queue_wait_s").observe(0.01)
+            _publish(d, "replica", rid, f"i{rid}", r)
+        now = read_blobs(d)[0]["wall"] + 0.1
+        doc1 = aggregate(d, now=now)
+        doc2 = aggregate(d, now=now)
+        assert doc1 == doc2
+        assert json.dumps(doc1, sort_keys=True) == \
+            json.dumps(doc2, sort_keys=True)
+
+    def test_totals_equal_sum_of_parts(self, tmp_path):
+        d = str(tmp_path)
+        want = 0
+        for rid in range(3):
+            r = Registry()
+            r.counter("sched.results_sent").inc(5 * (rid + 1))
+            want += 5 * (rid + 1)
+            _publish(d, "replica", rid, f"i{rid}", r)
+        doc = aggregate(d)
+        assert doc["cluster"]["counters"]["sched.results_sent"] == want
+        assert [p["status"] for p in doc["procs"]] == ["fresh"] * 3
+
+    def test_stale_source_flagged_and_excluded(self, tmp_path):
+        d = str(tmp_path)
+        for rid in (0, 1):
+            r = Registry()
+            r.counter("sched.results_sent").inc(10)
+            _publish(d, "replica", rid, f"i{rid}", r, beat_s=0.5)
+        # Freeze replica 1 by aggregating far past its window: its
+        # numbers drop out of totals, but the row stays VISIBLE.
+        path = os.path.join(d, "metrics_replica_1.json")
+        blob = json.load(open(path))
+        blob["wall"] -= 60.0
+        json.dump(blob, open(path, "w"))
+        doc = aggregate(d)
+        by = {p["proc"]: p for p in doc["procs"]}
+        assert by["replica0"]["status"] == "fresh"
+        assert by["replica1"]["status"] == "stale"
+        assert doc["cluster"]["counters"]["sched.results_sent"] == 10
+        assert by["replica1"]["age_s"] > by["replica1"]["window_s"]
+
+    def test_fenced_source_excluded_like_cache_spools(self, tmp_path):
+        d = str(tmp_path)
+        for rid in (0, 1):
+            r = Registry()
+            r.counter("sched.results_sent").inc(10)
+            _publish(d, "replica", rid, f"i{rid}", r)
+        m = Membership()
+        m.admit(Beat(rid=0, incarnation="i0", seq=1))
+        m.admit(Beat(rid=1, incarnation="i1", seq=1))
+        m.declare_dead(1)
+        doc = aggregate(d, membership=m)
+        by = {p["proc"]: p for p in doc["procs"]}
+        assert by["replica1"]["status"] == "fenced"
+        assert doc["cluster"]["counters"]["sched.results_sent"] == 10
+        # A NEW incarnation of the same rid is not fenced.
+        r = Registry()
+        r.counter("sched.results_sent").inc(1)
+        _publish(d, "replica", 1, "i1b", r)
+        doc = aggregate(d, membership=m)
+        assert {p["proc"]: p["status"] for p in doc["procs"]} == \
+            {"replica0": "fresh", "replica1": "fresh"}
+
+    def test_proc_detail_rows(self, tmp_path):
+        d = str(tmp_path)
+        r = Registry()
+        r.counter("sched.results_sent").inc(4)
+        r.counter("sched.qos_shed").inc(1)
+        r.gauge("sched.queue_depth").set(7)
+        r.gauge("sched.miner_trust", miner="m1").set(0.5)
+        r.gauge("sched.miner_trust", miner="m2").set(0.9)
+        r.histogram("sched.queue_wait_s").observe(0.02)
+        r.ewma("miner.nonces_per_s").observe(1234.5)
+        _publish(d, "miner", 99, "i", r)
+        detail = aggregate(d)["procs"][0]["detail"]
+        assert detail["results"] == 4 and detail["shed"] == 1
+        assert detail["queue"] == 7
+        assert detail["trust_min"] == 0.5
+        assert detail["queue_wait_p99_s"] is not None
+        assert detail["nps"] == 1234.5
+
+    def test_gc_sweeps_only_long_dead(self, tmp_path):
+        d = str(tmp_path)
+        r = Registry()
+        _publish(d, "miner", 1, "i", r, beat_s=0.5)
+        _publish(d, "miner", 2, "i", r, beat_s=0.5)
+        wall = read_blobs(d)[0]["wall"]
+        window = 0.5 * 3
+        # Freshly dead: visible, NOT swept (the operator must see it).
+        assert gc_stale_blobs(d, now=wall + window * 2) == 0
+        assert len(read_blobs(d)) == 2
+        # Long dead: litter from churned pids, swept.
+        assert gc_stale_blobs(d, now=wall + window * 50) == 2
+        assert read_blobs(d) == []
+
+
+class TestRollupState:
+    def test_frozen_seq_downgrades_fresh_wall(self, tmp_path):
+        d = str(tmp_path)
+        r = Registry()
+        pub = _publish(d, "replica", 0, "i0", r, beat_s=0.5)
+        state = RollupState(d)
+        t0 = read_blobs(d)[0]["wall"]
+        assert state.refresh(now=t0)["procs"][0]["status"] == "fresh"
+        # A cloned/replayed blob: wall advances, seq does not. The seq
+        # rule wins — exactly the BeatMonitor's SIGSTOP discipline.
+        path = os.path.join(d, "metrics_replica_0.json")
+        blob = json.load(open(path))
+        blob["wall"] = t0 + 10.0
+        json.dump(blob, open(path, "w"))
+        doc = state.refresh(now=t0 + 10.0)
+        assert doc["procs"][0]["status"] == "stale"
+        # A real publish (seq advances) restores freshness.
+        assert pub.publish()
+        blob = json.load(open(path))
+        blob["wall"] = t0 + 10.5
+        json.dump(blob, open(path, "w"))
+        doc = state.refresh(now=t0 + 10.6)
+        assert doc["procs"][0]["status"] == "fresh"
+
+    def test_long_stale_source_retired_from_bound(self, tmp_path):
+        d = str(tmp_path)
+        r = Registry()
+        r.gauge("g").set(1)
+        _publish(d, "replica", 0, "i0", r, beat_s=0.5)
+        state = RollupState(d)
+        t0 = read_blobs(d)[0]["wall"]
+        state.refresh(now=t0)
+        assert state.sources.sources("rollup_sources")
+        state.refresh(now=t0 + 0.5 * 3 * (RollupState.RETIRE_K + 5))
+        assert state.sources.sources("rollup_sources") == []
+
+    def test_epoch_timeline(self, tmp_path):
+        d = str(tmp_path)
+        from distributed_bitcoinminer_tpu.apps.procs import \
+            write_json_atomic
+        r = Registry()
+        _publish(d, "replica", 0, "i0", r)
+        m = Membership()
+        m.admit(Beat(rid=0, incarnation="i0", seq=1))
+        write_json_atomic(os.path.join(d, "membership.json"), m.to_dict())
+        state = RollupState(d)
+        t0 = read_blobs(d)[0]["wall"]
+        state.refresh(now=t0)
+        m.admit(Beat(rid=1, incarnation="i1", seq=1))
+        write_json_atomic(os.path.join(d, "membership.json"), m.to_dict())
+        state.refresh(now=t0 + 0.1)
+        assert [e for _, e in state.epochs()] == [1, 2]
+
+
+# ------------------------------------------------------------- seq freshness
+
+
+class TestSeqFreshness:
+    def test_advance_and_stale(self):
+        f = SeqFreshness(window_s=1.0)
+        assert f.observe("a", "g1", 1, T0)
+        assert not f.observe("a", "g1", 1, T0 + 0.5)   # replay: no life
+        assert f.stale(T0 + 0.5) == []
+        assert f.stale(T0 + 1.5) == ["a"]
+        assert f.observe("a", "g1", 2, T0 + 2.0)       # seq advanced
+        assert f.stale(T0 + 2.5) == []
+
+    def test_generation_change_counts_as_advance(self):
+        f = SeqFreshness(window_s=1.0)
+        f.observe("a", "g1", 5, T0)
+        # A restarted source resets its seq under a NEW generation.
+        assert f.observe("a", "g2", 1, T0 + 0.5)
+        assert f.age_s("a", T0 + 0.6) == pytest.approx(0.1)
+
+    def test_forget(self):
+        f = SeqFreshness(window_s=1.0)
+        f.observe("a", "g", 1, T0)
+        f.forget("a")
+        assert f.keys() == [] and f.stale(T0 + 10) == []
+
+    def test_beat_monitor_delegates_same_rules(self):
+        mon = BeatMonitor(beat_s=0.1, miss_k=3)
+        mon.observe(Beat(rid=0, incarnation="i", seq=1), T0)
+        # Replayed blob (same seq) is not life: dead after the window.
+        mon.observe(Beat(rid=0, incarnation="i", seq=1), T0 + 0.25)
+        assert mon.dead(T0 + 0.35) == [0]
+        mon.observe(Beat(rid=0, incarnation="i", seq=2), T0 + 0.4)
+        assert mon.dead(T0 + 0.5) == []
+        mon.forget(0)
+        assert mon.dead(T0 + 10.0) == []
+
+
+# ------------------------------------------------------------ identity stamp
+
+
+class TestIdentityStamp:
+    @pytest.fixture(autouse=True)
+    def _clear(self):
+        yield
+        set_proc_identity(None)
+
+    def _emit_doc(self):
+        records = []
+
+        class _H(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        log = logging.getLogger("test.rollup.emit")
+        log.addHandler(_H())
+        log.setLevel(logging.INFO)
+        try:
+            Emitter(Registry(), interval_s=60, logger=log).emit()
+        finally:
+            log.handlers.clear()
+        return json.loads(records[-1])
+
+    def test_emitter_lines_stamped(self):
+        set_proc_identity("replica", 3, "pid-123")
+        doc = self._emit_doc()
+        assert doc["identity"] == {"role": "replica", "rid": 3,
+                                   "inc": "pid-123"}
+
+    def test_no_identity_no_stamp(self):
+        set_proc_identity(None)
+        assert "identity" not in self._emit_doc()
+        assert proc_identity() is None
+
+    def test_flight_recorder_dump_stamped(self, caplog):
+        from distributed_bitcoinminer_tpu.utils.trace import FlightRecorder
+        set_proc_identity("miner", 42, "pid-9")
+        fr = FlightRecorder(cap=8)
+        fr.record("x", k=1)
+        with caplog.at_level(logging.WARNING):
+            fr.dump("test")
+        line = next(m for m in caplog.messages
+                    if "flight recorder dump" in m)
+        doc = json.loads(line[line.index("{"):])
+        assert doc["identity"] == {"role": "miner", "rid": 42,
+                                   "inc": "pid-9"}
+
+
+# ------------------------------------------------------------------ knob off
+
+
+class TestKnobOff:
+    """One attribute test per hook: DBM_ROLLUP=0 constructs NOTHING."""
+
+    def test_enabled_default_on(self, monkeypatch):
+        monkeypatch.delenv("DBM_ROLLUP", raising=False)
+        assert rollup_enabled()
+        monkeypatch.setenv("DBM_ROLLUP", "0")
+        assert not rollup_enabled()
+
+    def test_replica_hook(self, tmp_path, monkeypatch):
+        from distributed_bitcoinminer_tpu.apps.procs import ReplicaProcess
+        monkeypatch.setenv("DBM_ROLLUP", "0")
+        assert ReplicaProcess(str(tmp_path), 0)._rollup is None
+        monkeypatch.delenv("DBM_ROLLUP")
+        assert ReplicaProcess(str(tmp_path), 0)._rollup is not None
+
+    def test_router_hook(self, tmp_path, monkeypatch):
+        from distributed_bitcoinminer_tpu.apps.procs import Router
+        monkeypatch.setenv("DBM_ROLLUP", "0")
+        assert Router(str(tmp_path))._rollup is None
+        monkeypatch.delenv("DBM_ROLLUP")
+        assert Router(str(tmp_path))._rollup is not None
+
+    def test_miner_agent_hook(self, tmp_path, monkeypatch):
+        from distributed_bitcoinminer_tpu.apps.procs import MinerAgent
+        monkeypatch.setenv("DBM_ROLLUP", "0")
+        assert MinerAgent(str(tmp_path))._rollup is None
+        monkeypatch.delenv("DBM_ROLLUP")
+        assert MinerAgent(str(tmp_path))._rollup is not None
+
+    def test_off_writes_no_blobs(self, tmp_path, monkeypatch):
+        from distributed_bitcoinminer_tpu.apps.procs import ReplicaProcess
+        monkeypatch.setenv("DBM_ROLLUP", "0")
+        ReplicaProcess(str(tmp_path), 0)
+        assert read_blobs(str(tmp_path)) == []
+
+
+# ----------------------------------------------------------------------- slo
+
+
+def _slo_doc(shed, sent, procs=None):
+    return {"cluster": {"counters": {"sched.qos_shed": shed,
+                                     "sched.results_sent": sent,
+                                     "sched.qos_grants": sent}},
+            "procs": procs if procs is not None else [
+                {"proc": "replica0", "status": "fresh",
+                 "detail": {"shed": shed, "results": 0}},
+                {"proc": "replica1", "status": "fresh",
+                 "detail": {"shed": 0, "results": sent}}]}
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def record(self, event, **detail):
+        self.events.append((event, detail))
+
+
+class TestSlo:
+    def test_burn_alert_fires_on_transition_naming_offender(self):
+        rec = _Recorder()
+        tracker = SloTracker(window_s=12.0, burn=4.0, recorder=rec)
+        # Overload storm: half of everything decided is shed — error
+        # fraction 0.5 >> 4x the 1% availability budget.
+        alerts, fired_at = [], None
+        for i in range(14):
+            alerts = tracker.observe(_slo_doc(shed=10 * i, sent=10 * i),
+                                     now=T0 + i)
+            if alerts:
+                fired_at = i
+                break
+        assert alerts, "burn alert never fired"
+        assert alerts[0]["objective"] == "reply_availability"
+        assert alerts[0]["worst"] == "replica0"
+        assert alerts[0]["event"] == "slo_burn"
+        assert rec.events and rec.events[0][0] == "slo_burn"
+        assert rec.events[0][1]["objective"] == "reply_availability"
+        # Transition-only: the storm keeps burning across further
+        # observations at the beat cadence — no NEW alert fires.
+        for i in range(fired_at + 1, fired_at + 4):
+            assert tracker.observe(
+                _slo_doc(shed=10 * i, sent=10 * i), now=T0 + i) == []
+        st = {e["objective"]: e for e in tracker.status()}
+        assert st["reply_availability"]["burning"]
+
+    def test_recovery_clears_burning(self):
+        tracker = SloTracker(window_s=12.0, burn=4.0,
+                             recorder=_Recorder())
+        for i in range(14):
+            tracker.observe(_slo_doc(shed=10 * i, sent=10 * i),
+                            now=T0 + i)
+        # Flat counters: no new decisions, no windowed error, no burn.
+        for i in range(14, 30):
+            tracker.observe(_slo_doc(shed=130, sent=130), now=T0 + i)
+        st = {e["objective"]: e for e in tracker.status()}
+        assert not st["reply_availability"]["burning"]
+        # Recovery re-arms the transition: a second storm re-fires.
+        fired = []
+        for i in range(30, 48):
+            fired = tracker.observe(
+                _slo_doc(shed=130 + 10 * (i - 29), sent=130), now=T0 + i)
+            if fired:
+                break
+        assert fired and fired[0]["objective"] in ("reply_availability",
+                                                   "shed_rate")
+
+    def test_no_alert_without_traffic(self):
+        tracker = SloTracker(window_s=12.0, recorder=_Recorder())
+        for i in range(20):
+            assert tracker.observe(_slo_doc(shed=0, sent=0),
+                                   now=T0 + i) == []
+        for e in tracker.status():
+            assert not e["burning"]
+
+    def test_fenced_procs_never_rank_as_offender(self):
+        procs = [{"proc": "replica0", "status": "fenced",
+                  "detail": {"shed": 100, "results": 0}},
+                 {"proc": "replica1", "status": "fresh",
+                  "detail": {"shed": 1, "results": 9}}]
+        tracker = SloTracker(window_s=12.0, recorder=_Recorder())
+        alert = None
+        for i in range(14):
+            got = tracker.observe(
+                _slo_doc(shed=10 * i, sent=10 * i, procs=procs),
+                now=T0 + i)
+            if got:
+                alert = got[0]
+                break
+        assert alert is not None and alert["worst"] == "replica1"
+
+    def test_default_objectives_mirror_gates(self, monkeypatch):
+        monkeypatch.delenv("DBM_SLO_AVAIL", raising=False)
+        objs = {o.name: o for o in default_objectives()}
+        assert objs["reply_availability"].budget == pytest.approx(0.01)
+        assert objs["shed_rate"].budget == pytest.approx(0.25)
+        monkeypatch.setenv("DBM_SLO_AVAIL", "0.999")
+        objs = {o.name: o for o in default_objectives()}
+        assert objs["reply_availability"].budget == pytest.approx(0.001)
+
+    def test_queue_wait_objective_reads_buckets(self):
+        objs = {o.name: o for o in default_objectives()}
+        doc = {"cluster": {"histograms": {"sched.queue_wait_s": _hist(
+            [1.0, 30.0, 60.0, 120.0], [50, 80, 90, 100], 100, 0.0)}},
+            "procs": []}
+        bad, total = objs["queue_wait_p99"].cumulative(doc)
+        assert (bad, total) == (10.0, 100.0)   # 10 waits over 60s
+
+
+# -------------------------------------------------------------------- dbmtop
+
+
+def _load_dbmtop():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "dbmtop.py")
+    spec = importlib.util.spec_from_file_location("_dbmtop_under_test",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestDbmtop:
+    def _statedir(self, tmp_path):
+        d = str(tmp_path)
+        for rid in (0, 1):
+            r = Registry()
+            r.counter("sched.results_sent").inc(20 + rid)
+            r.gauge("sched.queue_depth").set(rid)
+            r.histogram("sched.queue_wait_s").observe(0.01)
+            _publish(d, "replica", rid, f"i{rid}", r)
+        r = Registry()
+        r.ewma("miner.nonces_per_s").observe(5000.0)
+        _publish(d, "miner", 77, "im", r)
+        return d
+
+    def test_render_rows_and_slo_bars(self, tmp_path):
+        top = _load_dbmtop()
+        doc = top.one_doc(self._statedir(tmp_path))
+        lines = top.render(doc)
+        text = "\n".join(lines)
+        assert "replica0" in text and "replica1" in text
+        assert "miner77" in text
+        assert "slo reply_availability" in text
+        assert "3/3 fresh" in text
+        # Cluster totals line carries the exact counter sum.
+        assert "results 41" in text
+
+    def test_once_json_mode(self, tmp_path, capsys):
+        top = _load_dbmtop()
+        d = self._statedir(tmp_path)
+        assert top.main([d, "--once", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert {p["proc"] for p in doc["procs"]} == \
+            {"replica0", "replica1", "miner77"}
+        assert doc["cluster"]["counters"]["sched.results_sent"] == 41
+        assert {e["objective"] for e in doc["slo"]} == \
+            {"reply_availability", "queue_wait_p99", "shed_rate"}
+
+    def test_missing_statedir(self, tmp_path, capsys):
+        top = _load_dbmtop()
+        assert top.main([str(tmp_path / "nope"), "--once"]) == 2
+        capsys.readouterr()
