@@ -1209,6 +1209,206 @@ def _mesh_probe() -> dict:
     }
 
 
+def _devloop_probe(data: str) -> dict:
+    """Device-resident span loop A/B (ISSUE 19, ``detail.devloop``):
+    devloop on vs off on the jnp tier, interleaved order-swapped rounds,
+    recording nonces/s, device launches per span, host transfers per
+    span, and host-crossing BYTES per span for each leg — plus the
+    difficulty-mode time-to-first-hit A/B (``DBM_DEVLOOP_UNTIL``) and a
+    pallas-interpret counters/parity leg.
+
+    Geometry: RAGGED sub count (767 = nine pow2 terms) at a small
+    batch, batch-aligned lower inside one decimal block. That is where
+    the devloop's structural win lives on CPU: the stock path's span
+    rate is already device-looped per sub, so the on/off delta is the
+    per-launch dispatch+force cost times the pow2 term count (9 -> 1
+    launches/span) plus the fetch collapse (9 triples -> one 20-byte
+    carry). At the headline bench geometry (one pow2-aligned sub) the
+    two paths are within noise BY CONSTRUCTION — this probe exists
+    because the headline number cannot show the launch amortization.
+    The span estimates ~4 ms on this box — 2x the est-seconds mouse
+    floor (``_DEVLOOP_MIN_EST_S``), and a silent mid-measurement
+    fallback to stock cannot hide: it would surface as the ON leg's
+    ``launches_per_span`` rising above 1.
+
+    Timing is PAIRED, not blocked: each round runs one ON span and one
+    OFF span back to back (order swapped every round) and the legs
+    accumulate their own wall time, so sub-second CPU frequency/
+    co-tenant drift cancels instead of landing on whichever leg ran
+    second — blocked 1 s legs measured the box's drift envelope
+    (±20 %) on this 2-core container, paired spans hold +-4 %.
+
+    The pallas leg runs under interpret on CPU, where timing is
+    meaningless — it records the launch/transfer/byte counters and
+    bit-parity only (the chip chain's devloop-smoke stage is where the
+    pallas rate measurement lives). ``DBM_BENCH_DEVLOOP=0`` skips;
+    ``DBM_BENCH_DEVLOOP_PAIRS`` (default 120) sets the paired reps.
+    """
+    import jax
+    from statistics import median
+
+    from distributed_bitcoinminer_tpu.bitcoin.hash import hash_op, scan_min
+    from distributed_bitcoinminer_tpu.models import NonceSearcher
+    from distributed_bitcoinminer_tpu.models.miner_model import \
+        _MET_LAUNCHES
+
+    batch = 64
+    nsub = 767                           # 9 pow2 terms: the ragged case
+    count = batch * nsub
+    lower = ((10_000_000 // batch) + 1) * batch   # aligned, one 10^7 block
+    upper = lower + count - 1
+    pairs = max(8, _int_env("DBM_BENCH_DEVLOOP_PAIRS", 120))
+
+    def counted(searcher, fn):
+        """(fn result, launches, host fetch calls, host bytes) — counts
+        ``model.device_launches`` deltas and wraps ``jax.device_get`` to
+        tally fetch calls and the bytes they move."""
+        fetches, nbytes = [0], [0]
+        orig_get = jax.device_get
+
+        def counting_get(x):
+            fetches[0] += 1
+            got = orig_get(x)
+            for leaf in jax.tree_util.tree_leaves(got):
+                nbytes[0] += int(getattr(leaf, "nbytes", 0) or 0)
+            return got
+
+        launches0 = _MET_LAUNCHES.value
+        jax.device_get = counting_get
+        try:
+            out = fn(searcher)
+        finally:
+            jax.device_get = orig_get
+        return out, _MET_LAUNCHES.value - launches0, fetches[0], nbytes[0]
+
+    knobs = ("DBM_DEVLOOP", "DBM_DEVLOOP_UNTIL", "DBM_DEVLOOP_PALLAS")
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        # One searcher per leg, each warmed under BOTH knob states (the
+        # ON searcher warms its stock signatures too, so even an
+        # est-floor fallback could never compile mid-measurement). A
+        # fresh knob read happens at every dispatch, so toggling the env
+        # var re-routes the SAME searcher — separate searchers keep the
+        # ON leg's rate EWMA unpolluted by stock spans.
+        os.environ.pop("DBM_DEVLOOP_UNTIL", None)
+        os.environ.pop("DBM_DEVLOOP_PALLAS", None)
+        searchers = {}
+        for name, knob in (("on", "1"), ("off", "0")):
+            os.environ["DBM_DEVLOOP"] = knob
+            s = NonceSearcher(data, batch=batch, tier="jnp")
+            s.search(lower, upper)                    # warm
+            searchers[name] = s
+        os.environ["DBM_DEVLOOP"] = "0"
+        searchers["on"].search(lower, upper)          # warm stock sigs too
+        acc = {name: {"t": 0.0, "reps": 0, "launches": 0, "fetches": 0,
+                      "nbytes": 0, "result": None} for name in ("on",
+                                                                "off")}
+        for i in range(pairs):
+            order = ("on", "off") if i % 2 == 0 else ("off", "on")
+            for name in order:
+                os.environ["DBM_DEVLOOP"] = "1" if name == "on" else "0"
+                a = acc[name]
+
+                def one(s, _a=a):
+                    t0 = time.perf_counter()
+                    _a["result"] = s.search(lower, upper)
+                    return time.perf_counter() - t0
+
+                dt, launches, fetches, nbytes = counted(
+                    searchers[name], one)
+                a["t"] += dt
+                a["reps"] += 1
+                a["launches"] += launches
+                a["fetches"] += fetches
+                a["nbytes"] += nbytes
+
+        jnp_ab = {}
+        for name in ("on", "off"):
+            a = acc[name]
+            jnp_ab[name] = {
+                "nps": round(count * a["reps"] / a["t"], 1),
+                "launches_per_span": round(a["launches"] / a["reps"], 3),
+                "host_transfers_per_span": round(
+                    a["fetches"] / a["reps"], 3),
+                "host_bytes_per_span": round(a["nbytes"] / a["reps"], 1),
+            }
+        on_nps = jnp_ab["on"]["nps"]
+        off_nps = jnp_ab["off"]["nps"]
+        jnp_ab["devloop_speedup"] = (round(on_nps / off_nps, 3)
+                                     if off_nps else None)
+        jnp_ab["parity"] = (
+            tuple(int(v) for v in acc["on"]["result"])
+            == tuple(int(v) for v in acc["off"]["result"]))
+
+        # Difficulty-mode TTFH A/B: a target that first qualifies ~1.5%
+        # into the span. The devloop's on-device first-hit predicate
+        # exits after ~hit/batch sub-windows; the stock path must finish
+        # the whole 2^18-lane leading pow2 sub before its host-side
+        # check sees the hit. Warmed with target 0 (never hits — the
+        # full-scan compile) so the timed call replays the signature.
+        os.environ["DBM_DEVLOOP"] = "1"
+        hit = lower + 8_000
+        target = hash_op(data, hit) + 1
+        until_ab = {"hit_offset": hit - lower}
+        u_results = {}
+        for name, knob in (("on", "1"), ("off", "0")):
+            os.environ["DBM_DEVLOOP_UNTIL"] = knob
+            s = NonceSearcher(data, batch=batch, tier="jnp")
+            s.search_until(lower, upper, 0)           # warm
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                u_results[name] = s.search_until(lower, upper, target)
+                times.append(time.perf_counter() - t0)
+            until_ab[name] = {"ttfh_s": round(median(times), 5),
+                              "found": bool(u_results[name][2])}
+        on_t = until_ab["on"]["ttfh_s"]
+        off_t = until_ab["off"]["ttfh_s"]
+        until_ab["ttfh_speedup"] = round(off_t / on_t, 3) if on_t else None
+        until_ab["parity"] = (
+            tuple(int(v) for v in u_results["on"][:2])
+            == tuple(int(v) for v in u_results["off"][:2])
+            and u_results["on"][2] == u_results["off"][2])
+
+        # Pallas leg, interpret on CPU: tiny geometry (16 grid steps),
+        # counters + bit-parity vs the host oracle only.
+        os.environ.pop("DBM_DEVLOOP_UNTIL", None)
+        p_batch, p_nsub = 128, 15
+        p_lower = ((1_000_000 // p_batch) + 1) * p_batch
+        p_upper = p_lower + p_batch * p_nsub - 1
+        oracle = scan_min(data, p_lower, p_upper)
+        pallas_ab = {"batch": p_batch, "nsub": p_nsub}
+        for name, knob in (("on", "1"), ("off", "0")):
+            os.environ["DBM_DEVLOOP"] = "1" if name == "on" else "0"
+            os.environ["DBM_DEVLOOP_PALLAS"] = knob
+            s = NonceSearcher(data, batch=p_batch, tier="pallas")
+            s.search(p_lower, p_upper)                # warm
+            got, launches, fetches, nbytes = counted(
+                s, lambda s_: s_.search(p_lower, p_upper))
+            pallas_ab[name] = {
+                "launches_per_span": launches,
+                "host_transfers_per_span": fetches,
+                "host_bytes_per_span": nbytes,
+                "parity": tuple(int(v) for v in got) == oracle,
+            }
+        return {
+            "schema": "devloop_ab_v1",
+            "batch": batch,
+            "nsub": nsub,
+            "span_nonces": count,
+            "pairs": pairs,
+            "jnp": jnp_ab,
+            "until": until_ab,
+            "pallas_interpret": pallas_ab,
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main() -> int:
     # Transport datapath modes (ISSUE 17) FIRST — both are socket-only
     # measurements with no JAX involved, and the child leg IS the timed
@@ -1502,6 +1702,19 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             batch_detail = {"batch": {"error": repr(exc)[:300]}}
 
+    # Device-resident span loop A/B (ISSUE 19): devloop on/off at the
+    # ragged-sub geometry where the launch amortization is visible, with
+    # per-span launch/transfer/byte counters, the difficulty-mode TTFH
+    # A/B, and the pallas-interpret counters leg. CPU-only and isolated
+    # like the other compute probes; DBM_BENCH_DEVLOOP=0 skips it.
+    devloop_detail = {}
+    if not on_accel and "jnp" in results \
+            and _str_env("DBM_BENCH_DEVLOOP", "1") != "0":
+        try:
+            devloop_detail = {"devloop": _devloop_probe(data)}
+        except Exception as exc:  # noqa: BLE001
+            devloop_detail = {"devloop": {"error": repr(exc)[:300]}}
+
     # Control-plane load curve (ISSUE 11): tenants vs p50/p99/shed-rate
     # for 1 vs 4 scheduler replicas on detnet with instant miners —
     # no JAX compute involved, so it runs on any box. DBM_BENCH_LOAD=0
@@ -1598,6 +1811,7 @@ def main() -> int:
         **pipeline_detail,
         **qos_detail,
         **batch_detail,
+        **devloop_detail,
         **load_detail,
         **adapt_detail,
         **replay_detail,

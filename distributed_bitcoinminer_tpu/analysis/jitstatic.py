@@ -53,8 +53,11 @@ NAME = "jit-static"
 #: Registered quantizers: calls whose RESULT is bounded by the callee's
 #: contract (see module docstring). Matched on the dotted name's last
 #: segment so both ``pow2_bucket(n)`` and ``search.pow2_bucket(n)``
-#: resolve.
-BOUNDED_CALLS = {"pow2_bucket"}
+#: resolve. ``devloop_cap`` (ISSUE 19) is the devloop span drivers'
+#: static iteration backstop — pow2-quantized by delegation to
+#: pow2_bucket, so the in-kernel loop bound's signature set stays at
+#: log2(max subs) while the LIVE count rides a traced operand.
+BOUNDED_CALLS = {"pow2_bucket", "devloop_cap"}
 
 SCOPE_PREFIXES = (
     "distributed_bitcoinminer_tpu/ops/",

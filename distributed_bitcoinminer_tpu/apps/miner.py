@@ -595,6 +595,12 @@ class MinerWorker:
             handle = searcher.dispatch(msg.lower, msg.upper)
             dispatch_s = time.monotonic() - t0
             self._span_dispatched(span, dispatch_s)
+            # Devloop spans (ISSUE 19) collapse the per-sub launch chain
+            # into one in-kernel loop; the span carries the loop's sub
+            # count so the trace stays honest about work done per launch.
+            subs = getattr(searcher, "last_dispatch_subs", None)
+            if span is not None and subs is not None:
+                span["subs"] = subs
             return searcher, handle, dispatch_s, span
         return searcher, None, 0.0, span
 

@@ -24,7 +24,9 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..bitcoin.hash import MAX_U64
-from ..ops.search import (pow2_bucket, search_span, search_span_segmin,
+from ..ops import searchop
+from ..ops.search import (devloop_cap, devloop_span, devloop_span_until,
+                          pow2_bucket, search_span, search_span_segmin,
                           search_span_until)
 from ..ops.sha256_host import sha256_midstate
 from ..ops.sha256_jnp import build_hoist, build_tail_template
@@ -37,6 +39,50 @@ _SENTINEL = (0xFFFFFFFF, 0xFFFFFFFF)
 #: several launches (keeps the pow2 signature set small and one launch's
 #: compile bounded). 64 rows is ~8x the default coalescer lane cap.
 _BATCH_ROWS_MAX = 64
+#: Devloop amortization floor (ISSUE 19): a chunk whose estimated scan
+#: time is below this falls back to the stock batched path — a mouse
+#: chunk's win comes from the coalescer, not from saving a handful of
+#: already-cheap launches, and keeping mice on the stock path keeps the
+#: coalescer population (and its metrics) unchanged. Sized to the mouse
+#: boundary: a 2^14-lane mouse estimates ~1.5 ms on the CPU tier, so
+#: 2 ms keeps every mouse on the stock path while 2^16-lane-and-up
+#: chunks — where the launch amortization is already measurable
+#: (``detail.devloop``) — stay on the loop.
+_DEVLOOP_MIN_EST_S = 2e-3
+#: EWMA blend for the devloop nonces/s estimate the floor divides by.
+_DEVLOOP_EWMA = 0.3
+
+
+def devloop_enabled() -> bool:
+    """Whether argmin dispatch uses the device-resident span loop
+    (ISSUE 19). Default ON: one launch per 10^k block, one <= 20-byte
+    carry fetch per span. ``DBM_DEVLOOP=0`` restores the stock pow2
+    sub-dispatch path bit-for-bit (the knob-off matrix leg pins it)."""
+    return _str_env("DBM_DEVLOOP", "1") != "0"
+
+
+def devloop_until_enabled() -> bool:
+    """Whether difficulty mode ALSO rides the device-resident loop.
+    Staged separately (``DBM_DEVLOOP_UNTIL``, default OFF): until's
+    early-exit/prefix-release semantics are the subtler contract, so it
+    follows the argmin rollout rather than leading it."""
+    return _str_env("DBM_DEVLOOP_UNTIL", "0") == "1"
+
+
+class _DevloopHandle:
+    """Opaque :meth:`NonceSearcher.dispatch` handle for a devloop span:
+    the single device-resident carry (plus accounting the finalize side
+    and the trace plane read). ``nbytes`` is the size of the ONE host
+    transfer finalize will perform."""
+
+    __slots__ = ("carry", "subs", "lanes", "nbytes", "t0")
+
+    def __init__(self, carry, subs: int, lanes: int, nbytes: int, t0: float):
+        self.carry = carry
+        self.subs = subs          # in-kernel sub-window count (trace "subs")
+        self.lanes = lanes        # valid lanes covered (nps estimate)
+        self.nbytes = nbytes      # bytes fetched at finalize
+        self.t0 = t0              # dispatch wall-clock start
 
 # Model-layer metrics (utils/metrics.py): midstate/hoist cache behavior
 # (a miss pays the scalar hoist build; production traffic should be nearly
@@ -168,6 +214,13 @@ class NonceSearcher:
     unrolled register-resident Mosaic kernel); None reads ``DBM_COMPUTE``.
     """
 
+    #: Whether :meth:`dispatch` may serve the devloop shape. The mesh
+    #: model has its own devloop plumbing (one launch per block across
+    #: the whole mesh); the plain sharded model inherits this dispatch,
+    #: where a single-device devloop would silently ignore the mesh —
+    #: it pins False.
+    _supports_devloop = True
+
     def __init__(self, data: str, batch: int = 1 << 20,
                  tier: str | None = None, hoist: bool | None = None):
         self.data = data
@@ -193,6 +246,15 @@ class NonceSearcher:
         #: earlier sub hits (its scan is idempotent).
         self._until_lookahead = (
             1 if _str_env("DBM_UNTIL_PIPELINE", "1") != "0" else 0)
+        #: Devloop nonces/s EWMA (est-seconds fallback floor); None until
+        #: the first devloop span finalizes — the first span always takes
+        #: the devloop path and seeds the estimate.
+        self._devloop_nps: float | None = None
+        #: In-kernel sub-window count of the LAST dispatch — the trace
+        #: plane stamps it as the span's ``subs`` field (ISSUE 19
+        #: satellite: a devloop span reports one launch, not zero-width
+        #: per-sub phases). None when the last dispatch was stock-shaped.
+        self.last_dispatch_subs: int | None = None
 
     def _plan_block(self, d: int, k: int, block_base: int, lo: int, hi: int) -> _BlockPlan:
         top = str(block_base)[: d - k] if d > k else ""
@@ -327,9 +389,188 @@ class NonceSearcher:
         """
         if lower > upper:
             raise ValueError("empty range")
+        self.last_dispatch_subs = None
+        if self._devloop_ok():
+            lanes = upper - lower + 1
+            if self._devloop_eligible(lanes):
+                return self._devloop_dispatch(
+                    list(self.plan(lower, upper)), lanes)
         return [(plan.base, triple)
                 for plan in self.plan(lower, upper)
                 for triple in self.search_block(plan)]
+
+    # ---------------------------------------------- devloop dispatch shape
+
+    def _devloop_ok(self) -> bool:
+        """Devloop gating: the knob, the model's support flag, and — on
+        the pallas tier — the separate persistent-grid rollout knob
+        (``DBM_DEVLOOP_PALLAS``; with it off a pallas searcher keeps the
+        stock path rather than silently switching tiers)."""
+        if not (devloop_enabled() and self._supports_devloop):
+            return False
+        if self.tier == "pallas":
+            from ..ops.sha256_pallas import devloop_pallas_enabled
+            return devloop_pallas_enabled()
+        return True
+
+    def _devloop_eligible(self, lanes: int) -> bool:
+        """Est-seconds amortization floor (see ``_DEVLOOP_MIN_EST_S``).
+        Unknown throughput (first span) estimates optimistically: the
+        span seeds the EWMA either way."""
+        if self._devloop_nps is None or self._devloop_nps <= 0:
+            return True
+        return lanes / self._devloop_nps >= _DEVLOOP_MIN_EST_S
+
+    def _devloop_dispatch(self, plans: list, lanes: int) -> _DevloopHandle:
+        """Chain every block of the span through the device-resident
+        loop: ONE jitted launch per 10^k block (vs one per pow2 sub),
+        the searchop carry threading device-side across blocks. Nothing
+        is forced here; :meth:`finalize` fetches the final 20-byte
+        carry once."""
+        import time
+
+        t0 = time.monotonic()
+        carry = searchop.carry_init()
+        subs = 0
+        for plan in plans:
+            i0 = (plan.lo_i // self.batch) * self.batch
+            nsub = (plan.hi_i - i0 + 1 + self.batch - 1) // self.batch
+            cap = devloop_cap(nsub)
+            subs += nsub
+            base_hi = np.uint32(plan.base >> 32)
+            base_lo = np.uint32(plan.base & 0xFFFFFFFF)
+            _MET_LAUNCHES.inc()
+            if self.tier == "pallas":
+                from ..ops.sha256_pallas import pallas_devloop_span
+                with _observe_launch(("pallas_devloop_span", plan.rem,
+                                      plan.k, self.batch, cap)):
+                    carry = pallas_devloop_span(
+                        np.asarray(plan.midstate, dtype=np.uint32),
+                        plan.template, carry,
+                        np.uint32(i0), np.uint32(plan.lo_i),
+                        np.uint32(plan.hi_i), np.int32(nsub),
+                        base_hi, base_lo,
+                        rem=plan.rem, k=plan.k, batch=self.batch,
+                        cap=cap, platform=self._platform(),
+                        hoist=plan.hoist_ops)
+            else:
+                with _observe_launch(("devloop_span", plan.rem, plan.k,
+                                      self.batch, cap)):
+                    carry = devloop_span(
+                        np.asarray(plan.midstate, dtype=np.uint32),
+                        plan.template, carry,
+                        np.uint32(i0), np.uint32(plan.lo_i),
+                        np.uint32(plan.hi_i), np.int32(nsub),
+                        base_hi, base_lo, plan.hoist_ops,
+                        rem=plan.rem, k=plan.k, batch=self.batch,
+                        cap=cap)  # dbmlint: ok[jit-static] devloop_cap pow2
+        self.last_dispatch_subs = subs
+        return _DevloopHandle(carry, subs, lanes,
+                              4 * searchop.CARRY_WORDS, t0)
+
+    def _devloop_finalize(self, handle: _DevloopHandle,
+                          lower: int) -> tuple[int, int]:
+        """Force a devloop span: ONE device_get of the 5-word carry."""
+        import time
+
+        import jax
+
+        words = jax.device_get(handle.carry)
+        elapsed = time.monotonic() - handle.t0
+        if elapsed > 0 and handle.lanes:
+            nps = handle.lanes / elapsed
+            self._devloop_nps = (
+                nps if self._devloop_nps is None else
+                (1 - _DEVLOOP_EWMA) * self._devloop_nps
+                + _DEVLOOP_EWMA * nps)
+        return searchop.decode_argmin(words, lower)
+
+    def _devloop_until_ok(self) -> bool:
+        """Whether difficulty mode rides the devloop: its own staging
+        knob AND the argmin devloop gate (``DBM_DEVLOOP=0`` is the one
+        master off-switch). On the pallas tier the stock until path is
+        kept — not a silent jnp-devloop swap — until the persistent-grid
+        knob opts in."""
+        return (devloop_until_enabled() and devloop_enabled()
+                and self._supports_devloop
+                and (self.tier == "jnp" or self._devloop_ok()))
+
+    def _devloop_until_chain(self, plans: list, t_hi: int, t_lo: int,
+                             use_pallas: bool) -> np.ndarray:
+        """Chain a span's blocks through the devloop difficulty launch
+        and fetch the final 8-word carry ONCE. Early exit needs no host
+        round-trip: a hit sets ``carry[0]`` on device and every later
+        launch in the chain sees it and falls straight through (jnp:
+        while cond goes false at step 0; pallas: live grid clamps to
+        one step)."""
+        import jax
+
+        carry = searchop.until_carry_init()
+        subs = 0
+        for plan in plans:
+            i0 = (plan.lo_i // self.batch) * self.batch
+            nsub = (plan.hi_i - i0 + 1 + self.batch - 1) // self.batch
+            cap = devloop_cap(nsub)
+            subs += nsub
+            base_hi = np.uint32(plan.base >> 32)
+            base_lo = np.uint32(plan.base & 0xFFFFFFFF)
+            _MET_LAUNCHES.inc()
+            if use_pallas:
+                from ..ops.sha256_pallas import pallas_devloop_span_until
+                with _observe_launch(("pallas_devloop_until", plan.rem,
+                                      plan.k, self.batch, cap)):
+                    carry = pallas_devloop_span_until(
+                        np.asarray(plan.midstate, dtype=np.uint32),
+                        plan.template, carry,
+                        np.uint32(i0), np.uint32(plan.lo_i),
+                        np.uint32(plan.hi_i),
+                        np.uint32(t_hi), np.uint32(t_lo),
+                        np.int32(nsub), base_hi, base_lo,
+                        rem=plan.rem, k=plan.k, batch=self.batch,
+                        cap=cap, platform=self._platform(),
+                        hoist=plan.hoist_ops)
+            else:
+                with _observe_launch(("devloop_span_until", plan.rem,
+                                      plan.k, self.batch, cap)):
+                    carry = devloop_span_until(
+                        np.asarray(plan.midstate, dtype=np.uint32),
+                        plan.template, carry,
+                        np.uint32(i0), np.uint32(plan.lo_i),
+                        np.uint32(plan.hi_i),
+                        np.uint32(t_hi), np.uint32(t_lo),
+                        np.int32(nsub), base_hi, base_lo,
+                        plan.hoist_ops,
+                        rem=plan.rem, k=plan.k, batch=self.batch,
+                        cap=cap)  # dbmlint: ok[jit-static] devloop_cap pow2
+        self.last_dispatch_subs = subs
+        return jax.device_get(carry)
+
+    def _devloop_search_until(self, lower: int, upper: int,
+                              target: int) -> tuple[int, int, bool]:
+        """Difficulty mode over the device-resident chain: one fetch per
+        span, exact prefix-release semantics (the carry's first-hit
+        plane keeps the LOWEST qualifying 64-bit nonce across chained
+        folds). A pallas fault — at dispatch or at the fetch — latches
+        the sticky until degradation and reruns the identical chain on
+        the jnp tier (idempotent scan, same contract as the stock
+        path's per-sub fallback)."""
+        t_hi, t_lo = target >> 32, target & 0xFFFFFFFF
+        plans = list(self.plan(lower, upper))
+        use_pallas = (self.tier == "pallas" and not self._until_degraded)
+        try:
+            words = self._devloop_until_chain(plans, t_hi, t_lo,
+                                              use_pallas)
+        except Exception:
+            if not use_pallas:
+                raise
+            self._degrade_until("pallas devloop until tier")
+            words = self._devloop_until_chain(plans, t_hi, t_lo, False)
+        found, f_nonce, best_hash, best_nonce = searchop.decode_until(
+            words, lower)
+        if found:
+            from ..bitcoin.hash import hash_op
+            return (hash_op(self.data, f_nonce), f_nonce, True)
+        return (best_hash, best_nonce, False)
 
     def finalize(self, results: list, lower: int) -> tuple[int, int]:
         """Force dispatched block results and merge on host in ascending
@@ -340,9 +581,15 @@ class NonceSearcher:
         ~65 ms each over this image's axon tunnel, which capped the bench
         at 229M nonces/s while the identical dispatch measured 420M
         (round-3 finding).
+
+        A devloop handle (ISSUE 19) short-circuits the merge entirely:
+        the device already holds the span's argmin in a 5-word carry, so
+        the fetch is 20 bytes and the "merge" is a decode.
         """
         import jax
 
+        if isinstance(results, _DevloopHandle):
+            return self._devloop_finalize(results, lower)
         fetched = jax.device_get([triple for _, triple in results])
         best_hash, best_nonce = MAX_U64, lower
         seen = False
@@ -675,6 +922,8 @@ class NonceSearcher:
         """
         if lower > upper:
             raise ValueError("empty range")
+        if self._devloop_until_ok():
+            return self._devloop_search_until(lower, upper, target)
         t_hi, t_lo = target >> 32, target & 0xFFFFFFFF
         best_hash, best_nonce, seen = MAX_U64, lower, False
         for plan in self.plan(lower, upper):

@@ -12,15 +12,19 @@ from __future__ import annotations
 import numpy as np
 
 from ..bitcoin.hash import MAX_U64
+from ..ops import searchop
+from ..ops.search import devloop_cap
 from ..parallel.mesh_search import (device_spans, make_mesh,
-                                    mesh_carry_init, mesh_search_span,
+                                    mesh_carry_init, mesh_devloop_span,
+                                    mesh_devloop_span_until,
+                                    mesh_search_span,
                                     mesh_search_span_until,
                                     mesh_until_carry_init,
                                     sharded_search_span,
                                     sharded_search_span_until)
 from ..parallel.partition import device_windows, pow2_subs
 from ..utils.trace import observe_launch as _observe_launch
-from .miner_model import _MET_LAUNCHES, NonceSearcher
+from .miner_model import _DevloopHandle, _MET_LAUNCHES, NonceSearcher
 
 
 class ShardedNonceSearcher(NonceSearcher):
@@ -38,6 +42,11 @@ class ShardedNonceSearcher(NonceSearcher):
     it overlaps single-device ones (pinned by
     tests/test_pipeline.py::test_sharded_dispatch_finalize_equivalence).
     """
+
+    #: Inherits NonceSearcher.dispatch, where the single-device devloop
+    #: launch would silently scan on ONE device of the mesh — pinned off;
+    #: the mesh plane below carries its own whole-mesh devloop.
+    _supports_devloop = False
 
     def __init__(self, data: str, batch: int = 1 << 20, mesh=None,
                  tier: str | None = None, hoist: bool | None = None):
@@ -138,6 +147,52 @@ class MeshNonceSearcher(ShardedNonceSearcher):
     are not what the pod is for).
     """
 
+    #: Re-enabled (the sharded parent pins it off): this model's own
+    #: dispatch/search_until own the devloop shape — one whole-mesh
+    #: launch per 10^k block over the same stripe windows.
+    _supports_devloop = True
+
+    def _mesh_devloop_block(self, plan, carry, t_hi=None, t_lo=None,
+                            tier: str | None = None):
+        """ONE whole-mesh devloop launch covering the block: every
+        device walks its stripe window's sub-steps inside the kernel
+        (vs one launch per pow2 sub in :meth:`_mesh_block`). Returns
+        ``(new_carry, steps)`` — steps is the in-kernel sub count the
+        trace plane reports."""
+        tier = tier if tier is not None else self.tier
+        i0_d, lo_d, hi_d, steps = device_windows(
+            plan.lo_i, plan.hi_i, self.n_devices, self.batch)
+        cap = devloop_cap(steps)
+        ops = {"carry": carry,
+               "midstate": np.asarray(plan.midstate, dtype=np.uint32),
+               "template": plan.template,
+               "i0_d": i0_d, "lo_d": lo_d, "hi_d": hi_d,
+               "nsub": np.int32(steps),
+               "base_hi": np.uint32(plan.base >> 32),
+               "base_lo": np.uint32(plan.base & 0xFFFFFFFF)}
+        if plan.hoist_ops is not None:
+            ops["hoist"] = plan.hoist_ops
+        _MET_LAUNCHES.inc()
+        if t_hi is not None:
+            ops["target_hi"] = t_hi
+            ops["target_lo"] = t_lo
+            with _observe_launch(("mesh_devloop_until", tier, plan.rem,
+                                  plan.k, self.batch, cap,
+                                  self.n_devices)):
+                carry = mesh_devloop_span_until(
+                    ops, mesh=self.mesh, rem=plan.rem, k=plan.k,
+                    batch=self.batch, cap=cap,
+                    tier=tier)  # dbmlint: ok[jit-static] two-valued jnp|pallas set (ctor-validated) + devloop_cap pow2
+        else:
+            with _observe_launch(("mesh_devloop_span", tier, plan.rem,
+                                  plan.k, self.batch, cap,
+                                  self.n_devices)):
+                carry = mesh_devloop_span(
+                    ops, mesh=self.mesh, rem=plan.rem, k=plan.k,
+                    batch=self.batch, cap=cap,
+                    tier=tier)  # dbmlint: ok[jit-static] two-valued jnp|pallas set (ctor-validated) + devloop_cap pow2
+        return carry, steps
+
     def _mesh_block(self, plan, carry, t_hi=None, t_lo=None,
                     tier: str | None = None):
         """Chain one block's pow2 sub-launches onto ``carry`` over the
@@ -179,13 +234,38 @@ class MeshNonceSearcher(ShardedNonceSearcher):
 
     def dispatch(self, lower: int, upper: int):
         """Enqueue the whole span as one carry chain; the handle is the
-        final carry (a single replicated device value)."""
+        final carry (a single replicated device value). Under the
+        devloop (ISSUE 19) each block is ONE whole-mesh launch instead
+        of a pow2-sub chain; the per-span host cost — one 20-byte carry
+        fetch — is unchanged, only the launch count drops."""
         if lower > upper:
             raise ValueError("empty range")
+        self.last_dispatch_subs = None
+        if self._devloop_ok():
+            lanes = upper - lower + 1
+            if self._devloop_eligible(lanes):
+                return self._mesh_devloop_dispatch(lower, upper, lanes)
         carry = mesh_carry_init()
         for plan in self.plan(lower, upper):
             carry = self._mesh_block(plan, carry)
         return carry
+
+    def _mesh_devloop_dispatch(self, lower: int, upper: int,
+                               lanes: int) -> _DevloopHandle:
+        """Whole-mesh devloop span: one launch per block, the searchop
+        carry (the SAME 5-word layout the stock mesh chain threads)
+        riding replicated across launches."""
+        import time
+
+        t0 = time.monotonic()
+        carry = mesh_carry_init()
+        subs = 0
+        for plan in self.plan(lower, upper):
+            carry, steps = self._mesh_devloop_block(plan, carry)
+            subs += steps
+        self.last_dispatch_subs = subs
+        return _DevloopHandle(carry, subs, lanes,
+                              4 * searchop.CARRY_WORDS, t0)
 
     def finalize(self, handle, lower: int) -> tuple[int, int]:
         """ONE host fetch per span: the 5-word carry. The ``seen`` word
@@ -194,6 +274,8 @@ class MeshNonceSearcher(ShardedNonceSearcher):
         like an empty scan)."""
         import jax
 
+        if isinstance(handle, _DevloopHandle):
+            return self._devloop_finalize(handle, lower)
         v = jax.device_get(handle)
         if not int(v[4]):
             return (MAX_U64, lower)
@@ -218,6 +300,8 @@ class MeshNonceSearcher(ShardedNonceSearcher):
 
         if lower > upper:
             raise ValueError("empty range")
+        if self._devloop_until_ok():
+            return self._mesh_devloop_search_until(lower, upper, target)
         t_hi = np.uint32(target >> 32)
         t_lo = np.uint32(target & 0xFFFFFFFF)
         carry = mesh_until_carry_init()
@@ -244,3 +328,48 @@ class MeshNonceSearcher(ShardedNonceSearcher):
             return ((int(v[3]) << 32) | int(v[4]),
                     (int(v[5]) << 32) | int(v[6]), False)
         return (MAX_U64, lower, False)
+
+    def _mesh_devloop_until_chain(self, plans, t_hi, t_lo,
+                                  tier: str) -> np.ndarray:
+        """Chain every block's devloop difficulty launch and fetch the
+        8-word carry ONCE per span (vs once per block on the stock
+        chain — the devloop's found-carry pass-through makes the
+        per-block fetch unnecessary: launches after a hit fall straight
+        through on device)."""
+        import jax
+
+        carry = mesh_until_carry_init()
+        subs = 0
+        for plan in plans:
+            carry, steps = self._mesh_devloop_block(plan, carry, t_hi,
+                                                    t_lo, tier=tier)
+            subs += steps
+        self.last_dispatch_subs = subs
+        return jax.device_get(carry)
+
+    def _mesh_devloop_search_until(self, lower: int, upper: int,
+                                   target: int) -> tuple[int, int, bool]:
+        """Difficulty mode on the devloop chain: one fetch per span.
+        A pallas fault anywhere in the chain latches the sticky until
+        degradation and reruns the whole span on the jnp tier (the scan
+        is idempotent, same recovery rule as the stock per-block
+        path)."""
+        t_hi = np.uint32(target >> 32)
+        t_lo = np.uint32(target & 0xFFFFFFFF)
+        plans = list(self.plan(lower, upper))
+        tier = "jnp" if self._until_degraded else self.tier
+        try:
+            words = self._mesh_devloop_until_chain(plans, t_hi, t_lo,
+                                                   tier)
+        except Exception:
+            if tier != "pallas":
+                raise
+            self._degrade_until("mesh pallas devloop until tier")
+            words = self._mesh_devloop_until_chain(plans, t_hi, t_lo,
+                                                   "jnp")
+        found, f_nonce, best_hash, best_nonce = searchop.decode_until(
+            words, lower)
+        if found:
+            from ..bitcoin.hash import hash_op
+            return (hash_op(self.data, f_nonce), f_nonce, True)
+        return (best_hash, best_nonce, False)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .searchop import fold_argmin, fold_until
 from .sha256_host import SHA256_K
 from .sha256_jnp import (_compress, compress_tail_hoisted, digit_contrib,
                          ensure_varying, lex_argmin)
@@ -34,6 +35,23 @@ def pow2_bucket(n: int) -> int:
     this helper as bounded, so call sites stay machine-checked.
     """
     return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
+def devloop_cap(n: int) -> int:
+    """Static iteration cap for a device-resident span launch (ISSUE 19):
+    smallest power of two >= ``n``.
+
+    The devloop drivers take the LIVE sub-window count as a traced
+    operand — the loop bound is ``min(nsub, cap)`` — and only this cap
+    as a jit static, so the signature set stays bounded at log2(max
+    subs) exactly like batched-dispatch row counts, with no masked
+    overscan (the loop simply stops at ``nsub``). The cap doubles as
+    the bounded-iterations backstop for the ``until`` while_loop. The
+    dbmlint jit-static analyzer recognizes calls to this helper as
+    bounded (same contract as :func:`pow2_bucket`, which it delegates
+    to).
+    """
+    return pow2_bucket(n)
 
 
 def _hash_lanes(midstate, template, i, rem: int, k: int, vary_axes=(),
@@ -193,6 +211,151 @@ def search_span_until(midstate, template, i0, lo_i, hi_i, target_hi,
                            target_hi, target_lo,
                            rem=rem, k=k, batch=batch, nbatches=nbatches,
                            hoist=hoist)
+
+
+# --------------------------------------------------------------------------
+# ISSUE 19 device-resident span loop (jnp tier).
+#
+# The stock path above runs ONE launch PER pow2 sub-window and merges the
+# per-sub triples on the host. The devloop drivers below iterate every
+# sub-window of a block inside a single launch with a DYNAMIC loop bound
+# (``min(nsub, cap)`` — ``nsub`` is a traced operand, only the pow2
+# ``cap`` is a jit static, see :func:`devloop_cap`), and fold the block's
+# merged candidate straight into the searchop carry
+# (:mod:`ops.searchop`). A whole span — any number of 10^k blocks —
+# chains carries device-side and costs exactly one jitted launch per
+# block and ONE carry fetch per span.
+
+
+def devloop_scan(midstate, template, i0, lo_i, hi_i, nsub, *, rem: int,
+                 k: int, batch: int, cap: int, vary_axes=(), hoist=None):
+    """Dynamic-bound span scan: ``min(nsub, cap)`` sub-windows of
+    ``batch`` lanes from ``i0``, masked to [lo_i, hi_i].
+
+    Same per-step math as :func:`span_scan_body` (strict-less fold,
+    earliest index keeps ties); the bound is traced, so the fori_loop
+    lowers to a while_loop — no masked overscan beyond ``nsub``.
+    Returns the (best_hi, best_lo, best_i) uint32 triple.
+    """
+    lane = jnp.arange(batch, dtype=jnp.uint32)
+    bound = jnp.minimum(jnp.asarray(nsub, dtype=jnp.int32),
+                        jnp.int32(cap))
+
+    def step(j, best):
+        base = i0 + j.astype(jnp.uint32) * np.uint32(batch)
+        i = base + lane
+        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
+                                 vary_axes=vary_axes, base=base, span=batch,
+                                 hoist=hoist)
+        valid = (i >= lo_i) & (i <= hi_i)
+        hi_h = jnp.where(valid, hi_h, _MAX_U32)
+        lo_h = jnp.where(valid, lo_h, _MAX_U32)
+        idx = jnp.where(valid, i, _MAX_U32)
+        c_hi, c_lo, c_i = lex_argmin(hi_h, lo_h, idx)
+        b_hi, b_lo, b_i = best
+        # Strict less => the earlier batch keeps ties (Go first-seen-wins).
+        better = (c_hi < b_hi) | ((c_hi == b_hi) & (c_lo < b_lo))
+        return (jnp.where(better, c_hi, b_hi),
+                jnp.where(better, c_lo, b_lo),
+                jnp.where(better, c_i, b_i))
+
+    init = (jnp.uint32(_MAX_U32),) * 3
+    if vary_axes:
+        init = tuple(ensure_varying(x, vary_axes) for x in init)
+    return jax.lax.fori_loop(0, bound, step, init)
+
+
+@functools.partial(jax.jit, static_argnames=("rem", "k", "batch", "cap"))
+def devloop_span(midstate, template, carry, i0, lo_i, hi_i, nsub,
+                 base_hi, base_lo, hoist=None, *, rem: int, k: int,
+                 batch: int, cap: int):
+    """Jitted single-device devloop block launch: scan ``nsub``
+    sub-windows on device and fold the result into the 5-word argmin
+    carry (:mod:`ops.searchop` layout — the carry holds the GLOBAL
+    64-bit nonce, ``base_hi``/``base_lo`` are the block base). Returns
+    the updated carry, a device value the caller threads into the next
+    block's launch or fetches once per span."""
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+    carry = jnp.asarray(carry, dtype=jnp.uint32)
+    b_hi, b_lo, b_i = devloop_scan(midstate, template, i0, lo_i, hi_i,
+                                   nsub, rem=rem, k=k, batch=batch,
+                                   cap=cap, hoist=hoist)
+    return fold_argmin(carry, b_hi, b_lo, b_i, base_hi, base_lo)
+
+
+def devloop_until_scan(midstate, template, i0, lo_i, hi_i, target_hi,
+                       target_lo, nsub, found_prev, *, rem: int, k: int,
+                       batch: int, cap: int, vary_axes=(), hoist=None):
+    """Dynamic-bound difficulty scan with the on-device first-hit
+    predicate in the while condition: exits at the first sub-window
+    holding a qualifying hash, at ``nsub`` sub-windows, at the ``cap``
+    backstop — or immediately when ``found_prev`` says an earlier block
+    of the chain already hit (the carry passes through untouched).
+
+    Same per-step math and first-*qualifying*-nonce semantics as
+    :func:`span_until_body`; returns the same uint32
+    ``(found, f_idx, best_hi, best_lo, best_idx)`` scalars.
+    """
+    lane = jnp.arange(batch, dtype=jnp.uint32)
+    bound = jnp.minimum(jnp.asarray(nsub, dtype=jnp.int32),
+                        jnp.int32(cap))
+    live = jnp.asarray(found_prev, dtype=jnp.uint32) == 0
+
+    def cond(carry):
+        j, f_idx, _best = carry
+        return (j < bound) & (f_idx == _MAX_U32) & live
+
+    def body(carry):
+        j, f_idx, best = carry
+        base = i0 + j.astype(jnp.uint32) * np.uint32(batch)
+        i = base + lane
+        hi_h, lo_h = _hash_lanes(midstate, template, i, rem, k,
+                                 vary_axes=vary_axes, base=base, span=batch,
+                                 hoist=hoist)
+        valid = (i >= lo_i) & (i <= hi_i)
+        hi_h = jnp.where(valid, hi_h, _MAX_U32)
+        lo_h = jnp.where(valid, lo_h, _MAX_U32)
+        idx = jnp.where(valid, i, _MAX_U32)
+        # Running argmin fallback.
+        c_hi, c_lo, c_i = lex_argmin(hi_h, lo_h, idx)
+        b_hi, b_lo, b_i = best
+        better = (c_hi < b_hi) | ((c_hi == b_hi) & (c_lo < b_lo))
+        best = (jnp.where(better, c_hi, b_hi),
+                jnp.where(better, c_lo, b_lo),
+                jnp.where(better, c_i, b_i))
+        # First qualifying lane in this batch (lowest nonce wins).
+        qual = valid & ((hi_h < target_hi)
+                        | ((hi_h == target_hi) & (lo_h < target_lo)))
+        q_idx = jnp.min(jnp.where(qual, i, _MAX_U32))
+        return (j + 1, q_idx, best)
+
+    init = (jnp.int32(0), jnp.uint32(_MAX_U32),
+            (jnp.uint32(_MAX_U32),) * 3)
+    if vary_axes:
+        init = jax.tree.map(lambda x: ensure_varying(x, vary_axes), init)
+    j, f_idx, best = jax.lax.while_loop(cond, body, init)
+    found = (f_idx != _MAX_U32).astype(jnp.uint32)
+    return found, f_idx, best[0], best[1], best[2]
+
+
+@functools.partial(jax.jit, static_argnames=("rem", "k", "batch", "cap"))
+def devloop_span_until(midstate, template, carry, i0, lo_i, hi_i,
+                       target_hi, target_lo, nsub, base_hi, base_lo,
+                       hoist=None, *, rem: int, k: int, batch: int,
+                       cap: int):
+    """Jitted single-device devloop difficulty block launch: early-exit
+    scan + fold into the 8-word until carry. A chain of these across a
+    span's blocks stops doing work the moment one block hits (the next
+    launches see ``carry[0]`` set and fall straight through), so the
+    whole span costs one fetch regardless of where the hit lands."""
+    midstate = jnp.asarray(midstate, dtype=jnp.uint32)
+    template = jnp.asarray(template, dtype=jnp.uint32)
+    carry = jnp.asarray(carry, dtype=jnp.uint32)
+    found, f_idx, b_hi, b_lo, b_i = devloop_until_scan(
+        midstate, template, i0, lo_i, hi_i, target_hi, target_lo, nsub,
+        carry[0], rem=rem, k=k, batch=batch, cap=cap, hoist=hoist)
+    return fold_until(carry, f_idx, b_hi, b_lo, b_i, base_hi, base_lo)
 
 
 def segmin_rows(hi_h, lo_h, idx, seg, num_segments: int):

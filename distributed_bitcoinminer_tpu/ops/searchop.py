@@ -1,0 +1,164 @@
+"""Search-op seam: carry codec + merge semiring behind one small protocol.
+
+ISSUE 19 moves the span loop on device, which forces the question "what
+IS a search op?" into one place: a search op is (a) a carry layout —
+the few uint32 words of running state a launch consumes and emits, (b)
+a fold — how one launch's merged candidate enters that carry, and (c) a
+decode — how the host reads the final carry back into Python values.
+The argmin op (minimal (hash, nonce)) and the first-hit/difficulty op
+(first *qualifying* nonce, argmin fallback) are the two instances; the
+ROADMAP's op-agnostic item starts from this interface instead of a
+rewrite.
+
+The codec here is PR 14's mesh carry, verbatim — ``parallel/
+mesh_search.py`` re-exports these names (``mesh_carry_init`` et al.) so
+existing imports and the on-chip-validated jaxprs are unchanged. The
+device-resident span drivers (``ops/search.py`` jnp tier, ``ops/
+sha256_pallas.py`` pallas tier) thread the same words, so a whole span
+— any number of 10^k blocks and sub-windows — crosses the PCIe/ICI
+boundary as ONE <= 32-byte vector (20 bytes for argmin), fetched once
+at finalize.
+
+Merge rule (both ops): full lexicographic strict-less on
+(hash_hi, hash_lo, nonce_hi, nonce_lo) among seen candidates — minimal
+hash, earliest nonce on ties, exactly the host finalize walk and the Go
+scan's first-seen-wins strict ``<`` (ref: bitcoin/miner/miner.go:54-58).
+The full lex (not hash-only) matters because chain order is not nonce
+order: mesh stripe windows interleave lane coverage across chained
+folds, so the tie-break must be explicit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_MAX_U32 = np.uint32(0xFFFFFFFF)
+_MAX_U64 = 0xFFFFFFFFFFFFFFFF
+
+#: Carry layouts (uint32 words).
+#: argmin: [hash_hi, hash_lo, nonce_hi, nonce_lo, seen]
+#: until:  [found, f_nonce_hi, f_nonce_lo] + the argmin layout.
+CARRY_WORDS = 5
+UNTIL_CARRY_WORDS = 8
+
+
+def carry_init() -> np.ndarray:
+    """Neutral argmin carry: nothing seen yet."""
+    return np.array([0xFFFFFFFF] * 4 + [0], dtype=np.uint32)
+
+
+def until_carry_init() -> np.ndarray:
+    """Neutral difficulty carry: no hit, nothing seen."""
+    return np.array([0, 0xFFFFFFFF, 0xFFFFFFFF]
+                    + [0xFFFFFFFF] * 4 + [0], dtype=np.uint32)
+
+
+def lex_less(a, b):
+    """Strict lexicographic ``a < b`` over matching leading words of two
+    uint32 vectors (element 0 most significant)."""
+    out = a[-1] < b[-1]
+    for i in range(len(a) - 2, -1, -1):
+        out = (a[i] < b[i]) | ((a[i] == b[i]) & out)
+    return out
+
+
+def global_nonce(base_hi, base_lo, idx):
+    """64-bit ``base + idx`` as a (hi, lo) uint32 pair (idx < 2^32; the
+    unsigned-add wrap test carries into the high word)."""
+    n_lo = base_lo + idx
+    return base_hi + (n_lo < idx).astype(jnp.uint32), n_lo
+
+
+def fold_argmin(carry, m_hi, m_lo, m_idx, base_hi, base_lo):
+    """Fold one launch's merged candidate into the argmin carry."""
+    valid = ~((m_hi == _MAX_U32) & (m_lo == _MAX_U32)
+              & (m_idx == _MAX_U32))
+    n_hi, n_lo = global_nonce(base_hi, base_lo, m_idx)
+    cand = jnp.stack([m_hi, m_lo, n_hi, n_lo])
+    prev = carry[:4]
+    better = valid & ((carry[4] == 0) | lex_less(cand, prev))
+    best = jnp.where(better, cand, prev)
+    seen = jnp.where(better, jnp.uint32(1), carry[4])
+    return jnp.concatenate([best, seen[None]])
+
+
+def fold_until(carry, f_idx, b_hi, b_lo, b_idx, base_hi, base_lo):
+    """Fold one launch's first-hit lane + argmin fallback into the 8-word
+    difficulty carry.
+
+    ``f_idx`` is the window's minimal qualifying lane (MAX sentinel when
+    none): the carry keeps the lex-lower 64-bit qualifying nonce across
+    chained folds (chain order is not nonce order under interleaved
+    stripe windows, so the min — not first-write-wins — is the correct
+    rule). The argmin fallback folds exactly like :func:`fold_argmin`
+    and answers only when the whole span misses the target.
+    """
+    cand_found = f_idx != _MAX_U32
+    f_hi, f_lo = global_nonce(base_hi, base_lo, f_idx)
+    fcand = jnp.stack([f_hi, f_lo])
+    prev_f = carry[1:3]
+    f_better = cand_found & ((carry[0] == 0) | lex_less(fcand, prev_f))
+    new_f = jnp.where(f_better, fcand, prev_f)
+    new_found = jnp.maximum(carry[0], cand_found.astype(jnp.uint32))
+    tail = fold_argmin(carry[3:], b_hi, b_lo, b_idx, base_hi, base_lo)
+    return jnp.concatenate([new_found[None], new_f, tail])
+
+
+def decode_argmin(words, default_nonce: int) -> Tuple[int, int]:
+    """Host decode of a fetched argmin carry -> (best_hash, nonce).
+
+    An unseen carry (empty effective range) decodes to the MAX-hash
+    sentinel at ``default_nonce`` — the same contract as an all-invalid
+    host-merged span.
+    """
+    v = [int(x) for x in np.asarray(words).ravel()[:CARRY_WORDS]]
+    if not v[4]:
+        return _MAX_U64, int(default_nonce)
+    return (v[0] << 32) | v[1], (v[2] << 32) | v[3]
+
+
+def decode_until(words, default_nonce: int
+                 ) -> Tuple[bool, int, int, int]:
+    """Host decode of a fetched until carry ->
+    ``(found, f_nonce, best_hash, best_nonce)``. The qualifying HASH is
+    deliberately absent (the model layer recomputes that one value with
+    the host oracle — the existing contract of ``search_span_until``)."""
+    v = [int(x) for x in np.asarray(words).ravel()[:UNTIL_CARRY_WORDS]]
+    found = bool(v[0])
+    f_nonce = (v[1] << 32) | v[2]
+    best_hash, best_nonce = decode_argmin(v[3:], default_nonce)
+    return found, f_nonce, best_hash, best_nonce
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchOp:
+    """The minimal op protocol a device-resident span driver needs.
+
+    ``init`` mints the neutral host-side carry, ``fold`` runs on device
+    (jnp, inside jit/shard_map) merging one window's candidate into the
+    carry, ``decode`` reads the final fetched words on the host. The
+    span *body* (how lanes get hashed and reduced to a candidate) stays
+    with the tier — ops/search.py and ops/sha256_pallas.py — because it
+    is tier-shaped, not op-shaped; the op is everything downstream of
+    the per-window reduction.
+    """
+    name: str
+    carry_words: int
+    init: Callable[[], np.ndarray]
+    fold: Callable[..., "jnp.ndarray"]
+    decode: Callable[..., tuple]
+
+    @property
+    def nbytes(self) -> int:
+        """Size of the per-span host transfer this op costs (uint32s)."""
+        return 4 * self.carry_words
+
+
+ARGMIN_OP = SearchOp("argmin", CARRY_WORDS, carry_init,
+                     fold_argmin, decode_argmin)
+UNTIL_OP = SearchOp("until", UNTIL_CARRY_WORDS, until_carry_init,
+                    fold_until, decode_until)

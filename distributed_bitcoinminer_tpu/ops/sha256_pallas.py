@@ -48,6 +48,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .searchop import fold_argmin, fold_until
 from .sha256_host import SHA256_K
 from .sha256_jnp import (_sig0, _sig1, digit_contrib, hoist_structure,
                          lex_argmin)
@@ -126,6 +127,22 @@ def pallas_until(midstate, template, i0, lo_i, hi_i, t_hi, t_lo, *,
         interpret=interpret_on(platform),  # dbmlint: ok[jit-static] bool
         peel=peel,  # dbmlint: ok[jit-static] bool knob
         vma=vma)
+
+
+def devloop_pallas_enabled() -> bool:
+    """Whether the pallas tier serves device-resident span loops
+    (ISSUE 19 persistent grid).
+
+    Default OFF, the ``DBM_PEEL``/``DBM_COALESCE_PALLAS`` rollout
+    discipline: the devloop grid shape is interpret-validated (Mosaic
+    simulator) in tier-1 but has not had an on-chip smoke, and the
+    chip-validated kernel must stay byte-identical until one lands
+    (``scripts/chip_chain.py`` step ``devloop-smoke``). With the knob
+    off, ``DBM_DEVLOOP`` miners on the pallas tier simply keep the
+    stock per-sub dispatch path. Flip with ``DBM_DEVLOOP_PALLAS=1``
+    once chip-validated."""
+    from ..utils._env import str_env
+    return str_env("DBM_DEVLOOP_PALLAS", "0") == "1"
 
 
 def batch_enabled() -> bool:
@@ -398,9 +415,18 @@ def _peel_hoisted(scal_ref, contrib, nz, *, rem: int, k: int, nblocks: int,
     return out_a, out_b
 
 
-def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
-            nblocks: int, rows: int, until: bool = False,
-            peel: bool = False, hoisted: bool = False):
+def _kernel(scal_ref, *refs, rem: int, k: int, nblocks: int, rows: int,
+            until: bool = False, peel: bool = False, hoisted: bool = False,
+            devloop: bool = False):
+    if devloop:
+        # ISSUE 19 persistent grid: the grid is sized for the STATIC
+        # pow2 step cap, and the second scalar-prefetch operand carries
+        # the LIVE step count — steps at or past it skip the SHA body
+        # entirely (a scalar SMEM read + branch, the same skip shape as
+        # the until flag below). The scal layout is untouched, so the
+        # chip-validated kernel is byte-identical when the knob is off.
+        live_ref, *refs = refs
+    hi_ref, lo_ref, idx_ref, *extra_refs = refs
     step = pl.program_id(0)
     if until:
         # In-kernel early exit (VERDICT r3 task 2): the grid is sequential
@@ -422,8 +448,13 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
             flag_ref[0] = jnp.uint32(0)
 
         done = flag_ref[0] != jnp.uint32(0)
+        run = jnp.logical_not(done)
+        if devloop:
+            # live is clamped >= 1 by the caller, so step 0 (accumulator
+            # init + flag overwrite) always runs.
+            run = run & (step < live_ref[0])
 
-        @pl.when(jnp.logical_not(done))
+        @pl.when(run)
         def _work():
             # ``step`` rides in from the enclosing scope (a cond operand):
             # calling pl.program_id INSIDE the when-branch would put the
@@ -433,6 +464,12 @@ def _kernel(scal_ref, hi_ref, lo_ref, idx_ref, *extra_refs, rem: int, k: int,
             _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, f_ref, flag_ref,
                          step=step, rem=rem, k=k, nblocks=nblocks,
                          rows=rows, until=True, peel=peel, hoisted=hoisted)
+    elif devloop:
+        @pl.when(step < live_ref[0])
+        def _work_argmin():
+            _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, None, None,
+                         step=step, rem=rem, k=k, nblocks=nblocks,
+                         rows=rows, until=False, peel=peel, hoisted=hoisted)
     else:
         _kernel_body(scal_ref, hi_ref, lo_ref, idx_ref, None, None,
                      step=step, rem=rem, k=k, nblocks=nblocks, rows=rows,
@@ -687,7 +724,8 @@ def _out_struct(shape, vma):
 
 
 def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
-                interpret, vma, target=None, peel=False, hoist=None):
+                interpret, vma, target=None, peel=False, hoist=None,
+                live=None):
     """Shared pallas_call builder for the argmin and difficulty variants.
 
     With ``hoist`` (peeled shape only), the host-precomputed sections are
@@ -695,7 +733,14 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
     rounds 0..15 (16 per block), the rounds-16..31 constant schedule
     terms (16 per block) and, when a digit-free block exists, its full
     K[t]+W[t] precombination (64) — so the chip-validated layout of the
-    rolled kernel is byte-identical when the hoist is off."""
+    rolled kernel is byte-identical when the hoist is off.
+
+    With ``live`` (ISSUE 19 devloop), the traced live step count rides
+    as a SECOND scalar-prefetch operand — NOT appended to ``scal``, so
+    the chip-validated scal layout is unshifted — and the kernel
+    predicates each grid step on ``step < live``; ``nsteps`` is then the
+    static pow2 step cap. ``live`` is clamped to >= 1 here (step 0 must
+    run: it initializes the accumulators and the until flag)."""
     midstate = jnp.asarray(midstate, dtype=jnp.uint32).reshape(8)
     template = jnp.asarray(template, dtype=jnp.uint32)
     nblocks = template.shape[0]
@@ -715,11 +760,22 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
             parts.append(jnp.asarray(hoist["ckw"], dtype=jnp.uint32))
     scal = jnp.concatenate(parts)
 
+    devloop = live is not None
     # Accumulator BlockSpec = the whole (rows, 128) array with a constant
     # index map: always Mosaic-legal, and the revisited block stays resident
-    # in VMEM across the entire sequential grid.
-    acc_spec = pl.BlockSpec((rows, _LANES), lambda s, scal: (0, 0),
-                            memory_space=pltpu.VMEM)
+    # in VMEM across the entire sequential grid. Index maps take one
+    # positional per scalar-prefetch operand, so the devloop shape (scal +
+    # live) needs the three-arg spelling.
+    if devloop:
+        acc_spec = pl.BlockSpec((rows, _LANES), lambda s, scal, live: (0, 0),
+                                memory_space=pltpu.VMEM)
+        flag_spec = pl.BlockSpec((1,), lambda s, scal, live: (0,),
+                                 memory_space=pltpu.SMEM)
+    else:
+        acc_spec = pl.BlockSpec((rows, _LANES), lambda s, scal: (0, 0),
+                                memory_space=pltpu.VMEM)
+        flag_spec = pl.BlockSpec((1,), lambda s, scal: (0,),
+                                 memory_space=pltpu.SMEM)
     acc_shape = _out_struct((rows, _LANES), vma)
     n_out = 3 if target is None else 4
     out_specs = (acc_spec,) * n_out
@@ -727,19 +783,23 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
     if target is not None:
         # 5th output: the early-exit flag, an SMEM scalar accumulator the
         # kernel reads at every step start to skip work after a hit.
-        out_specs += (pl.BlockSpec((1,), lambda s, scal: (0,),
-                                   memory_space=pltpu.SMEM),)
+        out_specs += (flag_spec,)
         out_shapes += (_out_struct((1,), vma),)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2 if devloop else 1,
         grid=(nsteps,),
         in_specs=[],
         out_specs=out_specs,
     )
+    args = (scal,)
+    if devloop:
+        live_arr = jnp.maximum(
+            jnp.asarray(live, dtype=jnp.int32).reshape(1), jnp.int32(1))
+        args = (scal, live_arr)
     return pl.pallas_call(
         functools.partial(_kernel, rem=rem, k=k, nblocks=nblocks, rows=rows,
                           until=target is not None, peel=peel,
-                          hoisted=hoisted),
+                          hoisted=hoisted, devloop=devloop),
         out_shape=out_shapes,
         grid_spec=grid_spec,
         # Mosaic TPU simulator where this jax has it; jax 0.4.x predates
@@ -747,4 +807,145 @@ def _run_kernel(midstate, template, i0, lo_i, hi_i, *, rem, k, rows, nsteps,
         interpret=(pltpu.InterpretParams()
                    if interpret and hasattr(pltpu, "InterpretParams")
                    else bool(interpret)),
-    )(scal)
+    )(*args)
+
+
+# --------------------------------------------------------------------------
+# ISSUE 19 devloop entries: persistent grid over a whole block.
+#
+# The grid is sized once for the static pow2 sub-window CAP
+# (``pallas_geometry(batch * cap)``); the live step count — derived from
+# the TRACED ``nsub`` — rides as the second scalar-prefetch operand and
+# predicates each step, so one launch covers any live size up to the cap
+# with no masked overscan work and no per-size recompiles. The running
+# min stays in the VMEM accumulators across all grid steps (the grid IS
+# the persistent loop — sequential on TPU), and the only thing that
+# leaves the device per span is the searchop carry.
+
+
+def _devloop_live(nsub, batch: int, rows: int):
+    """Traced live grid-step count covering ``nsub * batch`` lanes."""
+    lanes = jnp.asarray(nsub, dtype=jnp.int32) * jnp.int32(batch)
+    per = jnp.int32(rows * _LANES)
+    return (lanes + per - jnp.int32(1)) // per
+
+
+def pallas_devloop_scan(midstate, template, i0, lo_i, hi_i, nsub, *,
+                        rem: int, k: int, batch: int, cap: int,
+                        platform: str, vma: tuple = (), hoist=None):
+    """Unjitted devloop argmin scan -> (best_hi, best_lo, best_i)
+    scalars; the shard_map per-device body of
+    ``parallel.mesh_search.mesh_devloop_span`` (callers are already
+    inside jit). ``batch``/``cap`` describe the sub-window geometry the
+    jnp tier uses; the kernel re-tiles the same lane range to its own
+    ``rows x 128`` steps, which is coverage-identical (everything past
+    ``hi_i`` masks to the sentinel)."""
+    rows, nsteps = pallas_geometry(batch * cap)
+    peel = peel_enabled()
+    live = _devloop_live(nsub, batch, rows)
+    hi_h, lo_h, idx = _run_kernel(
+        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
+        peel=peel, hoist=hoist if peel else None, live=live)
+    return lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
+
+
+def pallas_devloop_until_scan(midstate, template, i0, lo_i, hi_i, t_hi,
+                              t_lo, nsub, found_prev, *, rem: int, k: int,
+                              batch: int, cap: int, platform: str,
+                              vma: tuple = (), hoist=None):
+    """Unjitted devloop difficulty scan -> the
+    ``(found, f_idx, best_hi, best_lo, best_idx)`` contract of
+    :func:`pallas_search_span_until`. ``found_prev`` (the carry's found
+    word) clamps the live step count to 1 — a launch chained after a hit
+    costs one grid step instead of a block's worth (the in-launch SMEM
+    flag already handles exits WITHIN a launch)."""
+    rows, nsteps = pallas_geometry(batch * cap)
+    peel = peel_enabled()
+    live = _devloop_live(nsub, batch, rows)
+    live = jnp.where(jnp.asarray(found_prev, dtype=jnp.uint32)
+                     != jnp.uint32(0), jnp.int32(1), live)
+    hi_h, lo_h, idx, f, flag = _run_kernel(
+        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        nsteps=nsteps, interpret=interpret_on(platform), vma=vma,
+        target=(t_hi, t_lo), peel=peel, hoist=hoist if peel else None,
+        live=live)
+    f_idx = jnp.min(f.ravel())
+    found = (flag[0] != 0).astype(jnp.uint32)
+    b_hi, b_lo, b_idx = lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
+    return found, f_idx, b_hi, b_lo, b_idx
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rem", "k", "batch", "cap", "interpret", "peel"))
+def _pallas_devloop_span_jit(midstate, template, carry, i0, lo_i, hi_i,
+                             nsub, base_hi, base_lo, hoist=None, *,
+                             rem: int, k: int, batch: int, cap: int,
+                             interpret: bool, peel: bool):
+    rows, nsteps = pallas_geometry(batch * cap)
+    live = _devloop_live(nsub, batch, rows)
+    hi_h, lo_h, idx = _run_kernel(
+        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        nsteps=nsteps, interpret=interpret, vma=(), peel=peel,
+        hoist=hoist, live=live)
+    b_hi, b_lo, b_i = lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
+    carry = jnp.asarray(carry, dtype=jnp.uint32)
+    return fold_argmin(carry, b_hi, b_lo, b_i, base_hi, base_lo)
+
+
+def pallas_devloop_span(midstate, template, carry, i0, lo_i, hi_i, nsub,
+                        base_hi, base_lo, *, rem: int, k: int, batch: int,
+                        cap: int, platform: str, hoist=None):
+    """Single-device devloop block launch (pallas tier): ONE jitted
+    launch scanning the whole block's lanes and folding the merged
+    candidate into the 5-word searchop carry — the pallas twin of
+    ``ops.search.devloop_span``. Returns the updated carry device
+    value."""
+    peel = peel_enabled()
+    # Static-signature boundedness: batch is the searcher's fixed lane
+    # width and cap is devloop_cap-quantized by the model layer.
+    return _pallas_devloop_span_jit(
+        midstate, template, carry, i0, lo_i, hi_i, nsub, base_hi, base_lo,
+        hoist if peel else None, rem=rem, k=k, batch=batch,
+        cap=cap,  # dbmlint: ok[jit-static] devloop_cap-quantized pow2
+        interpret=interpret_on(platform),  # dbmlint: ok[jit-static] bool
+        peel=peel)  # dbmlint: ok[jit-static] bool knob
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rem", "k", "batch", "cap", "interpret", "peel"))
+def _pallas_devloop_until_jit(midstate, template, carry, i0, lo_i, hi_i,
+                              t_hi, t_lo, nsub, base_hi, base_lo,
+                              hoist=None, *, rem: int, k: int, batch: int,
+                              cap: int, interpret: bool, peel: bool):
+    rows, nsteps = pallas_geometry(batch * cap)
+    carry = jnp.asarray(carry, dtype=jnp.uint32)
+    live = _devloop_live(nsub, batch, rows)
+    live = jnp.where(carry[0] != jnp.uint32(0), jnp.int32(1), live)
+    hi_h, lo_h, idx, f, flag = _run_kernel(
+        midstate, template, i0, lo_i, hi_i, rem=rem, k=k, rows=rows,
+        nsteps=nsteps, interpret=interpret, vma=(), target=(t_hi, t_lo),
+        peel=peel, hoist=hoist, live=live)
+    f_idx = jnp.min(f.ravel())
+    b_hi, b_lo, b_i = lex_argmin(hi_h.ravel(), lo_h.ravel(), idx.ravel())
+    return fold_until(carry, f_idx, b_hi, b_lo, b_i, base_hi, base_lo)
+
+
+def pallas_devloop_span_until(midstate, template, carry, i0, lo_i, hi_i,
+                              t_hi, t_lo, nsub, base_hi, base_lo, *,
+                              rem: int, k: int, batch: int, cap: int,
+                              platform: str, hoist=None):
+    """Single-device devloop difficulty block launch (pallas tier): one
+    jitted launch -> updated 8-word searchop carry, the pallas twin of
+    ``ops.search.devloop_span_until``. An already-found carry clamps the
+    live grid to one step, so chained launches after a hit are ~free."""
+    peel = peel_enabled()
+    return _pallas_devloop_until_jit(
+        midstate, template, carry, i0, lo_i, hi_i, t_hi, t_lo, nsub,
+        base_hi, base_lo, hoist if peel else None, rem=rem, k=k,
+        batch=batch,
+        cap=cap,  # dbmlint: ok[jit-static] devloop_cap-quantized pow2
+        interpret=interpret_on(platform),  # dbmlint: ok[jit-static] bool
+        peel=peel)  # dbmlint: ok[jit-static] bool knob

@@ -44,7 +44,9 @@ def shard_map(f, **kw):
     """Version-portable ``jax.shard_map`` (see _SHARD_MAP_KW above)."""
     return _shard_map(f, **kw, **_SHARD_MAP_KW)
 
-from ..ops.search import span_scan_body, span_until_body
+from ..ops import searchop
+from ..ops.search import (devloop_scan, devloop_until_scan, span_scan_body,
+                          span_until_body)
 from .partition import AXIS, device_windows, mesh_specs, pow2_subs
 
 _MAX_U32 = np.uint32(0xFFFFFFFF)
@@ -52,6 +54,7 @@ _MAX_U32 = np.uint32(0xFFFFFFFF)
 __all__ = ["AXIS", "make_mesh", "device_spans", "device_windows",
            "pow2_subs", "sharded_search_span", "sharded_search_span_until",
            "mesh_search_span", "mesh_search_span_until",
+           "mesh_devloop_span", "mesh_devloop_span_until",
            "mesh_carry_init", "mesh_until_carry_init"]
 
 
@@ -237,38 +240,17 @@ def device_spans(i0: int, n_devices: int, batch: int, nbatches: int) -> np.ndarr
 # second sub covers LOWER nonces than device 1's first, so chain order
 # is not nonce order and the tie-break must be explicit.
 
-#: Carry layouts (uint32 words).
-#: argmin: [hash_hi, hash_lo, nonce_hi, nonce_lo, seen]
-#: until:  [found, f_nonce_hi, f_nonce_lo] + the argmin layout.
-CARRY_WORDS = 5
-UNTIL_CARRY_WORDS = 8
-
-
-def mesh_carry_init() -> np.ndarray:
-    """Neutral argmin carry: nothing seen yet."""
-    return np.array([0xFFFFFFFF] * 4 + [0], dtype=np.uint32)
-
-
-def mesh_until_carry_init() -> np.ndarray:
-    """Neutral difficulty carry: no hit, nothing seen."""
-    return np.array([0, 0xFFFFFFFF, 0xFFFFFFFF]
-                    + [0xFFFFFFFF] * 4 + [0], dtype=np.uint32)
-
-
-def _lex_less(a, b):
-    """Strict lexicographic ``a < b`` over matching leading words of two
-    uint32 vectors (element 0 most significant)."""
-    out = a[-1] < b[-1]
-    for i in range(len(a) - 2, -1, -1):
-        out = (a[i] < b[i]) | ((a[i] == b[i]) & out)
-    return out
-
-
-def _global_nonce(base_hi, base_lo, idx):
-    """64-bit ``base + idx`` as a (hi, lo) uint32 pair (idx < 2^32; the
-    unsigned-add wrap test carries into the high word)."""
-    n_lo = base_lo + idx
-    return base_hi + (n_lo < idx).astype(jnp.uint32), n_lo
+# The carry codec + fold semiring moved behind the SearchOp seam in
+# ops/searchop.py (ISSUE 19) — one copy shared by this mesh plane and
+# the single-device devloop drivers. The names below stay importable
+# from here (the PR 14 surface) and are byte-identical delegations.
+CARRY_WORDS = searchop.CARRY_WORDS
+UNTIL_CARRY_WORDS = searchop.UNTIL_CARRY_WORDS
+mesh_carry_init = searchop.carry_init
+mesh_until_carry_init = searchop.until_carry_init
+_lex_less = searchop.lex_less
+_global_nonce = searchop.global_nonce
+_fold_argmin = searchop.fold_argmin
 
 
 def _scan_windows(ops, *, mesh, rem, k, batch, nbatches, tier):
@@ -287,19 +269,6 @@ def _scan_windows(ops, *, mesh, rem, k, batch, nbatches, tier):
         ops["lo_d"][0], ops["hi_d"][0],
         rem=rem, k=k, batch=batch, nbatches=nbatches,
         vary_axes=(AXIS,), hoist=hoist)
-
-
-def _fold_argmin(carry, m_hi, m_lo, m_idx, base_hi, base_lo):
-    """Fold one launch's mesh-merged candidate into the argmin carry."""
-    valid = ~((m_hi == _MAX_U32) & (m_lo == _MAX_U32)
-              & (m_idx == _MAX_U32))
-    n_hi, n_lo = _global_nonce(base_hi, base_lo, m_idx)
-    cand = jnp.stack([m_hi, m_lo, n_hi, n_lo])
-    prev = carry[:4]
-    better = valid & ((carry[4] == 0) | _lex_less(cand, prev))
-    best = jnp.where(better, cand, prev)
-    seen = jnp.where(better, jnp.uint32(1), carry[4])
-    return jnp.concatenate([best, seen[None]])
 
 
 @functools.partial(
@@ -371,22 +340,119 @@ def mesh_search_span_until(operands, *, mesh: Mesh, rem: int, k: int,
                 ops["target_hi"], ops["target_lo"],
                 rem=rem, k=k, batch=batch, nbatches=nbatches,
                 vary_axes=(AXIS,), hoist=hoist)
-        carry = ops["carry"]
-        # First-hit plane: min qualifying lane across the mesh, then the
-        # lex-min qualifying 64-bit nonce across the chain.
+        # First-hit plane: min qualifying lane across the mesh
+        # (disjoint ascending spans), then the lex-min qualifying
+        # 64-bit nonce across the chain plus the argmin fallback — the
+        # searchop fold (bit-identical to the PR 14 inline version).
         g_idx = jax.lax.pmin(f_idx, AXIS)
-        cand_found = g_idx != _MAX_U32
-        f_hi, f_lo = _global_nonce(ops["base_hi"], ops["base_lo"], g_idx)
-        fcand = jnp.stack([f_hi, f_lo])
-        prev_f = carry[1:3]
-        f_better = cand_found & ((carry[0] == 0)
-                                 | _lex_less(fcand, prev_f))
-        new_f = jnp.where(f_better, fcand, prev_f)
-        new_found = jnp.maximum(carry[0], cand_found.astype(jnp.uint32))
-        # Argmin fallback plane (answers when the whole span misses).
         m_hi, m_lo, m_idx = _pmin_lex_argmin(b_hi, b_lo, b_idx)
-        tail = _fold_argmin(carry[3:], m_hi, m_lo, m_idx,
-                            ops["base_hi"], ops["base_lo"])
-        return jnp.concatenate([new_found[None], new_f, tail])
+        return searchop.fold_until(ops["carry"], g_idx, m_hi, m_lo,
+                                   m_idx, ops["base_hi"], ops["base_lo"])
+
+    return body(operands)
+
+
+# --------------------------------------------------------------------------
+# ISSUE 19 devloop plane: whole-mesh span as ONE launch per block.
+#
+# The PR 14 entries above still run one launch per pow2 sub-window
+# (carry-chained, so the host fetch already amortizes to one per span).
+# The devloop entries fold the sub-window chain INTO the launch: each
+# device walks all ``nsub`` stripe sub-windows of its block share with
+# the dynamic-bound device loop (ops/search.devloop_scan — ``nsub`` is a
+# traced replicated operand, only the pow2 ``cap`` is a jit static), so
+# a whole-mesh span costs one launch per block instead of one per sub,
+# and still exactly one carry fetch per span.
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "rem", "k", "batch", "cap", "tier"))
+def mesh_devloop_span(operands, *, mesh: Mesh, rem: int, k: int,
+                      batch: int, cap: int, tier: str = "jnp"):
+    """Device-resident whole-block mesh launch (argmin op).
+
+    ``operands`` is the PR 14 named pytree plus ``nsub`` — the live
+    per-device sub-window count (0-d, replicated; the partition-rule
+    table places scalars as replicated automatically). Per-core stripe
+    windows ``i0_d``/``lo_d``/``hi_d`` are device-sharded exactly as in
+    :func:`mesh_search_span`; each device walks its contiguous window
+    in ascending ``batch``-lane steps — the same lane->device
+    assignment and scan order the chained pow2-sub plan produced, so
+    results are bit-identical to the stock chain. Returns the updated
+    replicated carry.
+    """
+    specs = mesh_specs(operands)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(specs,),
+                       out_specs=P())
+    def body(ops):
+        hoist = ops.get("hoist")
+        if tier == "pallas":
+            from ..ops.sha256_pallas import pallas_devloop_scan
+            hi_h, lo_h, idx = pallas_devloop_scan(
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_d"][0], ops["hi_d"][0], ops["nsub"],
+                rem=rem, k=k, batch=batch, cap=cap,
+                platform=mesh.devices.flat[0].platform, vma=(AXIS,),
+                hoist=hoist)
+        else:
+            hi_h, lo_h, idx = devloop_scan(
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_d"][0], ops["hi_d"][0], ops["nsub"],
+                rem=rem, k=k, batch=batch, cap=cap,
+                vary_axes=(AXIS,), hoist=hoist)
+        m_hi, m_lo, m_idx = _pmin_lex_argmin(hi_h, lo_h, idx)
+        return searchop.fold_argmin(ops["carry"], m_hi, m_lo, m_idx,
+                                    ops["base_hi"], ops["base_lo"])
+
+    return body(operands)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "rem", "k", "batch", "cap", "tier"))
+def mesh_devloop_span_until(operands, *, mesh: Mesh, rem: int, k: int,
+                            batch: int, cap: int, tier: str = "jnp"):
+    """Device-resident whole-block mesh difficulty launch.
+
+    Each device runs the early-exiting dynamic-bound loop over its own
+    stripe sub-windows (the while predicate is device-varying — a
+    device stops at ITS first qualifying sub independently, and an
+    already-found carry short-circuits the whole loop, so chained block
+    launches after a hit cost ~no device time). Per-device windows are
+    contiguous, disjoint and scanned ascending, so each device's
+    ``f_idx`` is the minimal qualifying lane of its window and the
+    global first hit is the mesh ``pmin`` — the same exact
+    first-*qualifying*-nonce rule as :func:`mesh_search_span_until`.
+    Returns the updated replicated 8-word carry.
+    """
+    specs = mesh_specs(operands)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(specs,),
+                       out_specs=P())
+    def body(ops):
+        hoist = ops.get("hoist")
+        found_prev = ops["carry"][0]
+        if tier == "pallas":
+            from ..ops.sha256_pallas import pallas_devloop_until_scan
+            found, f_idx, b_hi, b_lo, b_idx = pallas_devloop_until_scan(
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_d"][0], ops["hi_d"][0],
+                ops["target_hi"], ops["target_lo"], ops["nsub"],
+                found_prev, rem=rem, k=k, batch=batch, cap=cap,
+                platform=mesh.devices.flat[0].platform, vma=(AXIS,),
+                hoist=hoist)
+        else:
+            found, f_idx, b_hi, b_lo, b_idx = devloop_until_scan(
+                ops["midstate"], ops["template"], ops["i0_d"][0],
+                ops["lo_d"][0], ops["hi_d"][0],
+                ops["target_hi"], ops["target_lo"], ops["nsub"],
+                found_prev, rem=rem, k=k, batch=batch, cap=cap,
+                vary_axes=(AXIS,), hoist=hoist)
+        g_idx = jax.lax.pmin(f_idx, AXIS)
+        m_hi, m_lo, m_idx = _pmin_lex_argmin(b_hi, b_lo, b_idx)
+        return searchop.fold_until(ops["carry"], g_idx, m_hi, m_lo,
+                                   m_idx, ops["base_hi"], ops["base_lo"])
 
     return body(operands)

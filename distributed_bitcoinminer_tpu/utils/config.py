@@ -63,6 +63,30 @@ Environment variables:
   same unroll is the known live-chain HBM spill.
 - ``DBM_UNTIL_PIPELINE`` (0 disables): difficulty-mode sub-dispatch
   pipelining (models.miner_model._until_block).
+- ``DBM_DEVLOOP`` (default 1; 0 restores the stock pow2 sub-dispatch
+  chain bit-for-bit — the knob-off matrix leg pins it): device-resident
+  span loop (ISSUE 19). Argmin dispatch iterates a block's sub-windows
+  INSIDE one jitted launch (ops/search.devloop_span; whole-mesh twin
+  parallel/mesh_search.mesh_devloop_span), threading a 5-word searchop
+  carry across blocks so a span costs one launch per 10^k block and ONE
+  <=20-byte host fetch at finalize. Chunks whose estimated scan time is
+  under the amortization floor (models/miner_model._DEVLOOP_MIN_EST_S)
+  keep the stock batched path, so the coalescer population is unchanged.
+- ``DBM_DEVLOOP_UNTIL`` (default 0): difficulty mode ALSO rides the
+  device-resident loop — on-device first-hit predicate in the while
+  condition (early exit without a host round-trip; an already-found
+  carry short-circuits later block launches device-side), one 32-byte
+  fetch per span, exact first-*qualifying*-nonce semantics. Staged
+  behind the argmin rollout because the early-exit/prefix-release
+  contract is the subtler one.
+- ``DBM_DEVLOOP_PALLAS`` (default 0): serve the devloop on the pallas
+  tier via the persistent grid (ops/sha256_pallas.pallas_devloop_span)
+  — running min held in VMEM accumulators across grid steps, live step
+  count as a scalar-prefetch operand. Off, a pallas searcher keeps the
+  stock per-sub path (never a silent tier switch). Interpret-validated
+  in tier-1; default off until the chip smoke
+  (scripts/chip_chain.py devloop-smoke), the ``DBM_PEEL`` /
+  ``DBM_COALESCE_PALLAS`` rollout discipline.
 - ``DBM_PIPELINE`` (0 disables) / ``DBM_PIPELINE_DEPTH``: miner-side
   dispatch pipeline (apps/miner.MinerWorker): incoming Requests land in
   a bounded local queue (depth = ``DBM_PIPELINE_DEPTH``, default 8) and
@@ -591,6 +615,13 @@ Environment variables:
   medians + the makespan ratio; publish must be within noise), plus a
   microbench of one publish and one aggregate over synthetic
   4-process registries (``publish_ms`` / ``aggregate_ms``).
+- ``DBM_BENCH_DEVLOOP`` (0 disables) / ``DBM_BENCH_DEVLOOP_PAIRS``
+  (default 120): the bench's ``detail.devloop`` A/B probe — paired
+  alternating devloop-on/off spans at a launch-bound geometry (nps +
+  launches/transfers/bytes per span + until time-to-first-hit +
+  pallas-interpret counter parity). PAIRS is the number of
+  order-swapped on/off span pairs per timing leg; paired timing holds
+  the CPU drift envelope to a few percent where blocked legs wander.
 """
 
 from __future__ import annotations

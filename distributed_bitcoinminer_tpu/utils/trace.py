@@ -71,11 +71,14 @@ _log = logging.getLogger("dbm.trace")
 #: Span phase keys a miner-side chunk span may carry, in pipeline order.
 #: Everything is seconds; ``launch``/``lanes`` (shared coalesced launch),
 #: ``compiles`` (fresh jit signatures compiled during this chunk's
-#: dispatch) and ``serial`` (blocking-path chunk) are the non-phase
-#: extras. The wire dict draws from exactly these keys — a fixed
-#: vocabulary so the exporter and the golden-format test can pin keys.
+#: dispatch), ``serial`` (blocking-path chunk) and ``subs`` (in-kernel
+#: sub-window count of a device-resident devloop span, ISSUE 19 — a
+#: devloop chunk reports ONE dispatch phase plus this count instead of
+#: zero-width per-sub dispatch/force pairs) are the non-phase extras.
+#: The wire dict draws from exactly these keys — a fixed vocabulary so
+#: the exporter and the golden-format test can pin keys.
 SPAN_PHASES = ("queue_s", "dispatch_s", "wait_s", "force_s", "gap_s")
-SPAN_EXTRAS = ("launch", "lanes", "compiles", "serial")
+SPAN_EXTRAS = ("launch", "lanes", "compiles", "serial", "subs")
 
 
 def enabled() -> bool:
@@ -531,6 +534,8 @@ def _span_events(trace_dict: dict, base_us: int, t0_us: int,
         if ev.get("launch") is not None:
             sargs["launch"] = ev["launch"]
             sargs["lanes"] = ev.get("lanes")
+        if ev.get("subs") is not None:
+            sargs["subs"] = ev["subs"]
         if ev.get("slow"):
             sargs["slow"] = ev["slow"]
         # Layout order differs from the vocabulary order: gap_s is the

@@ -46,12 +46,15 @@ _HIGHER = (
     "nps", "value", "vs_baseline", "admitted_per_s", "speedup",
     "rate_gain", "dispatch_reduction", "efficiency", "throughput",
     "completed", "hit_ratio", "gain", "admitted_ratio",
+    "devloop_speedup", "ttfh_speedup",
 )
 _LOWER = (
     "p50_s", "p99_s", "p50", "p99", "cpu_s_per_request", "makespan_s",
     "latency_s", "latency", "shed_rate", "regression", "compile_s",
     "elapsed_s", "overhead", "dispatches_per_mouse", "timed_s",
-    "queue_wait_s", "shed_delta",
+    "queue_wait_s", "shed_delta", "ttfh_s", "until_ttfh_s",
+    "launches_per_span", "dispatches_per_span",
+    "host_transfers_per_span", "host_bytes_per_span",
 )
 #: Path segments that are configuration/noise, never metrics: the walk
 #: prunes the whole subtree.

@@ -159,6 +159,24 @@ def _validate_tune(out: str, rc: int) -> str | None:
     return None
 
 
+def _validate_devloop_smoke(out: str, rc: int) -> str | None:
+    """devloop-smoke is hardware evidence for the device-resident span
+    loop (ISSUE 19): the jnp legs must be bit-exact ON CHIP with the
+    one-launch-per-block counter contract holding; the pallas candidate
+    leg is informational and never gates (the DBM_DEVLOOP_PALLAS flip
+    is decided from the log, like bench-peel's precondition)."""
+    if rc != 0:
+        return f"exit {rc}"
+    from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
+    if not any(f"platform={p}" in out for p in CHIP_PLATFORMS):
+        return "ran off-chip (platform line not a chip)"
+    for leg in ("devloop argmin bit-exact", "one launch per block",
+                "devloop until bit-exact", "devloop_vs_stock="):
+        if leg not in out:
+            return f"missing leg: {leg}"
+    return None
+
+
 def _validate_e2e(out: str, rc: int) -> str | None:
     if rc != 0:
         return f"exit {rc}"
@@ -210,6 +228,12 @@ STAGES = [
      3600, _validate_tune, _DEFAULT_ENV),
     ("e2e", [PY, os.path.join(_SCRIPTS, "chip_e2e.py")], 1800,
      _validate_e2e, _DEFAULT_ENV),
+    # Device-resident span loop evidence (ISSUE 19): jnp devloop legs
+    # bit-exact on chip + the launch-counter contract + the on-chip
+    # devloop-vs-stock rate A/B; the pallas-devloop candidate leg in the
+    # same log is what a DBM_DEVLOOP_PALLAS default flip is decided from.
+    ("devloop-smoke", [PY, os.path.join(_SCRIPTS, "devloop_chip_smoke.py")],
+     900, _validate_devloop_smoke, _DEFAULT_ENV),
     # The peel-candidate bench: only after the smoke proved the peeled
     # kernel bit-exact ON CHIP (skipped — recorded as such — otherwise).
     # Its artifact is the rate evidence for flipping peel_enabled's

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""On-chip smoke for the device-resident span loop (ISSUE 19).
+
+Runs the devloop dispatch path against the stock path and the host
+oracle on the default backend: argmin bit-exactness, the one-launch-
+per-block counter contract, until (``DBM_DEVLOOP_UNTIL``) hit + miss
+legs, an informational pallas-devloop candidate leg, and an on-chip
+devloop-vs-stock rate A/B at the wide-batch geometry. Exit 0 = every
+gating leg bit-exact; nonzero = failure (error printed).
+
+Off-chip the correctness legs run fine on the CPU backend (the pallas
+candidate under the Mosaic interpreter); the rate A/B is skipped —
+a CPU ratio is ``bench.py detail.devloop``'s job, with drift-paired
+timing this one-shot cannot afford.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    import jax
+
+    from distributed_bitcoinminer_tpu.bitcoin.hash import scan_min, \
+        scan_until
+    from distributed_bitcoinminer_tpu.models import NonceSearcher
+    from distributed_bitcoinminer_tpu.models.miner_model import \
+        _MET_LAUNCHES
+    from distributed_bitcoinminer_tpu.utils.config import (
+        apply_jax_platform_env)
+
+    # Honor JAX_PLATFORMS=cpu for off-chip runs (utils.config: a bare
+    # jax.devices() hangs forever when the tunnel is blackholed).
+    apply_jax_platform_env()
+
+    # The legs manage the devloop knobs themselves; inherited pins would
+    # silently turn the stock baselines into devloop-vs-devloop.
+    knobs = ("DBM_DEVLOOP", "DBM_DEVLOOP_UNTIL", "DBM_DEVLOOP_PALLAS")
+    prior = {k: os.environ.pop(k, None) for k in knobs}
+    try:
+        print(f"platform={jax.devices()[0].platform}", flush=True)
+        data = "cmu440"
+        lo, hi = 2_000_000_000, 2_000_009_999
+
+        # Argmin: devloop vs stock vs host oracle, plus the counter
+        # contract — exactly one model.device_launches per 10^k block.
+        os.environ["DBM_DEVLOOP"] = "1"
+        s = NonceSearcher(data, batch=8192, tier="jnp")
+        blocks = len(list(s.plan(lo, hi)))
+        t0 = time.time()
+        l0 = _MET_LAUNCHES.value
+        got = s.search(lo, hi)
+        launches = _MET_LAUNCHES.value - l0
+        print(f"tiny search: {time.time() - t0:.1f}s", flush=True)
+        want = scan_min(data, lo, hi)
+        os.environ["DBM_DEVLOOP"] = "0"
+        stock = s.search(lo, hi)
+        if got != want or stock != want:
+            print(f"MISMATCH: devloop={got} stock={stock} oracle={want}")
+            return 1
+        print("devloop argmin bit-exact vs stock + oracle", flush=True)
+        if launches != blocks:
+            print(f"LAUNCH COUNT: {launches} launches for {blocks} blocks")
+            return 1
+        print(f"one launch per block ({launches}/{blocks})", flush=True)
+
+        # Until: devloop chain vs oracle, hit + miss legs. The miss leg
+        # exercises the full bounded-iterations backstop and the argmin
+        # fallback decode; the hit leg the on-device first-hit exit.
+        os.environ["DBM_DEVLOOP"] = "1"
+        os.environ["DBM_DEVLOOP_UNTIL"] = "1"
+        target = 1 << 56
+        got_u = s.search_until(lo, hi, target)
+        want_u = scan_until(data, lo, hi, target)
+        got_m = s.search_until(lo, lo + 999, 1)      # unreachable target
+        want_m = scan_until(data, lo, lo + 999, 1)
+        if got_u != want_u or got_m != want_m:
+            print(f"UNTIL MISMATCH: hit {got_u} != {want_u} or "
+                  f"miss {got_m} != {want_m}")
+            return 1
+        print("devloop until bit-exact vs oracle (hit + miss legs)",
+              flush=True)
+        os.environ.pop("DBM_DEVLOOP_UNTIL", None)
+
+        # Pallas devloop CANDIDATE (DBM_DEVLOOP_PALLAS rollout knob):
+        # informational, never gates — the flip to default-on is decided
+        # from this log, the validated jnp legs above are the evidence
+        # chain. Off-chip this runs under the Mosaic interpreter.
+        try:
+            os.environ["DBM_DEVLOOP_PALLAS"] = "1"
+            sp = NonceSearcher(data, batch=8192, tier="pallas")
+            t0 = time.time()
+            gp = sp.search(lo, lo + 4095)
+            wp = scan_min(data, lo, lo + 4095)
+            if gp != wp:
+                print(f"pallas devloop candidate MISMATCH: {gp} != {wp}")
+            else:
+                print(f"pallas devloop candidate ok "
+                      f"({time.time() - t0:.1f}s)", flush=True)
+        except Exception as exc:  # noqa: BLE001 — candidate only
+            print(f"pallas devloop candidate error: {exc!r}"[:400],
+                  flush=True)
+        finally:
+            os.environ.pop("DBM_DEVLOOP_PALLAS", None)
+
+        from distributed_bitcoinminer_tpu.utils.config import CHIP_PLATFORMS
+        if jax.devices()[0].platform not in CHIP_PLATFORMS:
+            print("rate leg skipped off-chip", flush=True)
+            return 0
+
+        # On-chip rate A/B at the wide-batch bench geometry: the axon
+        # tunnel charges ~65 ms per host force, so the per-block launch
+        # collapse should show directly here (BENCH_r03's overlapped-vs-
+        # serial gap is the same overhead family).
+        lo, hi = 2_000_000_000, 2_000_000_000 + (1 << 26) - 1
+        rates = {}
+        for name, knob in (("devloop", "1"), ("stock", "0")):
+            os.environ["DBM_DEVLOOP"] = knob
+            sw = NonceSearcher(data, batch=1 << 20, tier="jnp")
+            warm = sw.search(lo, hi)
+            t0 = time.time()
+            timed = sw.search(lo, hi)
+            dt = time.time() - t0
+            if warm != timed:
+                print(f"RATE LEG NONDETERMINISM ({name}): {warm} != {timed}")
+                return 1
+            rates[name] = (hi - lo + 1) / dt / 1e6
+            print(f"rate[{name}]={rates[name]:.1f}M nonces/s ({dt:.2f}s)",
+                  flush=True)
+        print(f"devloop_vs_stock={rates['devloop'] / rates['stock']:.3f}",
+              flush=True)
+        return 0
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    os._exit(rc)

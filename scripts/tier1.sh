@@ -306,13 +306,17 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # shape (no publisher objects, no metrics_* blobs, no identity
     # stamps — the bit-for-bit stock contract test_rollup.py's
     # knob-off tests assert) with test_rollup.py in the module list.
+    # ISSUE 19 addition: DBM_DEVLOOP=0 pins the stock pow2 sub-dispatch
+    # chain (one launch + one fetched triple per sub — the bit-for-bit
+    # pre-devloop dispatch shape test_devloop.py's parity pins assert)
+    # with test_devloop.py in the module list.
     timeout -k 10 "$matrix_budget" env JAX_PLATFORMS=cpu \
         DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
         DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 DBM_MESH=0 \
         DBM_CAPTURE=0 DBM_VERIFY=0 DBM_MMSG=0 DBM_WIRE_FAST=0 \
-        DBM_ROLLUP=0 \
+        DBM_ROLLUP=0 DBM_DEVLOOP=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
@@ -320,7 +324,7 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
         tests/test_trace.py tests/test_plane_split.py \
         tests/test_adapt.py tests/test_capture.py tests/test_verify.py \
         tests/test_wire.py tests/test_transport_fast.py \
-        tests/test_rollup.py \
+        tests/test_rollup.py tests/test_devloop.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}

@@ -283,9 +283,15 @@ def test_difficulty_and_oversize_chunks_never_coalesce():
     # Neither the target chunk nor the oversize chunk rode a batch: at
     # most the three small argmin chunks coalesced.
     assert _counter("miner.chunks_coalesced") - before <= 3
-    # Every chunk still launched (the oversize one on the stock
-    # single-chunk path: its 1000-nonce span is its own dispatches).
-    assert _counter("model.device_launches") - before_launches >= 5
+    # Every chunk still launched. The solo oversize chunk rides the
+    # devloop when enabled (ISSUE 19): one launch per 10^k block
+    # instead of one per pow2 sub, so the floor drops by one there
+    # (the tier-1 matrix leg re-runs this with DBM_DEVLOOP=0 and pins
+    # the stock floor).
+    from distributed_bitcoinminer_tpu.models.miner_model import \
+        devloop_enabled
+    floor = 4 if devloop_enabled() else 5
+    assert _counter("model.device_launches") - before_launches >= floor
 
 
 def test_no_batch_api_degrades_in_order():

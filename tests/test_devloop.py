@@ -1,0 +1,302 @@
+"""Device-resident span loop (ISSUE 19): bit-exactness, knob-off
+parity, early-exit semantics, and the dbmcheck leg.
+
+The devloop replaces the host-side sub-dispatch chain with one jitted
+launch per 10^k block (running (hash, nonce) min threaded as a device
+carry, one <= 20-byte host fetch per span). These tests pin:
+
+- argmin bit-exactness vs the host oracle AND the stock path over a
+  rem x k x range grid (unaligned bounds, block crossings, tiny tails);
+- until (``DBM_DEVLOOP_UNTIL=1``) exact first-*qualifying*-nonce
+  semantics — equal to the exhaustive host scan even when the on-device
+  predicate exits early, including multi-qualifier and miss
+  (argmin-fallback) ranges;
+- ``DBM_DEVLOOP=0`` is bit-for-bit stock: stock handle shape, stock
+  launch count, stock results (the tier1.sh matrix leg runs the whole
+  suite this way);
+- the est-seconds mouse floor routes sub-floor chunks to the stock
+  path (and the trace ``subs`` stamp follows the route taken);
+- the pallas persistent-grid devloop (``DBM_DEVLOOP_PALLAS=1``) under
+  the Mosaic interpreter — slow-marked and grid-step budgeted like
+  tests/test_pallas.py;
+- the mesh devloop: ONE whole-mesh launch per block, parity with the
+  stock mesh path and the host oracle;
+- the dbmcheck leg: the real MinerWorker pipeline holds every
+  invariant when the miner-side searcher is devloop-shaped (opaque
+  single-launch handle + ``last_dispatch_subs`` stamping).
+
+Compile budget: jnp signatures are (rem, k, batch, cap) — the grid
+reuses batch=64 and a handful of caps so the file stays a few fresh
+signatures, not a recompile storm.
+"""
+
+import pytest
+
+from distributed_bitcoinminer_tpu.analysis.schedcheck.scenario import (
+    oracle_min)
+from distributed_bitcoinminer_tpu.analysis.schedcheck.scenarios import (
+    _FakeSearcher as _StockFakeSearcher)
+from distributed_bitcoinminer_tpu.bitcoin.hash import (hash_op, scan_min,
+                                                       scan_until)
+from distributed_bitcoinminer_tpu.models import (MeshNonceSearcher,
+                                                 NonceSearcher,
+                                                 ShardedNonceSearcher)
+from distributed_bitcoinminer_tpu.models.miner_model import (
+    _MET_LAUNCHES, _DevloopHandle)
+from distributed_bitcoinminer_tpu.parallel import make_mesh
+
+# Two rem classes (data length shifts the tail-block layout) x ranges
+# hitting several 10^k classes, unaligned bounds, block crossings, and
+# a sub-batch tail.
+GRID_DATA = ("cmu440", "x" * 21)
+GRID_RANGES = (
+    (0, 4095),          # aligned start, k ladder from zero
+    (997, 3001),        # unaligned bounds, crosses 10^3 boundaries
+    (9_990, 10_250),    # crosses the 10^4 block boundary
+    (12_000, 12_030),   # sub-batch tail inside one block
+)
+
+
+def _on(monkeypatch, until=False, pallas=False):
+    monkeypatch.setenv("DBM_DEVLOOP", "1")
+    monkeypatch.setenv("DBM_DEVLOOP_UNTIL", "1" if until else "0")
+    monkeypatch.setenv("DBM_DEVLOOP_PALLAS", "1" if pallas else "0")
+
+
+def _off(monkeypatch):
+    monkeypatch.setenv("DBM_DEVLOOP", "0")
+
+
+# ------------------------------------------------------------ argmin grid
+
+@pytest.mark.parametrize("data", GRID_DATA)
+def test_devloop_argmin_bit_exact_grid(data, monkeypatch):
+    s = NonceSearcher(data, batch=64)
+    for lo, hi in GRID_RANGES:
+        _on(monkeypatch)
+        got = s.search(lo, hi)
+        _off(monkeypatch)
+        assert got == s.search(lo, hi), (lo, hi)
+        assert got == scan_min(data, lo, hi), (lo, hi)
+
+
+def test_devloop_handle_is_one_fetch(monkeypatch):
+    """The span contract: devloop dispatch returns ONE carry handle of
+    <= 20 bytes and one launch per block, however ragged the range."""
+    _on(monkeypatch)
+    s = NonceSearcher("cmu440", batch=64)
+    lo, hi = 997, 3001
+    blocks = len(list(s.plan(lo, hi)))
+    before = _MET_LAUNCHES.value
+    handle = s.dispatch(lo, hi)
+    assert isinstance(handle, _DevloopHandle)
+    assert _MET_LAUNCHES.value - before == blocks
+    assert handle.nbytes <= 20
+    assert s.last_dispatch_subs and s.last_dispatch_subs >= blocks
+    assert s.finalize(handle, lo) == scan_min("cmu440", lo, hi)
+
+
+# ------------------------------------------------------------- until grid
+
+@pytest.mark.parametrize("data", GRID_DATA)
+def test_devloop_until_bit_exact_grid(data, monkeypatch):
+    _on(monkeypatch, until=True)
+    s = NonceSearcher(data, batch=64)
+    for lo, hi in GRID_RANGES:
+        for target in (1 << 59, 1 << 56, 1):   # quick hit, late hit, miss
+            assert s.search_until(lo, hi, target) == \
+                scan_until(data, lo, hi, target), (lo, hi, target)
+
+
+def test_devloop_until_early_exit_equals_exhaustive(monkeypatch):
+    """First-*qualifying*-nonce semantics: with MANY qualifying nonces
+    in range, the early exit must return the lowest-nonce qualifier —
+    not the argmin, not a later hit from the exiting sub-window — and
+    agree with both the exhaustive host scan and the stock path."""
+    data = "cmu440"
+    lo, hi = 1_000, 3_500
+    hashes = sorted((hash_op(data, n), n) for n in range(lo, hi + 1))
+    target = hashes[7][0] + 1          # 8 qualifying nonces in range
+    assert sum(1 for n in range(lo, hi + 1)
+               if hash_op(data, n) < target) == 8
+    want_nonce = min(n for _h, n in hashes[:8])
+    _on(monkeypatch, until=True)
+    s = NonceSearcher(data, batch=64)
+    got = s.search_until(lo, hi, target)
+    assert got == (hash_op(data, want_nonce), want_nonce, True)
+    assert got == scan_until(data, lo, hi, target)
+    _off(monkeypatch)
+    assert got == s.search_until(lo, hi, target)
+
+
+def test_devloop_until_miss_falls_back_to_argmin(monkeypatch):
+    _on(monkeypatch, until=True)
+    data = "cmu440"
+    s = NonceSearcher(data, batch=64)
+    assert s.search_until(100, 1_500, 1) == \
+        (*scan_min(data, 100, 1_500), False)
+
+
+def test_devloop_until_hit_in_first_block_skips_later_blocks(monkeypatch):
+    """Cross-block pass-through: once the carry records a hit, every
+    later launch in the chain must fall straight through (the device-
+    side short-circuit) without perturbing the recorded first hit."""
+    _on(monkeypatch, until=True)
+    data = "cmu440"
+    lo, hi = 0, 99_999                 # several chained 10^k blocks
+    target = 1 << 56                   # expected hit a few hundred in
+    s = NonceSearcher(data, batch=64)
+    assert s.search_until(lo, hi, target) == \
+        scan_until(data, lo, hi, target)
+
+
+# -------------------------------------------------------- knob-off parity
+
+def test_knob_off_is_bit_for_bit_stock(monkeypatch):
+    """DBM_DEVLOOP=0 must be the stock path: stock handle shape (a list
+    of per-sub launches, not a carry), stock launch count (one per pow2
+    sub), and stock results. The tier1.sh matrix leg pins the same
+    contract suite-wide."""
+    _off(monkeypatch)
+    s = NonceSearcher("cmu440", batch=64)
+    lo, hi = 997, 3001
+    subs = sum(len(s._sub_dispatches(plan)) for plan in s.plan(lo, hi))
+    before = _MET_LAUNCHES.value
+    handle = s.dispatch(lo, hi)
+    assert not isinstance(handle, _DevloopHandle)
+    assert isinstance(handle, list) and len(handle) == subs
+    assert _MET_LAUNCHES.value - before == subs
+    assert s.last_dispatch_subs is None
+    assert s.finalize(handle, lo) == scan_min("cmu440", lo, hi)
+
+
+def test_sharded_searcher_never_devloops(monkeypatch):
+    """ShardedNonceSearcher pins ``_supports_devloop`` off (a devloop
+    there would scan ONE device's share); only the mesh model re-enables
+    it with a whole-mesh loop. Pin the routing."""
+    _on(monkeypatch)
+    s = ShardedNonceSearcher("cmu440", batch=64, mesh=make_mesh(4))
+    assert not s._supports_devloop
+    handle = s.dispatch(0, 4_095)
+    assert not isinstance(handle, _DevloopHandle)
+    assert s.finalize(handle, 0) == scan_min("cmu440", 0, 4_095)
+
+
+def test_mouse_below_est_floor_takes_stock_path(monkeypatch):
+    """The est-seconds amortization floor: with an observed rate making
+    the chunk estimate fall under _DEVLOOP_MIN_EST_S, dispatch must
+    route to the stock path — and the trace stamp must follow the route
+    taken, not the knob."""
+    _on(monkeypatch)
+    s = NonceSearcher("cmu440", batch=64)
+    s._devloop_nps = 1e12              # everything estimates ~0 s
+    handle = s.dispatch(1_000, 1_200)
+    assert not isinstance(handle, _DevloopHandle)
+    assert s.last_dispatch_subs is None
+    assert s.finalize(handle, 1_000) == scan_min("cmu440", 1_000, 1_200)
+    s._devloop_nps = 1.0               # everything estimates huge
+    handle = s.dispatch(1_000, 1_200)
+    assert isinstance(handle, _DevloopHandle)
+    assert s.last_dispatch_subs
+    assert s.finalize(handle, 1_000) == scan_min("cmu440", 1_000, 1_200)
+
+
+# ------------------------------------------------------------- mesh plane
+
+def test_mesh_devloop_whole_mesh_one_launch_per_block(monkeypatch):
+    _on(monkeypatch)
+    data = "cmu440"
+    m = MeshNonceSearcher(data, batch=64, mesh=make_mesh(4))
+    lo, hi = 997, 3001
+    blocks = len(list(m.plan(lo, hi)))
+    before = _MET_LAUNCHES.value
+    handle = m.dispatch(lo, hi)
+    assert isinstance(handle, _DevloopHandle)
+    assert _MET_LAUNCHES.value - before == blocks
+    got = m.finalize(handle, lo)
+    assert got == scan_min(data, lo, hi)
+    _off(monkeypatch)
+    assert got == m.search(lo, hi)
+
+
+def test_mesh_devloop_until_parity(monkeypatch):
+    _on(monkeypatch, until=True)
+    data = "cmu440"
+    m = MeshNonceSearcher(data, batch=64, mesh=make_mesh(4))
+    target = 1 << 56
+    assert m.search_until(0, 9_999, target) == \
+        scan_until(data, 0, 9_999, target)
+    assert m.search_until(100, 1_500, 1) == \
+        (*scan_min(data, 100, 1_500), False)
+
+
+# ------------------------------------------- pallas tier (interpret, slow)
+
+@pytest.mark.slow
+def test_pallas_devloop_argmin_interpret(monkeypatch):
+    _on(monkeypatch, pallas=True)
+    data = "cmu440"
+    s = NonceSearcher(data, batch=128, tier="pallas")
+    lo, hi = 2_000, 2_511              # few grid steps under interpret
+    got = s.search(lo, hi)
+    assert got == scan_min(data, lo, hi)
+    _off(monkeypatch)
+    assert got == s.search(lo, hi)
+
+
+@pytest.mark.slow
+def test_pallas_devloop_until_interpret(monkeypatch):
+    _on(monkeypatch, until=True, pallas=True)
+    data = "cmu440"
+    s = NonceSearcher(data, batch=128, tier="pallas")
+    target = 1 << 59                   # ~1-in-32 per nonce: certain hit
+    got = s.search_until(2_000, 2_511, target)
+    assert got == scan_until(data, 2_000, 2_511, target)
+    assert not s._until_degraded
+
+
+# ------------------------------------------------------------ dbmcheck leg
+
+class _DevloopFakeSearcher(_StockFakeSearcher):
+    """Devloop-shaped two-phase searcher for the schedcheck harness:
+    dispatch charges ONE launch enqueue (a fixed cost, however many
+    sub-windows the span covers), returns an opaque carry handle, and
+    stamps ``last_dispatch_subs`` the way the real devloop dispatch
+    does — so the MinerWorker's single-fetch finalize shape and trace-
+    stamp read run under the deterministic explorer."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.last_dispatch_subs = None
+
+    def dispatch(self, lower, upper):
+        if lower > upper:
+            raise ValueError("empty range")
+        self._charge(64, frac=0.2)              # one enqueue, size-free
+        self.last_dispatch_subs = max(1, (upper - lower + 64) // 64)
+        return ("carry", lower, upper)
+
+    def finalize(self, handle, lower):
+        _tag, lo, up = handle
+        self._charge(up - lo + 1)               # the single carry force
+        return oracle_min(self.data, lo, up)
+
+
+@pytest.mark.parametrize("name", ("pipelined_dispatch",
+                                  "difficulty_prefix"))
+def test_dbmcheck_scenarios_hold_with_devloop_searcher(name, monkeypatch):
+    """The control-plane invariant pack (exactly-once, per-miner result
+    order, accounting, liveness) must hold when the miner-side searcher
+    is devloop-shaped — the pipeline sees one opaque handle per span
+    instead of a per-sub list, and in-order finalize semantics must
+    survive that. difficulty_prefix rides along unpatched as the until-
+    contract control leg."""
+    from distributed_bitcoinminer_tpu.analysis.schedcheck import (
+        ALL, execute, format_spec)
+    from distributed_bitcoinminer_tpu.analysis.schedcheck import scenarios
+    monkeypatch.setattr(scenarios, "_FakeSearcher", _DevloopFakeSearcher)
+    for seed in range(8):
+        result = execute(ALL[name](), seed)
+        assert not result.failed, (
+            f"{name} seed {seed}: {result.violations} "
+            f"(repro: {format_spec(result)})")

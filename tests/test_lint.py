@@ -361,6 +361,36 @@ def test_jitstatic_bounded_quantizer_call_is_stable():
     assert "nrows" in found[0].message
 
 
+JIT_DEVLOOP = snip("""
+    import functools
+    import jax
+
+    from .search import devloop_cap
+
+    @functools.partial(jax.jit, static_argnames=("cap",))
+    def devloop_fixture(x, nsub, *, cap):
+        return x
+
+    def caller(x, lo_i, hi_i, batch):
+        nsub = (hi_i - lo_i + batch) // batch
+        # The devloop static backstop (ISSUE 19): pow2-quantized by
+        # devloop_cap's contract; the LIVE count nsub is traced.
+        ok = devloop_fixture(x, nsub, cap=devloop_cap(nsub))
+        # The raw runtime count at the static boundary still fails.
+        return ok, devloop_fixture(x, nsub, cap=nsub)
+""")
+
+
+def test_jitstatic_devloop_cap_is_stable():
+    """The devloop_cap quantizer (ISSUE 19): the in-kernel loop's
+    static iteration backstop is bounded by delegation to pow2_bucket,
+    so a devloop launch site passing ``cap=devloop_cap(nsub)`` is
+    clean while the unquantized sub count next to it still fails."""
+    found = run_source("jit-static", JIT_DEVLOOP, rel=JIT_REL)
+    assert len(found) == 1
+    assert "cap" in found[0].message
+
+
 # ------------------------------------------------------------ thread-state
 
 THREAD_BAD = snip("""

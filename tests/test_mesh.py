@@ -177,9 +177,12 @@ def test_mesh_span_single_host_transfer_and_launch_count(monkeypatch,
     many blocks/pow2 subs it decomposes into — costs exactly ONE
     ``jax.device_get`` of the 5-word (20-byte) carry, and the launch
     count equals the pow2-sub total of its blocks (one chained launch
-    each, no per-sub partials)."""
+    each, no per-sub partials). This is the STOCK chain contract, so
+    the devloop is pinned off; the devloop count — one launch per
+    BLOCK — is pinned in test_devloop.py (ISSUE 19)."""
     from distributed_bitcoinminer_tpu.models.miner_model import \
         _MET_LAUNCHES
+    monkeypatch.setenv("DBM_DEVLOOP", "0")
     data = "cmu440"
     m = MeshNonceSearcher(data, batch=BATCH, mesh=mesh8)
     calls = []

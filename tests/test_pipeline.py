@@ -334,11 +334,18 @@ def _e2e_cluster_answers(pipeline: bool, stripe: StripeParams,
                 c.miners.append(worker)
             # Request 1 warms the pool; the EWMA is then pinned directly
             # (the windowed rate sampler ignores sub-window warm
-            # requests by design) so request 2 stripes (when on).
+            # requests by design) so request 2 stripes (when on). On a
+            # loaded box the warm request CAN outlast RATE_WINDOW_S and
+            # publish a real pool rate, which flips the QoS gate to a
+            # chunked incremental start (a mode with its own suite) —
+            # clear the published sample too: this test pins the
+            # wholesale + stripe path.
             r0 = await asyncio.wait_for(
                 submit(c.hostport, "equiv warm", 999, params), 30)
             for m in c.scheduler.miners:
                 m.rate_ewma = 1000.0
+                m.win_t0, m.win_nonces = 0.0, 0
+            c.scheduler.miner_plane.pool_rate = None
             r1 = await asyncio.wait_for(
                 submit(c.hostport, "equiv main", 49_999, params), 60)
             ru = await asyncio.wait_for(
@@ -387,9 +394,15 @@ def test_e2e_equivalence_real_jnp_searcher():
             # The windowed rate sampler needs RATE_WINDOW_S of wall
             # clock before publishing a rate; a sub-second warm request
             # can't fill it, so pin the EWMA (file-wide idiom) so the
-            # next request stripes.
+            # next request stripes. On a loaded box the warm request
+            # CAN outlast the window and publish a real pool rate,
+            # which flips the QoS gate to a chunked incremental start
+            # that never counts chunks_striped — clear the published
+            # sample too: this test pins the wholesale + stripe path.
             for m in c.scheduler.miners:
                 m.rate_ewma = 1000.0
+                m.win_t0, m.win_nonces = 0.0, 0
+            c.scheduler.miner_plane.pool_rate = None
             r1 = await asyncio.wait_for(
                 submit(c.hostport, "pipe jnp", 2999, params), 120)
             assert r1 == scan_min("pipe jnp", 0, 3000)
