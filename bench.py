@@ -1130,6 +1130,188 @@ def _mesh_mixed_pool() -> dict:
     return asyncio.run(run())
 
 
+def _federation_probe() -> dict:
+    """Scheduler-federation probe (ISSUE 20, ``detail.federation``):
+    what does the extra tier COST, and does grant placement still track
+    capacity through it?
+
+    Two measurements on detnet (sockets + asyncio only, no JAX):
+
+    - ``overhead_ratio``: the same workload (one chunked elephant plus
+      mice) against the same 4-child pool, run FLAT (children JOIN the
+      scheduler directly) and FEDERATED (2 GatewayMiners x 2 children
+      re-sharding through stock inner schedulers), averaged over
+      ``DBM_BENCH_FEDERATION_ROUNDS`` rounds. The ratio of makespans is
+      the federation tax — the extra hop plus the inner tier's own
+      lease/QoS machinery.
+    - ``skew``: a >= 10x child-pool skew between the two gateways
+      (pool sums 40k vs 4k nonces/s); each gateway's GRANT SHARE
+      (nonces its children scanned) is recorded against its advertised
+      rate share, with the relative ``tracking_error`` — the parent
+      sees only two JOIN rate hints, so this is the whole-cluster
+      placement fidelity of the pool-summed Rate extension.
+
+    ``DBM_BENCH_FEDERATION=0`` skips.
+    """
+    import asyncio
+    import time
+
+    from distributed_bitcoinminer_tpu.apps.gateway import GatewayMiner
+    from distributed_bitcoinminer_tpu.apps.scheduler import Scheduler
+    from distributed_bitcoinminer_tpu.bitcoin.message import (
+        Message, MsgType, new_join, new_request, new_result)
+    from distributed_bitcoinminer_tpu.lspnet.detnet import DetServer
+    from distributed_bitcoinminer_tpu.utils.config import (
+        AdaptParams, CoalesceParams, GatewayParams, LeaseParams,
+        QosParams, StripeParams, VerifyParams)
+
+    rounds = max(1, int(_str_env("DBM_BENCH_FEDERATION_ROUNDS", "2")
+                        or 2))
+    ELEPHANT = 60_000
+    MICE = 4
+
+    def mk_sched(server) -> Scheduler:
+        return Scheduler(
+            server,
+            lease=LeaseParams(grace_s=5.0, floor_s=2.0, tick_s=0.1,
+                              queue_alarm_s=30.0),
+            qos=QosParams(enabled=True, chunk_s=0.05, max_chunks=256,
+                          depth=2, wholesale_s=0.2),
+            stripe=StripeParams(enabled=False),
+            coalesce=CoalesceParams(enabled=False),
+            adapt=AdaptParams(enabled=False),
+            # Deterministic non-oracle hashes below (the probe measures
+            # placement and makespan, not merges) — claim checks off.
+            verify=VerifyParams(enabled=False))
+
+    async def miner(server, rate: float, granted: dict,
+                    key: str) -> None:
+        chan = server.connect()
+        chan.write(new_join(rate=int(rate)).to_json())
+        try:
+            while True:
+                msg = Message.from_json(await chan.read())
+                if msg.type != MsgType.REQUEST:
+                    continue
+                size = msg.upper - msg.lower + 1
+                granted[key] = granted.get(key, 0) + size
+                await asyncio.sleep(size / rate)
+                chan.write(new_result(
+                    (1 << 50) + msg.lower, msg.lower).to_json())
+        except Exception:   # noqa: BLE001 — conn closed at teardown
+            return
+
+    async def drive(server) -> float:
+        """Elephant + mice against ``server``; returns the makespan."""
+        async def client(data: str, upper: int) -> None:
+            chan = server.connect()
+            chan.write(new_request(data, 0, upper).to_json())
+            while True:
+                msg = Message.from_json(await chan.read())
+                if msg.type == MsgType.RESULT:
+                    await chan.close()
+                    return
+
+        t0 = time.monotonic()
+        jobs = [asyncio.create_task(client("fed elephant",
+                                           ELEPHANT - 1))]
+        for j in range(MICE):
+            jobs.append(asyncio.create_task(
+                client(f"fed mouse {j}", 499)))
+        await asyncio.wait_for(asyncio.gather(*jobs), 120)
+        return time.monotonic() - t0
+
+    async def run_flat(rates) -> float:
+        server = DetServer()
+        sched = mk_sched(server)
+        granted: dict = {}
+        tasks = [asyncio.create_task(sched.run())]
+        tasks += [asyncio.create_task(miner(server, r, granted, "flat"))
+                  for r in rates]
+        while len(sched.miners) < len(rates):
+            await asyncio.sleep(0.01)
+        makespan = await drive(server)
+        for t in tasks:
+            t.cancel()
+        return makespan
+
+    async def run_fed(cluster_rates) -> tuple:
+        parent_srv = DetServer()
+        parent = mk_sched(parent_srv)
+        granted: dict = {}
+        tasks = [asyncio.create_task(parent.run())]
+        gws = []
+        for i, rates in enumerate(cluster_rates):
+            inner_srv = DetServer()
+            inner = mk_sched(inner_srv)
+            tasks.append(asyncio.create_task(inner.run()))
+            tasks += [asyncio.create_task(
+                miner(inner_srv, r, granted, f"gw{i}")) for r in rates]
+
+            async def connect(srv=inner_srv):
+                return srv.connect()
+
+            async def connect_parent():
+                return parent_srv.connect()
+
+            gw = GatewayMiner(
+                connect_parent, connect, [inner],
+                params=GatewayParams(enabled=True, hint_s=0.5,
+                                     min_pool=len(rates),
+                                     orphan_s=10.0),
+                poll_s=0.01, name=f"gw{i}")
+            gws.append(gw)
+            tasks.append(asyncio.create_task(gw.run_forever()))
+        while len(parent.miners) < len(cluster_rates):
+            await asyncio.sleep(0.01)
+        makespan = await drive(parent_srv)
+        for t in tasks:
+            t.cancel()
+        return makespan, granted, gws
+
+    POOL = [10_000.0] * 4
+    CLUSTERS = [POOL[:2], POOL[2:]]
+    flat_s, fed_s = [], []
+    for _ in range(rounds):
+        flat_s.append(asyncio.run(run_flat(POOL)))
+        fed_s.append(asyncio.run(run_fed(CLUSTERS))[0])
+    flat_mean = sum(flat_s) / len(flat_s)
+    fed_mean = sum(fed_s) / len(fed_s)
+
+    # The >= 10x skew leg: pool sums 40k vs 4k nonces/s.
+    SKEW = [[20_000.0, 20_000.0], [2_000.0, 2_000.0]]
+    skew_makespan, skew_granted, gws = asyncio.run(run_fed(SKEW))
+    total = sum(skew_granted.values()) or 1
+    rate_total = sum(sum(c) for c in SKEW)
+    skew_rows = {}
+    for i, rates in enumerate(SKEW):
+        share = skew_granted.get(f"gw{i}", 0) / total
+        expect = sum(rates) / rate_total
+        skew_rows[f"gw{i}"] = {
+            "pool_rate_nps": sum(rates),
+            "granted_nonces": skew_granted.get(f"gw{i}", 0),
+            "grant_share": round(share, 4),
+            "rate_share": round(expect, 4),
+            "tracking_error": round(abs(share - expect) / expect, 4)
+            if expect else None,
+        }
+    return {
+        "rounds": rounds,
+        "elephant_nonces": ELEPHANT,
+        "flat_makespan_s": round(flat_mean, 3),
+        "federated_makespan_s": round(fed_mean, 3),
+        "overhead_ratio": round(fed_mean / flat_mean, 4)
+        if flat_mean else None,
+        "skew": {
+            "makespan_s": round(skew_makespan, 3),
+            "skew_ratio": 10.0,
+            "tiers": skew_rows,
+            "grants_taken": {g.name: g.grants_taken for g in gws},
+            "hint_refreshes": {g.name: g.hint_refreshes for g in gws},
+        },
+    }
+
+
 def _mesh_probe() -> dict:
     """Mesh-plane probe (ISSUE 14, ``detail.mesh``) — ALSO the
     ``MULTICHIP_r06.json`` artifact schema (``schema: mesh_scaling_v1``)
@@ -1757,6 +1939,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001
             mesh_detail = {"mesh": {"error": repr(exc)[:300]}}
 
+    # Scheduler federation (ISSUE 20): federated-vs-flat makespan at
+    # equal pool size + grant-share tracking under >= 10x child-pool
+    # skew — detnet sockets only, no JAX. DBM_BENCH_FEDERATION=0 skips.
+    federation_detail = {}
+    if _str_env("DBM_BENCH_FEDERATION", "1") != "0":
+        try:
+            federation_detail = {"federation": _federation_probe()}
+        except Exception as exc:  # noqa: BLE001
+            federation_detail = {"federation": {"error": repr(exc)[:300]}}
+
     # Transport datapath A/B (ISSUE 17): echo-storm msgs/s fast vs stock
     # (DBM_MMSG=0 DBM_WIRE_FAST=0) in subprocess legs, syscall economics,
     # per-conn memory — sockets only, no JAX, so it runs on any box.
@@ -1816,6 +2008,7 @@ def main() -> int:
         **adapt_detail,
         **replay_detail,
         **mesh_detail,
+        **federation_detail,
         **transport_detail,
         **rollup_detail,
         # Process metrics snapshot (ISSUE 3): stable-keyed and

@@ -1227,6 +1227,149 @@ class HealthTakeover(Scenario):
         return out
 
 
+# --------------------------------------------------------------- federation
+
+class Federation(Scenario):
+    """ISSUE 20: the two-level scheduler tree run IN-PROCESS on the
+    virtual clock — a REAL parent scheduler fronted by two REAL
+    :class:`~...apps.gateway.GatewayMiner` actors, each re-sharding its
+    grants through a stock inner scheduler on its own DetServer (one
+    socket per child cluster, like one socket per process). The parent
+    sees nothing but two miners speaking the stock wire: JOINs carry
+    pool-summed rate hints over the Rate extension, grants come back as
+    merged Results in grant order, and difficulty targets ride through
+    both tiers (child miners honor the until extension, so the inner
+    merge is strong and the gateway's target echo is truthful).
+
+    Mid-schedule, child cluster 0 FAILS at a seed-drawn virtual time
+    (every conn of its inner server dies — miners and bridge alike; the
+    inner scheduler itself keeps running, modeling a fenced/empty child
+    pool). The gateway reconnects its bridge, resubmits unanswered
+    grants in order, finds the pool empty, and the orphan watchdog
+    closes its parent conn: ONE drop + blown lease(s) at the parent,
+    recovered by the stock re-issue plane granting to the surviving
+    gateway. Invariants: every tenant gets EXACTLY ONE oracle-exact
+    reply however the schedule interleaves grants, inner re-sharding,
+    the failure, and re-issue; accounting and spans drain to zero on
+    ALL THREE schedulers."""
+
+    name = "federation"
+
+    def build(self, ctx: Ctx) -> None:
+        from ...apps.gateway import GatewayMiner
+        from ...lspnet.detnet import DetServer
+        from ...utils.config import GatewayParams
+        rng = ctx.rng
+        lease = LeaseParams(grace_s=4.0, factor=4.0, floor_s=1.5,
+                            tick_s=0.1, queue_alarm_s=30.0)
+        qos = QosParams(enabled=True, chunk_s=0.3, max_chunks=8,
+                        depth=2, wholesale_s=0.5)
+
+        def mk(server) -> Scheduler:
+            s = Scheduler(server, lease=lease, cache=CacheParams(),
+                          stripe=StripeParams(enabled=False), qos=qos,
+                          coalesce=CoalesceParams(enabled=False),
+                          clock=ctx.loop.time)
+            ctx.spawn(s.run())
+            return s
+
+        parent = mk(ctx.server)
+        inner_servers = [DetServer(), DetServer()]
+        inners = [mk(srv) for srv in inner_servers]
+        ctx.sched = _ProcView([parent] + inners)
+        self.inners = inners
+
+        # ---- the federation tier: one gateway per child cluster ----
+        async def _conn(server):
+            return server.connect()
+
+        gw_params = GatewayParams(
+            enabled=True, hint_s=rng.uniform(0.3, 0.8), min_pool=1,
+            orphan_s=rng.uniform(0.4, 0.9))
+        self.gateways = []
+        for i in range(2):
+            gw = GatewayMiner(
+                parent_connect=lambda: _conn(ctx.server),
+                bridge_connect=lambda srv=inner_servers[i]: _conn(srv),
+                inner_scheds=[inners[i]], params=gw_params,
+                poll_s=0.1, backoff_s=0.2, name=f"gw{i}")
+            self.gateways.append(gw)
+            ctx.spawn(gw.run_forever())
+
+        # ---- child miners: oracle-exact, until-honoring, hinted ----
+        pools = [rng.choice((1, 2)), rng.choice((1, 2))]
+
+        async def child(i: int, mrng: random.Random) -> None:
+            hint = mrng.uniform(400.0, 4000.0)
+            chan = inner_servers[i].connect()
+            try:
+                chan.write(new_join(rate=int(hint)).to_json())
+                while True:
+                    payload = await chan.read()
+                    msg = Message.from_json(payload)
+                    if msg.type != MsgType.REQUEST:
+                        continue
+                    await asyncio.sleep(
+                        (msg.upper - msg.lower + 1) / 1000.0
+                        * mrng.uniform(0.8, 1.2))
+                    from .scenario import oracle_min, oracle_until
+                    if msg.target:
+                        h, n, _found = oracle_until(
+                            msg.data, msg.lower, msg.upper, msg.target)
+                        echo = msg.target
+                    else:
+                        h, n = oracle_min(msg.data, msg.lower, msg.upper)
+                        echo = 0
+                    chan.write(new_result(h, n, echo).to_json())
+            except LspError:
+                return      # child cluster failed under us
+
+        for i in range(2):
+            for _j in range(pools[i]):
+                ctx.spawn(child(i, _fork(rng)))
+
+        # ---- mid-schedule child-cluster failure (cluster 0) ----
+        self.fail_at = rng.uniform(0.6, 2.2)
+        self.failed = False
+
+        async def failover() -> None:
+            await asyncio.sleep(self.fail_at)
+            self.failed = True
+            # Whole-cluster death: every conn of the inner server dies
+            # (child miners AND the gateway's bridge), and the inner
+            # scheduler observes the drops — the simulate_exit shape.
+            for conn_id in list(inner_servers[0]._chans):
+                inner_servers[0].close_conn(conn_id)
+                inners[0]._on_drop(conn_id)
+        ctx.spawn(failover())
+
+        # ---- tenants at the parent (oracle-checked) ----
+        ctx.add_client("elephant", [Req(rng.choice(_DATA), 0,
+                                        rng.choice((1499, 1999)),
+                                        pre_delay=0.3)])
+        for j in range(rng.choice((2, 3))):
+            data = f"{rng.choice(_DATA)}#{j}"
+            upper = rng.choice((99, 199))
+            target = 0
+            if rng.random() < 0.5:
+                if rng.random() < 0.25:
+                    target = 1      # unreachable: no-hit arg-min path
+                else:
+                    q = rng.randrange(0, upper + 2)
+                    target = hash_op(data, q) + 1
+            ctx.add_client(f"mouse{j}",
+                           [Req(data, 0, upper, target=target,
+                                pre_delay=0.2 + rng.uniform(0.0, 1.5))])
+
+    def check(self, ctx: Ctx):
+        out = self.check_replies(ctx)
+        out += self.check_accounting(ctx)
+        if sum(g.results_forwarded for g in self.gateways) < 1:
+            out.append("no Result ever crossed the federation tier "
+                       "(gateways never carried the schedule)")
+        return out
+
+
 # ------------------------------------------------------- known-bad fixtures
 
 # --------------------------------------------------------- byzantine_miner
@@ -1433,6 +1576,7 @@ SCENARIOS = {
     "replica_takeover": ReplicaTakeover,
     "adaptive_control": AdaptiveControl,
     "health_takeover": HealthTakeover,
+    "federation": Federation,
     "byzantine_wrong_hash": ByzantineWrongHash,
     "byzantine_collude": ByzantineCollude,
     "byzantine_sentinel": ByzantineSentinel,

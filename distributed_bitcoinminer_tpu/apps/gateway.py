@@ -1,0 +1,431 @@
+"""Scheduler federation — the GatewayMiner (ISSUE 20).
+
+PAPER.md's plugin-boundary thesis ("a TPU pod registers as one very
+wide miner") recursed one level: a **GatewayMiner** JOINs a *parent*
+scheduler as ONE miner whose rate hint is the summed rate EWMAs of its
+downstream pool, and re-shards every granted chunk through a stock
+*inner* :class:`~.scheduler.Scheduler` (or
+:class:`~.replicas.ReplicaSet`) running verbatim behind it. Chunks are
+already contiguous nonce windows with exactly-once lease semantics, so
+the parent sees leases, stripes, QoS, claim checks, audits and spans
+exactly as it sees any miner today — **zero wire change** — and pools
+compose into a tree (the PNPCoin fan-in story: no single scheduler
+holds all tenant state, and fault domains nest).
+
+Topology (one gateway shown; any number JOIN the same parent)::
+
+    tenants ──▶ parent Scheduler ──▶ GatewayMiner (JOIN rate=Σ pool)
+                      │                   │   ▲
+                      ▼                   ▼   │ (bridge = one tenant
+                other miners        inner Scheduler   conn, FIFO)
+                                          │
+                                          ▼
+                                    child miners (stock, any tier)
+
+Design rules, each load-bearing:
+
+- **Op-blind, kernel-free**: the gateway never computes a hash. It
+  brokers wire messages; the inner tier's miners own the ``SearchOp``
+  seam (PR 19), so a new search op needs zero gateway changes.
+- **Grant translation**: each parent REQUEST (a chunk grant, argmin or
+  difficulty) is resubmitted verbatim — same data/range/target — as a
+  tenant request on ONE long-lived *bridge* conn into the inner tier.
+  The inner scheduler preserves the argmin strict-less barrier and the
+  difficulty prefix-release internally and replies with the exact
+  merged result for the window, which is precisely what the parent
+  expects from a miner for that chunk.
+- **In-order upward forwarding** (the PR 4 pipelined-executor
+  contract: the k-th Result on a conn answers the k-th Request): the
+  inner tier's per-tenant FIFO reply discipline guarantees bridge
+  replies arrive in bridge-request order, and bridge requests are
+  submitted in parent-grant order, so popping the pending FIFO head
+  per bridge reply and writing it upward preserves the contract with
+  no reordering buffer. If the bridge conn dies (inner shed closes the
+  conn; transport death), the gateway reconnects and resubmits every
+  unanswered pending IN ORDER — the replacement conn restarts the same
+  FIFO, and the inner result cache replays already-finished windows.
+- **Difficulty echo**: the forwarded Result echoes the grant's target
+  (the stock miner's "until mode ran" marker): the inner tier's
+  prefix-release yields the window-FIRST qualifying nonce, else the
+  exact argmin, matching the echo's contract. Caveat (documented, not
+  defended): if the inner merge itself was WEAK — a child answered
+  without the target extension — the gateway still echoes, claiming
+  window-first for a merely-qualifying nonce; the parent's own weak
+  grading covers direct miners, and a weak inner subtree is the child
+  cluster operator's configuration to fix.
+- **Liveness = inner health**: the gateway delays its parent JOIN
+  until ``min_pool`` inner miners exist, refreshes its rate hint every
+  ``hint_s`` when the pool sum moves >= ~10% (a repeat JOIN over the
+  existing ``Rate`` extension — ``DBM_GATEWAY`` teaches the parent to
+  absorb it in place), and an *orphan watchdog* closes the parent conn
+  when the inner pool stays EMPTY for ``orphan_s`` with grants
+  pending: a fenced/failed child cluster becomes ONE blown lease (plus
+  a drop) at the parent, recovered by the stock re-issue plane with no
+  federation-aware code above.
+
+Everything here is purely async on the ambient loop — no threads — so
+the deterministic explorer (analysis/schedcheck) schedules the gateway
+like any other actor, and the whole two-level topology runs under the
+full invariant pack (the ``federation`` scenario).
+
+Process deployment: ``python -m distributed_bitcoinminer_tpu.apps.gateway
+<parent_hostport> [inner_port]`` (or the ``procs gateway`` role, which
+adds health beats + rollup identity) owns an inner LSP server + stock
+scheduler and bridges to it over localhost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from typing import Awaitable, Callable, Deque, Optional
+
+from ..bitcoin.message import (Message, MsgType, new_join, new_request,
+                               new_result)
+from ..lsp.params import Params
+from ..utils.config import GatewayParams, gateway_from_env
+from .miner_plane import MinerPlane
+
+logger = logging.getLogger("dbm.gateway")
+
+__all__ = ["GatewayMiner", "aggregate_rate_hint", "serve", "main"]
+
+
+def aggregate_rate_hint(scheds) -> float:
+    """Pool-summed rate hint (nonces/s) over one or more inner
+    schedulers: the rate EWMAs of every non-quarantined inner miner
+    (hinted-but-unconfirmed EWMAs count — they are the pool's best
+    estimate and decay on their own), clamped to the same
+    ``RATE_HINT_CAP`` the parent clamps at so an absurd sum is bounded
+    at both ends of the wire. Cold miners (no EWMA yet) contribute 0 —
+    a wholly-cold pool advertises no hint and the parent falls back to
+    stock cold-EWMA seeding."""
+    total = 0.0
+    for sched in scheds:
+        for m in sched.miner_plane.miners:
+            if m.quarantined:
+                continue
+            total += m.rate_ewma or 0.0
+    return min(total, MinerPlane.RATE_HINT_CAP)
+
+
+class _Pending:
+    """One parent grant awaiting its inner-tier result (FIFO order)."""
+
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: Message):
+        self.msg = msg
+
+
+class GatewayMiner:
+    """One federated miner: parent-facing conn + inner-tier bridge.
+
+    ``parent_connect`` / ``bridge_connect`` are async callables
+    returning an AsyncClient-shaped channel (async ``read()``, sync
+    ``write(payload)``, async ``close()``): :func:`~..lsp.client.
+    new_async_client` bound to a hostport in production, a
+    ``DetServer.connect`` wrapper under dbmcheck/tests. ``inner_scheds``
+    are the in-process inner scheduler(s) whose pool this gateway
+    advertises (rate sum + size; the replica tier passes its replicas).
+
+    :meth:`run` is ONE parent-conn lifetime — it returns when the
+    parent conn dies or the orphan watchdog fires, closing the bridge
+    so the inner tier cancels the gateway's tenant state;
+    :meth:`run_forever` is the production rejoin loop.
+    """
+
+    def __init__(self, parent_connect: Callable[[], Awaitable],
+                 bridge_connect: Callable[[], Awaitable],
+                 inner_scheds, *,
+                 params: Optional[GatewayParams] = None,
+                 poll_s: float = 0.05, backoff_s: float = 0.5,
+                 name: str = "gateway"):
+        self.parent_connect = parent_connect
+        self.bridge_connect = bridge_connect
+        self.inner_scheds = list(inner_scheds)
+        self.params = params if params is not None else gateway_from_env()
+        self.poll_s = poll_s
+        self.backoff_s = backoff_s
+        self.name = name
+        self._pending: Deque[_Pending] = deque()
+        self._parent = None
+        self._bridge = None
+        self._last_hint = 0.0
+        # Introspection counters (procsmoke, bench, tests).
+        self.grants_taken = 0
+        self.results_forwarded = 0
+        self.hint_refreshes = 0
+        self.orphan_drops = 0
+
+    # ------------------------------------------------------------ pool view
+
+    def pool_size(self) -> int:
+        """Grant-eligible inner miners (non-quarantined)."""
+        return sum(1 for sched in self.inner_scheds
+                   for m in sched.miner_plane.miners if not m.quarantined)
+
+    def rate_hint(self) -> float:
+        return aggregate_rate_hint(self.inner_scheds)
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def run(self) -> None:
+        """One parent-conn lifetime (see class docstring)."""
+        while self.pool_size() < self.params.min_pool:
+            await asyncio.sleep(self.poll_s)
+        self._pending.clear()
+        self._parent = await self.parent_connect()
+        tasks = []
+        try:
+            self._bridge = await self.bridge_connect()
+            self._last_hint = self.rate_hint()
+            self._parent.write(
+                new_join(rate=int(self._last_hint)).to_json())
+            logger.info("%s joined parent as one miner "
+                        "(pool=%d, hint %.3g nonces/s)",
+                        self.name, self.pool_size(), self._last_hint)
+            tasks = [asyncio.ensure_future(c) for c in (
+                self._parent_loop(), self._bridge_loop(),
+                self._hint_loop(), self._orphan_loop())]
+            done, _ = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                exc = t.exception()
+                if exc is not None:
+                    # Transport death (parent or unrecoverable bridge):
+                    # normal federation weather — the conn teardown
+                    # below is the recovery, stock re-issue upstream.
+                    logger.info("%s conn ended: %r", self.name, exc)
+        finally:
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+            await self._close_all()
+
+    async def run_forever(self) -> None:
+        """Production rejoin loop (the MinerWorker idiom): every parent
+        death or orphan drop is followed by a fresh :meth:`run` after
+        ``backoff_s`` — the gateway re-registers as a brand-new miner
+        conn and the parent re-seeds it from its next JOIN hint."""
+        while True:
+            try:
+                await self.run()
+            except asyncio.CancelledError:
+                raise
+            except Exception:   # noqa: BLE001 — rejoin loop must survive
+                logger.exception("%s run() failed; rejoining", self.name)
+            await asyncio.sleep(self.backoff_s)
+
+    async def _close_all(self) -> None:
+        # Bridge FIRST: closing it is what cancels the gateway's tenant
+        # state inside the inner tier (spans close, chunks recovered).
+        for chan in (self._bridge, self._parent):
+            if chan is None:
+                continue
+            try:
+                await chan.close()
+            except Exception:  # noqa: BLE001 — conn may already be dead
+                pass
+        self._bridge = None
+        self._parent = None
+        self._pending.clear()
+
+    # ------------------------------------------------------------- datapath
+
+    def _submit(self, pend: _Pending) -> None:
+        # Bound-quirk translation: a miner grant carries an EXCLUSIVE
+        # upper that miners scan INCLUSIVELY (ref miner.go:51-52), i.e.
+        # the granted set is [lower, upper]; a tenant request's upper
+        # is inclusive-on-arrival and the system scans [lower, upper+1].
+        # Submitting upper-1 makes the inner tier scan exactly the
+        # granted set — verbatim forwarding would scan one EXTRA nonce,
+        # and an argmin landing there fails the parent's claim check.
+        # (A one-nonce grant, upper == lower, floors at upper == lower:
+        # the inner tier scans one extra nonce and a quirk-nonce argmin
+        # re-executes off the claim-retry path — rare and safe.)
+        msg = pend.msg
+        self._bridge.write(new_request(
+            msg.data, msg.lower, max(msg.lower, msg.upper - 1),
+            msg.target).to_json())
+
+    async def _parent_loop(self) -> None:
+        """Parent grants -> pending FIFO -> inner-tier requests."""
+        while True:
+            payload = await self._parent.read()
+            try:
+                msg = Message.from_json(payload)
+            except ValueError:
+                continue
+            if msg.type != MsgType.REQUEST:
+                continue
+            pend = _Pending(msg)
+            self._pending.append(pend)
+            self.grants_taken += 1
+            try:
+                self._submit(pend)
+            except Exception:  # noqa: BLE001 — bridge mid-death
+                # Leave it pending: the bridge loop's read failure
+                # drives reconnection, which resubmits the FIFO.
+                pass
+
+    async def _bridge_loop(self) -> None:
+        """Inner results -> pending FIFO head -> parent, in order."""
+        while True:
+            try:
+                payload = await self._bridge.read()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — shed/close/transport death
+                await self._bridge_reconnect()
+                continue
+            try:
+                msg = Message.from_json(payload)
+            except ValueError:
+                continue
+            if msg.type != MsgType.RESULT or not self._pending:
+                continue
+            pend = self._pending.popleft()
+            # Echo the grant's target — the "until mode ran" marker a
+            # stock miner sets (weak-subtree caveat: module docstring).
+            self._parent.write(new_result(
+                msg.hash, msg.nonce, pend.msg.target).to_json())
+            self.results_forwarded += 1
+
+    async def _bridge_reconnect(self) -> None:
+        """Fresh bridge conn + in-order resubmission of every
+        unanswered pending. The old conn's requests died with it inside
+        the inner tier (tenant drop cancels them); the replacement conn
+        starts a fresh per-tenant FIFO, so resubmitting the pendings in
+        FIFO order re-establishes the k-th-reply-answers-k-th-grant
+        mapping exactly. Already-finished windows replay from the inner
+        result cache."""
+        old, self._bridge = self._bridge, None
+        if old is not None:
+            try:
+                await old.close()
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        while True:
+            try:
+                self._bridge = await self.bridge_connect()
+                break
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — inner tier restarting
+                await asyncio.sleep(self.backoff_s)
+        if self._pending:
+            logger.info("%s bridge reconnected; resubmitting %d "
+                        "unanswered grant(s) in order", self.name,
+                        len(self._pending))
+        for pend in self._pending:
+            try:
+                self._submit(pend)
+            except Exception:  # noqa: BLE001 — died again already
+                break   # the next read failure reconnects once more
+
+    # ------------------------------------------------------------- liveness
+
+    async def _hint_loop(self) -> None:
+        """Periodic pool-sum refresh: a repeat JOIN over the stock Rate
+        extension whenever the aggregate moved >= ~10% (or flipped
+        between zero and nonzero) — chatty enough for the parent's
+        stripe planner to track child churn, quiet enough to stay
+        invisible next to grant traffic."""
+        while True:
+            await asyncio.sleep(self.params.hint_s)
+            hint = self.rate_hint()
+            last = self._last_hint
+            moved = ((hint <= 0) != (last <= 0)
+                     or (last > 0 and abs(hint - last) / last >= 0.10))
+            if not moved:
+                continue
+            self._last_hint = hint
+            self._parent.write(new_join(rate=int(hint)).to_json())
+            self.hint_refreshes += 1
+
+    async def _orphan_loop(self) -> None:
+        """Orphan watchdog: an EMPTY inner pool sitting on pending
+        grants for ``orphan_s`` means this gateway can only let the
+        parent's leases rot — returning ends :meth:`run`, the conn
+        teardown surfaces as one drop + blown lease(s) at the parent,
+        and the stock re-issue plane re-grants the chunks to siblings
+        immediately instead of at lease expiry."""
+        loop = asyncio.get_running_loop()
+        empty_since: Optional[float] = None
+        while True:
+            await asyncio.sleep(self.poll_s)
+            if self.pool_size() > 0 or not self._pending:
+                empty_since = None
+                continue
+            now = loop.time()
+            if empty_since is None:
+                empty_since = now
+            elif now - empty_since >= self.params.orphan_s:
+                self.orphan_drops += 1
+                logger.warning(
+                    "%s: inner pool empty for %.1fs with %d grant(s) "
+                    "pending; dropping parent conn for stock re-issue",
+                    self.name, now - empty_since, len(self._pending))
+                return
+
+
+async def serve(parent_hostport: str, inner_port: int = 0,
+                params: Optional[Params] = None,
+                gateway: Optional[GatewayParams] = None) -> None:
+    """Process entry: inner LSP server + stock env-configured scheduler
+    + one :class:`GatewayMiner` bridging to it over localhost. Child
+    miners point at the printed inner port exactly as they would at a
+    flat scheduler."""
+    from ..lsp.client import new_async_client
+    from ..lsp.server import new_async_server
+    from .scheduler import Scheduler
+
+    gw_params = gateway if gateway is not None else gateway_from_env()
+    if not gw_params.enabled:
+        raise RuntimeError("DBM_GATEWAY=0: the gateway role is disabled "
+                           "(flat topology pin)")
+    lsp = params or Params()
+    server = await new_async_server(inner_port, lsp)
+    print("Gateway inner tier listening on port", server.port, flush=True)
+    sched = Scheduler(server)
+    inner_hostport = f"127.0.0.1:{server.port}"
+    gw = GatewayMiner(
+        parent_connect=lambda: new_async_client(parent_hostport, lsp),
+        bridge_connect=lambda: new_async_client(inner_hostport, lsp),
+        inner_scheds=[sched], params=gw_params)
+    try:
+        await asyncio.gather(sched.run(), gw.run_forever())
+    finally:
+        await server.close()
+
+
+def main(argv: Optional[list] = None) -> int:
+    import sys
+    argv = sys.argv if argv is None else argv
+    if len(argv) not in (2, 3):
+        print(f"Usage: ./{argv[0]} <parent_hostport> [inner_port]")
+        return 1
+    inner_port = 0
+    if len(argv) == 3:
+        try:
+            inner_port = int(argv[2])
+        except ValueError as exc:
+            print("Inner port must be a number:", exc)
+            return 1
+    from ..utils import configure_logging, ensure_emitter, from_env
+    configure_logging(logging.INFO, logfile="log.txt")
+    ensure_emitter()
+    cfg = from_env()
+    try:
+        asyncio.run(serve(argv[1], inner_port, cfg.params))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

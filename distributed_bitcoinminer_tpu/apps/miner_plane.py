@@ -262,6 +262,40 @@ class MinerPlane:
         self.update_pool_gauges()
         return miner
 
+    def refresh_rate_hint(self, miner: MinerState, rate_hint: float) -> None:
+        """Repeat-JOIN rate-hint refresh (ISSUE 20): a GatewayMiner
+        re-sends its JOIN whenever its downstream pool sum moves, so the
+        hint must UPDATE the existing MinerState instead of minting a
+        duplicate roster entry.
+
+        Semantics mirror :meth:`on_join`'s seeding rules: the new hint is
+        clamped to ``RATE_HINT_CAP`` and trust-weighted (an untrusted
+        refresher cannot inflate its share any more than an untrusted
+        joiner can). While the EWMA is still hint-only (unconfirmed), the
+        refresh simply replaces it. Once a real throughput window has
+        confirmed a MEASURED rate, the measurement outranks claims —
+        except on >=2x divergence either way, which for a gateway means
+        the pool behind it genuinely changed shape (children joined or a
+        child cluster died) faster than the EWMA can track; then the
+        fresh pool-sum re-seeds it, flagged unconfirmed again so decay
+        applies until the next real window. ``rate_hint <= 0`` is a
+        no-op (a stock miner's hintless repeat JOIN carries no claim)."""
+        if rate_hint <= 0:
+            return
+        hinted = min(float(rate_hint), self.RATE_HINT_CAP) * miner.trust
+        measured = miner.rate_ewma is not None and not miner.rate_hinted
+        if measured:
+            assert miner.rate_ewma is not None
+            diverged = (hinted >= miner.rate_ewma * 2.0
+                        or hinted <= miner.rate_ewma * 0.5)
+            if not diverged:
+                return
+        miner.rate_ewma = hinted
+        miner.rate_hinted = True
+        self.metrics.gauge("miner_rate_nps",
+                           miner=str(miner.conn_id)).set(miner.rate_ewma)
+        self._count("rate_hints_refreshed")
+
     def adopt_miner(self, conn_id: int, pending: Optional[list] = None,
                     rate_ewma: Optional[float] = None) -> MinerState:
         """Replica lease takeover (apps/replicas.py): adopt a miner that

@@ -27,6 +27,14 @@ partition), with failure detected by the health plane in
   thinnest advertised miner slice and, when its conn dies (replica
   killed or fenced), re-reads the membership and REJOINS a survivor —
   the process-topology analog of PR 11's in-process miner adoption.
+- **Gateway agent** (:class:`GatewayAgent`, CLI ``gateway``): one OS
+  process holding a whole federated child cluster (ISSUE 20) — an
+  inner LSP server + stock :class:`~.scheduler.Scheduler` + N
+  in-process child :class:`~.miner.MinerWorker`\\ s + one
+  :class:`~.gateway.GatewayMiner` that JOINs the thinnest live replica
+  as ONE very wide miner. Owner pick and fence-push mirror the miner
+  agent; the process publishes a ``gateway``-role rollup blob so
+  ``dbmtop`` shows the federation tier next to the flat one.
 - **Replicated cache tier** (:class:`SpoolResultCache`): each replica's
   ResultCache WRITES THROUGH finished results to an append-only
   per-incarnation spool file; every replica ingests its peers' spools
@@ -78,9 +86,9 @@ from .scheduler import ResultCache
 
 logger = logging.getLogger("dbm.procs")
 
-__all__ = ["ReplicaProcess", "Router", "MinerAgent", "SpoolResultCache",
-           "ProcCluster", "read_membership", "resolve_owner",
-           "gc_fenced_spools", "FENCED_EXIT"]
+__all__ = ["ReplicaProcess", "Router", "MinerAgent", "GatewayAgent",
+           "SpoolResultCache", "ProcCluster", "read_membership",
+           "resolve_owner", "gc_fenced_spools", "FENCED_EXIT"]
 
 #: Exit code of a replica process that observed its own fence: the
 #: supervisor (ProcCluster, or an operator's systemd unit) respawns it
@@ -183,6 +191,20 @@ def resolve_owner(statedir: str, key) -> Optional[Tuple[int, str]]:
     ring_ids = serving or [min(m.live)]
     rid = HashRing(ring_ids).owner(key)
     return rid, f"127.0.0.1:{m.live[rid]['port']}"
+
+
+def pick_thinnest(statedir: str) -> Optional[Tuple[int, str, str]]:
+    """``(rid, incarnation, hostport)`` of the live replica advertising
+    the thinnest miner slice (ties by lowest rid), or None while no
+    membership is published — the JOIN placement rule shared by the
+    miner agent and the gateway agent."""
+    m = read_membership(statedir)
+    if m is None or not m.live:
+        return None
+    counts = {b.rid: b.miners for b in read_beats(statedir)}
+    rid = min(sorted(m.live), key=lambda r: counts.get(r, 0))
+    entry = m.live[rid]
+    return rid, entry["incarnation"], f"127.0.0.1:{entry['port']}"
 
 
 # ------------------------------------------------------- replicated cache
@@ -556,14 +578,7 @@ class MinerAgent:
     def _pick(self) -> Optional[Tuple[int, str, str]]:
         """``(rid, incarnation, hostport)`` of the thinnest advertised
         live slice, or None while no membership is published."""
-        m = read_membership(self.statedir)
-        if m is None or not m.live:
-            return None
-        counts = {b.rid: b.miners for b in read_beats(self.statedir)}
-        rid = min(sorted(m.live), key=lambda r: counts.get(r, 0))
-        entry = m.live[rid]
-        return rid, entry["incarnation"], \
-            f"127.0.0.1:{entry['port']}"
+        return pick_thinnest(self.statedir)
 
     @staticmethod
     def owner_gone(m: Optional[Membership], rid: int,
@@ -671,6 +686,152 @@ class _InstantSearcher:
         return h, lower
 
 
+# --------------------------------------------------------- gateway agent
+
+class GatewayAgent:
+    """One federated child cluster in one OS process (ISSUE 20): an
+    inner LSP server + stock :class:`~.scheduler.Scheduler` + N
+    in-process child :class:`~.miner.MinerWorker` loops + one
+    :class:`~.gateway.GatewayMiner` that JOINs the replica ring as ONE
+    very wide miner.
+
+    Placement and failover mirror :class:`MinerAgent`: each (re)join
+    picks the thinnest advertised live slice (:func:`pick_thinnest`),
+    and a fence-push watcher closes the parent conn the moment the
+    joined owner leaves the ring — the GatewayMiner's ``run_forever``
+    loop then re-picks a survivor immediately instead of waiting for
+    epoch detection. The children live IN-PROCESS against the inner
+    localhost socket, making the process boundary the child cluster's
+    fault domain: ``kill -9`` the agent and the parent sees exactly one
+    dropped (very wide) miner, recovered by the stock re-issue plane.
+
+    Like the miner agent the process has no beat file — a
+    ``gateway``-role rollup blob (pid-keyed, same churn discipline) is
+    its whole state-plane presence, so ``dbmtop`` renders the
+    federation tier next to the flat one.
+    """
+
+    def __init__(self, statedir: str, params=None,
+                 searcher_factory: Optional[Callable] = None,
+                 children: int = 1, backoff_s: float = 0.2,
+                 gateway=None):
+        from ..utils.config import gateway_from_env
+        self.statedir = statedir
+        self.params = params
+        self.children = max(1, int(children))
+        self.backoff_s = backoff_s
+        self.gw_params = gateway if gateway is not None \
+            else gateway_from_env()
+        if searcher_factory is None:
+            from .miner import HostSearcher
+            searcher_factory = lambda d, b: HostSearcher(d)  # noqa: E731
+        self.factory = searcher_factory
+        self.joins = 0
+        self.fence_pushes = 0
+        self.incarnation = f"{os.getpid()}-{int(time.time() * 1000)}"
+        self._owner: Optional[Tuple[int, str]] = None
+        self.gw = None                      # set by run()
+        self._rollup = (RollupPublisher(statedir, "gateway", os.getpid(),
+                                        self.incarnation)
+                        if rollup_enabled() else None)
+
+    async def _parent_connect(self):
+        """GatewayMiner ``parent_connect`` hook: block until a live
+        replica is advertised, then dial the thinnest slice. Raising
+        (refused dial) is fine — the rejoin loop backs off and calls
+        again."""
+        from ..lsp.client import new_async_client
+        while True:
+            picked = await asyncio.to_thread(pick_thinnest, self.statedir)
+            if picked is not None:
+                rid, incarnation, hostport = picked
+                chan = await new_async_client(hostport, self.params)
+                self._owner = (rid, incarnation)
+                self.joins += 1
+                logger.info("gateway agent dialing parent rid %d at %s "
+                            "(join #%d)", rid, hostport, self.joins)
+                return chan
+            await asyncio.sleep(self.backoff_s)
+
+    async def _watch_loop(self) -> None:
+        """Fence-push (the MinerAgent idiom): when the joined owner
+        leaves the advertised ring, close the parent conn so the
+        GatewayMiner re-picks a survivor NOW instead of after epoch
+        detection."""
+        period = min(self.backoff_s, health_beat_s())
+        while True:
+            await asyncio.sleep(period)
+            owner = self._owner
+            chan = self.gw._parent if self.gw is not None else None
+            if owner is None or chan is None:
+                continue
+            m = await asyncio.to_thread(read_membership, self.statedir)
+            if MinerAgent.owner_gone(m, owner[0], owner[1]):
+                self.fence_pushes += 1
+                self._owner = None
+                logger.info(
+                    "gateway agent: owner rid %d (%s) fenced — closing "
+                    "parent conn for immediate rejoin (fence-push #%d)",
+                    owner[0], owner[1], self.fence_pushes)
+                try:
+                    await chan.close()
+                except Exception:  # noqa: BLE001 — conn already dead
+                    pass
+
+    async def _child_loop(self, hostport: str) -> None:
+        """One stock in-process child miner, rejoining the inner tier
+        across shed/close exactly like a remote worker would."""
+        from .miner import MinerWorker
+        while True:
+            worker = MinerWorker(hostport, params=self.params,
+                                 searcher_factory=self.factory)
+            try:
+                await worker.join()
+                await worker.run()
+            except LspError as exc:
+                logger.info("gateway child join/run failed: %s", exc)
+            finally:
+                await worker.close()
+            await asyncio.sleep(self.backoff_s)
+
+    async def _publish_loop(self) -> None:
+        period = health_beat_s()
+        while True:
+            m = await asyncio.to_thread(read_membership, self.statedir)
+            self._rollup.publish(epoch_seen=m.epoch if m else 0)
+            await asyncio.sleep(period)
+
+    async def run(self) -> None:
+        from ..lsp.client import new_async_client
+        from ..lsp.params import Params
+        from ..lsp.server import new_async_server
+        from .gateway import GatewayMiner
+        from .scheduler import Scheduler
+
+        lsp = self.params or Params()
+        server = await new_async_server(0, lsp)
+        sched = Scheduler(server)
+        inner = f"127.0.0.1:{server.port}"
+        self.gw = GatewayMiner(
+            parent_connect=self._parent_connect,
+            bridge_connect=lambda: new_async_client(inner, lsp),
+            inner_scheds=[sched], params=self.gw_params,
+            backoff_s=self.backoff_s,
+            name=f"gateway[{os.getpid()}]")
+        coros = [sched.run(), self.gw.run_forever(), self._watch_loop()]
+        coros += [self._child_loop(inner) for _ in range(self.children)]
+        if self._rollup is not None:
+            coros.append(self._publish_loop())
+        tasks = [asyncio.ensure_future(c) for c in coros]
+        try:
+            await asyncio.gather(*tasks)
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await server.close()
+
+
 # ------------------------------------------------------- process cluster
 
 class ProcCluster:
@@ -686,10 +847,12 @@ class ProcCluster:
     """
 
     def __init__(self, statedir: str, replicas: int = 2, miners: int = 1,
-                 env: Optional[dict] = None, fake_miners: bool = False):
+                 env: Optional[dict] = None, fake_miners: bool = False,
+                 gateways: int = 0):
         self.statedir = statedir
         self.n = replicas
         self.m = miners
+        self.g = gateways
         self.fake = fake_miners
         self.env = dict(os.environ)
         # Children must never touch JAX or pay emitter/probe overhead.
@@ -704,6 +867,11 @@ class ProcCluster:
             # the control plane is the thing measured here. An explicit
             # env override still wins.
             self.env["DBM_VERIFY"] = "0"
+            # Same reasoning for the probabilistic audit plane (its env
+            # default flipped on in ISSUE 20): an audit re-grants a
+            # subwindow to a second fake miner, whose fabricated
+            # sub-argmin "beats" the original's and convicts it.
+            self.env["DBM_AUDIT_P"] = "0"
         self.env.update(env or {})
         self.procs: Dict[str, object] = {}      # name -> Popen
 
@@ -731,6 +899,11 @@ class ProcCluster:
             if self.fake:
                 args.append("--fake")
             self._spawn(f"miner{i}", args)
+        for i in range(self.g):
+            args = ["gateway", self.statedir]
+            if self.fake:
+                args.append("--fake")
+            self._spawn(f"gateway{i}", args)
 
     def spawn_replica(self, rid: int):
         return self._spawn(f"replica{rid}",
@@ -814,8 +987,9 @@ class ProcCluster:
 # -------------------------------------------------------------------- CLI
 
 def main(argv=None) -> int:
-    """CLI: ``procs {replica|router|miner} <statedir> [options]`` — the
-    process entrypoints ProcCluster (and operators) spawn."""
+    """CLI: ``procs {replica|router|miner|gateway} <statedir>
+    [options]`` — the process entrypoints ProcCluster (and operators)
+    spawn."""
     import argparse
     import sys
     argv = sys.argv[1:] if argv is None else argv
@@ -830,6 +1004,13 @@ def main(argv=None) -> int:
     mine = sub.add_parser("miner")
     mine.add_argument("statedir")
     mine.add_argument("--fake", action="store_true",
+                      help="instant fake compute (loadharness --procs)")
+    gate = sub.add_parser("gateway")
+    gate.add_argument("statedir")
+    gate.add_argument("--children", type=int, default=1,
+                      help="in-process child miners behind the inner "
+                           "scheduler (default 1)")
+    gate.add_argument("--fake", action="store_true",
                       help="instant fake compute (loadharness --procs)")
     args = ap.parse_args(argv)
 
@@ -858,6 +1039,23 @@ def main(argv=None) -> int:
         factory = None
         if args.fake:
             factory = lambda d, b: _InstantSearcher(d)  # noqa: E731
+        if args.role == "gateway":
+            from ..utils.config import gateway_from_env
+            gwp = gateway_from_env()
+            if not gwp.enabled:
+                # Mirror apps.gateway.serve: the flat-topology pin must
+                # refuse loudly, not run a silently degraded tier.
+                logger.error("DBM_GATEWAY=0: the gateway role is "
+                             "disabled (flat topology pin)")
+                return 2
+            gw_agent = GatewayAgent(args.statedir, params=cfg.params,
+                                    searcher_factory=factory,
+                                    children=args.children, gateway=gwp)
+            if rollup_enabled():
+                set_proc_identity("gateway", os.getpid(),
+                                  gw_agent.incarnation)
+            asyncio.run(gw_agent.run())
+            return 0
         agent = MinerAgent(args.statedir, params=cfg.params,
                            searcher_factory=factory)
         if rollup_enabled():

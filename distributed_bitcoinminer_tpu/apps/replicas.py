@@ -167,6 +167,10 @@ class ReplicaSet:
         self._recv_batch = max(1, recv_batch if recv_batch is not None
                                else _int_env("DBM_RECV_BATCH", 64))
         self._read_nowait = getattr(server, "read_nowait", None)
+        # Federation (ISSUE 20): repeat JOINs route to the existing
+        # owner replica (the gateway rate-hint refresh). Same knob and
+        # construction-time read as Scheduler's.
+        self._gateway = _int_env("DBM_GATEWAY", 1) != 0
 
     # ------------------------------------------------------------- routing
 
@@ -235,6 +239,14 @@ class ReplicaSet:
         except ValueError:
             return
         if msg.type == MsgType.JOIN:
+            # Repeat JOIN from a conn a live replica already owns as a
+            # miner (ISSUE 20, DBM_GATEWAY): a rate-hint refresh, routed
+            # to the existing owner — re-running the thinnest-slice pick
+            # would register the same gateway on a SECOND replica.
+            rid = self._miner_owner.get(conn_id)
+            if self._gateway and rid is not None and rid in self.live:
+                self.replicas[rid]._on_join(conn_id, msg)
+                return
             # Thinnest live slice takes the new miner.
             rid = min(self.live,
                       key=lambda r: len(self.replicas[r].miners))

@@ -139,6 +139,9 @@ STAT_COUNTERS = (
     "claims_checked", "claims_failed", "audits_issued",
     "audits_passed", "audits_failed", "audits_inconclusive",
     "trust_decays_claim", "trust_decays_audit",
+    # Federation (ISSUE 20): repeat-JOIN rate-hint refreshes absorbed
+    # in place instead of minting duplicate roster entries.
+    "rate_hints_refreshed",
 )
 
 
@@ -325,6 +328,13 @@ class Scheduler:
         self._recv_batch = max(1, recv_batch if recv_batch is not None
                                else _int_env("DBM_RECV_BATCH", 64))
         self._read_nowait = getattr(server, "read_nowait", None)
+        # Federation (ISSUE 20, DBM_GATEWAY default 1): with the knob on,
+        # a repeat JOIN from a conn already registered as a live miner
+        # refreshes its rate hint in place (the GatewayMiner pool-sum
+        # path). 0 = bit-for-bit stock: every JOIN mints a fresh miner
+        # (the knob-off matrix leg pin). Read once at construction like
+        # the recv-batch knob so a live scheduler's behavior is stable.
+        self._gateway = _int_env("DBM_GATEWAY", 1) != 0
         # In-flight requests by job_id, oldest first (dict preserves
         # insertion order). The stock FIFO path keeps AT MOST ONE entry
         # — the reference's one-request-in-flight invariant — while the
@@ -925,10 +935,30 @@ class Scheduler:
     def _on_join(self, conn_id: int, msg: Optional[Message] = None) -> None:
         """``msg`` carries the optional Rate hint (ISSUE 14); callers on
         the pre-split surface (tests, embedded drivers) may omit it —
-        a hint-less join is the stock path bit-for-bit."""
+        a hint-less join is the stock path bit-for-bit.
+
+        Repeat JOIN from a conn already registered as a live miner
+        (ISSUE 20, ``DBM_GATEWAY``): the GatewayMiner's rate-hint
+        refresh — the hint updates the existing roster entry in place
+        via :meth:`MinerPlane.refresh_rate_hint` instead of minting a
+        duplicate MinerState whose phantom capacity the stripe planner
+        would plan against forever."""
         if self._owner is not None:
             self._owner.assert_here()
         rate_hint = float(msg.rate) if msg is not None else 0.0
+        if self._gateway:
+            miner = self.miner_plane.find_miner(conn_id)
+            if miner is not None:
+                self.miner_plane.refresh_rate_hint(miner, rate_hint)
+                if rate_hint > 0:
+                    # Refreshes recur every hint interval for the life
+                    # of a gateway conn — debug, not INFO, or a quiet
+                    # federated cluster logs nothing but hints.
+                    logger.debug(
+                        "miner %d refreshed rate hint %.3g nonces/s",
+                        conn_id, rate_hint)
+                self._maybe_dispatch()
+                return
         self.miner_plane.on_join(conn_id, rate_hint=rate_hint)
         if rate_hint > 0:
             logger.info("miner %d joined with rate hint %.3g nonces/s",

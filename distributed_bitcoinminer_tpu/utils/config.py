@@ -492,7 +492,7 @@ Environment variables:
   0 = bit-for-bit stock: Results are believed verbatim (pinned in the
   knob-off matrix leg). Cost is microseconds per WINNER, not per
   nonce — bench-geometry throughput is unaffected within noise.
-- ``DBM_AUDIT_P`` (default 0, clamped to [0, 1]): probabilistic
+- ``DBM_AUDIT_P`` (default 0.02, clamped to [0, 1]): probabilistic
   audit rate. With probability p per completed (merged) chunk, a
   random subwindow of it is re-granted to a DISJOINT miner and the
   sub-argmin cross-checked against the original claim over that
@@ -500,6 +500,15 @@ Environment variables:
   original never scanned it (the "sentinel-without-scan" lazy-miner
   class that claim checks cannot see) and fires ``audit_failed``.
   0 disables audits entirely (no RNG draw, no bookkeeping).
+  ISSUE 16 shipped the knob at 0 pending soak; ISSUE 20 flips the
+  ENV default to 0.02 (~1 audit per 50 merged chunks — sub-percent
+  grant overhead at the 2^16 subwindow cap) now the byzantine
+  dbmcheck family and the tier-1 byzantine leg have soaked clean.
+  Only the env path flips: the ``VerifyParams`` dataclass field
+  stays 0.0, so programmatic constructions (dbmcheck scenarios,
+  bench probes, fake-miner rigs whose fabricated hashes an audit
+  would convict) remain audit-free and deterministic unless they
+  opt in; the knob-off matrix leg pins 0 explicitly.
 - ``DBM_AUDIT_MAX`` (default 65536, floor 1): audit subwindow size
   cap in nonces — audits must stay launch-overhead-scale, never a
   second full scan.
@@ -622,6 +631,40 @@ Environment variables:
   pallas-interpret counter parity). PAIRS is the number of
   order-swapped on/off span pairs per timing leg; paired timing holds
   the CPU drift envelope to a few percent where blocked legs wander.
+- ``DBM_GATEWAY`` (default 1): scheduler federation (apps/gateway.py,
+  ISSUE 20). 1 = a repeat JOIN from a conn the scheduler already
+  knows as a live miner REFRESHES that miner's rate hint in place
+  (the GatewayMiner's pool-sum refresh path over the existing
+  ``Rate`` wire extension) and ``ReplicaSet`` routes it to the
+  existing owner replica. 0 = bit-for-bit stock flat topology: a
+  repeat JOIN registers a fresh miner exactly as before (pinned in
+  the knob-off matrix leg) and ``gateway serve`` refuses to start.
+- ``DBM_GATEWAY_HINT_S`` (default 2.0, floor 0.05): period of the
+  gateway's rate-hint refresher — every tick it sums the rate EWMAs
+  of its non-quarantined inner pool and, when the aggregate moved
+  >= ~10% (or the pool emptied/filled), re-sends the JOIN with the
+  new hint so the parent's stripe planner tracks the pool.
+- ``DBM_GATEWAY_MIN_POOL`` (default 1): inner miners that must have
+  JOINed the gateway's inner tier before it announces itself to the
+  parent — a gateway with nothing downstream must not accept grants
+  it can only let expire.
+- ``DBM_GATEWAY_ORPHAN_S`` (default 10.0, floor 0.1): orphan
+  watchdog — when the inner pool stays EMPTY this long while parent
+  work is pending, the gateway closes its parent conn so the stock
+  lease/drop/re-issue plane re-grants its chunks to siblings (a
+  fenced child cluster = one blown lease at the parent).
+- ``DBM_TIER1_FED`` (0 disables): scripts/tier1.sh's federation leg —
+  dbmcheck's ``federation`` scenario (two-level topology, gateway
+  rate-hint refresh, mid-schedule child-cluster failover) under the
+  exactly-once oracle-exact invariant pack with the same >=500
+  distinct-schedule floor as the other dbmcheck legs.
+- ``DBM_BENCH_FEDERATION`` (0 disables) /
+  ``DBM_BENCH_FEDERATION_ROUNDS`` (default 2): the bench's
+  ``detail.federation`` probe — federated (gateways re-sharding to
+  children) vs flat (same miners JOINed directly) makespan at equal
+  pool size (``overhead_ratio``), plus a >=10x child-pool-skew leg
+  recording per-gateway grant share against rate share
+  (``tracking_error``).
 """
 
 from __future__ import annotations
@@ -986,6 +1029,29 @@ class VerifyParams:
 
 
 @dataclass(frozen=True)
+class GatewayParams:
+    """Scheduler-federation knobs (ISSUE 20; apps/gateway.py GatewayMiner
+    + the repeat-JOIN rate-hint refresh in apps/scheduler.py /
+    apps/replicas.py).
+
+    A GatewayMiner JOINs a parent scheduler as ONE miner whose rate hint
+    is the summed rate EWMAs of its downstream pool and re-shards each
+    granted chunk through a stock inner scheduler — zero wire change.
+    ``hint_s`` paces the pool-sum refresh (re-sent as a repeat JOIN over
+    the existing ``Rate`` extension); ``min_pool`` delays the parent
+    JOIN until that many inner miners exist; ``orphan_s`` bounds how
+    long an EMPTY inner pool may sit on granted work before the gateway
+    drops its parent conn and lets the stock re-issue plane recover.
+    ``enabled=False`` (``DBM_GATEWAY=0``) is bit-for-bit stock flat
+    topology: repeat JOINs register fresh miners exactly as before.
+    """
+    enabled: bool = True
+    hint_s: float = 2.0
+    min_pool: int = 1
+    orphan_s: float = 10.0
+
+
+@dataclass(frozen=True)
 class RetryParams:
     """Client submit-with-retry knobs (apps/client.py submit_with_retry).
 
@@ -1125,9 +1191,15 @@ def adapt_from_env() -> AdaptParams:
 
 def verify_from_env() -> VerifyParams:
     d = VerifyParams()
+    # The ENV default for audits is 0.02 (ISSUE 20 flip after the ISSUE
+    # 16 soak) while the dataclass field stays 0.0: env-configured
+    # deployments get the lazy-miner defense by default, but programmatic
+    # ``VerifyParams()`` constructions — dbmcheck scenarios, bench
+    # probes, fake-miner rigs whose fabricated hashes any audit would
+    # convict — stay audit-free and deterministic unless they opt in.
     return VerifyParams(
         enabled=_int_env("DBM_VERIFY", 1) != 0,
-        audit_p=min(1.0, max(0.0, _float_env("DBM_AUDIT_P", d.audit_p))),
+        audit_p=min(1.0, max(0.0, _float_env("DBM_AUDIT_P", 0.02))),
         audit_max_nonces=max(1, _int_env("DBM_AUDIT_MAX",
                                          d.audit_max_nonces)),
         trust_decay=min(0.99, max(0.01, _float_env("DBM_TRUST_DECAY",
@@ -1138,6 +1210,16 @@ def verify_from_env() -> VerifyParams:
                                                  d.trust_floor))),
         trust_bar=min(1.0, max(0.0, _float_env("DBM_TRUST_BAR",
                                                d.trust_bar))),
+    )
+
+
+def gateway_from_env() -> GatewayParams:
+    d = GatewayParams()
+    return GatewayParams(
+        enabled=_int_env("DBM_GATEWAY", 1) != 0,
+        hint_s=max(0.05, _float_env("DBM_GATEWAY_HINT_S", d.hint_s)),
+        min_pool=max(1, _int_env("DBM_GATEWAY_MIN_POOL", d.min_pool)),
+        orphan_s=max(0.1, _float_env("DBM_GATEWAY_ORPHAN_S", d.orphan_s)),
     )
 
 

@@ -55,6 +55,10 @@ _LOWER = (
     "queue_wait_s", "shed_delta", "ttfh_s", "until_ttfh_s",
     "launches_per_span", "dispatches_per_span",
     "host_transfers_per_span", "host_bytes_per_span",
+    # detail.federation (ISSUE 20): the federation tax and the
+    # whole-cluster placement error both shrink when healthy.
+    "overhead_ratio", "tracking_error",
+    "flat_makespan_s", "federated_makespan_s",
 )
 #: Path segments that are configuration/noise, never metrics: the walk
 #: prunes the whole subtree.

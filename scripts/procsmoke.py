@@ -3,20 +3,27 @@
 skips it in scripts/tier1.sh).
 
 Spawns the REAL process topology on localhost — router + 2 replica
-processes (each with its own LSP socket) + 1 miner agent — drives one
-replica-aware client through ``ring:<statedir>``, then ``kill -9``\\ s
-the replica that owns the in-flight request and asserts the reply still
-arrives EXACTLY ONCE and ORACLE-EXACT, with failover driven solely by
-the router's missed-beat detection (no test-hook kill path exists in
-this topology). Exit 0 on success, 1 on any violation.
+processes (each with its own LSP socket) + 1 miner agent + 1 gateway
+agent (a whole federated child cluster in one process, ISSUE 20) —
+drives one replica-aware client through ``ring:<statedir>``, then
+``kill -9``\\ s the replica that owns the in-flight request and asserts
+the reply still arrives EXACTLY ONCE and ORACLE-EXACT, with failover
+driven solely by the router's missed-beat detection (no test-hook kill
+path exists in this topology). Exit 0 on success, 1 on any violation.
 
 ISSUE 18 addition: the observability plane rides the same topology, so
 this leg also asserts ``dbmtop --once --json`` sees EVERY live process
-(router + both replicas + the miner agent) with a fresh rollup snapshot
-within one beat interval, and — after the kill — that the dead
-replica's snapshot reads fenced/stale instead of folding into cluster
-totals. Skipped when DBM_ROLLUP=0 in the ambient env (the knob-off
-matrix shape).
+(router + both replicas + the miner agent + the gateway agent) with a
+fresh rollup snapshot within one beat interval, and — after the kill —
+that the dead replica's snapshot reads fenced/stale instead of folding
+into cluster totals. Skipped when DBM_ROLLUP=0 in the ambient env (the
+knob-off matrix shape).
+
+ISSUE 20 addition: the membership wait requires TWO joined miners —
+the flat miner agent plus the gateway's JOIN — so the smoke proves the
+federated tier actually registered with the ring (not merely that its
+process breathes), and the post-kill recovery runs with a gateway in
+the pool eligible for re-granted chunks.
 """
 
 from __future__ import annotations
@@ -66,7 +73,7 @@ async def _assert_all_fresh(statedir: str, beat_s: float) -> int:
                  and p["age_s"] <= beat_s * 2.0]
         roles = sorted(p["role"] for p in fresh)
         if roles.count("replica") >= 2 and "router" in roles \
-                and "miner" in roles:
+                and "miner" in roles and "gateway" in roles:
             print(f"PROCSMOKE: dbmtop sees {len(fresh)} fresh procs "
                   f"({'/'.join(roles)}) within a beat", flush=True)
             return 0
@@ -91,10 +98,13 @@ async def smoke() -> int:
            "DBM_COMPUTE": "host"}
     params = Params(epoch_limit=4, epoch_millis=200, window_size=8,
                     max_backoff_interval=2)
-    cluster = ProcCluster(statedir, replicas=2, miners=1, env=env)
+    cluster = ProcCluster(statedir, replicas=2, miners=1, gateways=1,
+                          env=env)
     cluster.start()
     try:
-        await cluster.wait_live(2, timeout_s=30.0, miners=1)
+        # miners=2: the flat miner agent AND the gateway's federation
+        # JOIN must both be in the advertised ring (ISSUE 20).
+        await cluster.wait_live(2, timeout_s=30.0, miners=2)
         # Warm sanity: one small request end to end.
         retry = RetryParams(attempts=12, timeout_s=3.0, backoff_s=0.2,
                             backoff_cap_s=1.0)

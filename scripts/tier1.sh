@@ -209,6 +209,28 @@ if [ "${DBM_TIER1_BYZ:-1}" != "0" ]; then
     echo "BYZ_LEG_RC=$byz_rc"
 fi
 
+# Federation leg (ISSUE 20): dbmcheck's federation scenario alone — a
+# parent scheduler with two whole child clusters JOINed through
+# GatewayMiners (pool-summed rate hints over the Rate extension, grant
+# translation, in-order upward forwarding, hint refresh, mid-schedule
+# child-cluster failover) under the full exactly-once oracle-exact
+# invariant pack, with the same >=500 distinct-schedule floor as the
+# other dbmcheck legs. No JAX import. DBM_TIER1_FED=0 skips.
+fed_rc=0
+if [ "${DBM_TIER1_FED:-1}" != "0" ]; then
+    rm -f /tmp/_t1_fed.log
+    timeout -k 5 150 python scripts/dbmcheck.py \
+        --scenario federation --seeds 700 2>&1 | tee /tmp/_t1_fed.log
+    fed_rc=${PIPESTATUS[0]}
+    fdistinct=$(grep -a '^DBMCHECK_DISTINCT=' /tmp/_t1_fed.log | tail -1 | cut -d= -f2)
+    if [ "$fed_rc" -eq 0 ] && [ "${fdistinct:-0}" -lt 500 ]; then
+        echo "FED_FLOOR: only ${fdistinct:-0} distinct schedules" \
+             "explored (< 500) — treating as failure"
+        fed_rc=3
+    fi
+    echo "FED_LEG_RC=$fed_rc"
+fi
+
 # Multi-process smoke leg (ISSUE 12): the REAL process topology on
 # localhost — router + 2 replica processes on their own LSP sockets +
 # 1 miner agent — with a kill -9 of the replica owning an in-flight
@@ -310,13 +332,19 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
     # chain (one launch + one fetched triple per sub — the bit-for-bit
     # pre-devloop dispatch shape test_devloop.py's parity pins assert)
     # with test_devloop.py in the module list.
+    # ISSUE 20 additions: DBM_GATEWAY=0 pins the flat single-tier
+    # topology (a repeat JOIN registers a fresh roster entry instead of
+    # refreshing in place — the stock shape test_federation.py's
+    # knob-off tests assert) and DBM_AUDIT_P=0 pins the audit-free
+    # verify tier (the pre-flip env default), with
+    # tests/test_federation.py in the module list.
     timeout -k 10 "$matrix_budget" env JAX_PLATFORMS=cpu \
         DBM_PIPELINE=0 DBM_STRIPE=0 \
         DBM_QOS=0 DBM_COALESCE=0 DBM_TRACE=0 DBM_SANITIZE=1 \
         DBM_RECV_BATCH=1 DBM_TIMER_WHEEL=0 DBM_TRACE_SAMPLE=1.0 \
         DBM_REPLICAS=1 DBM_QOS_LAZY=0 DBM_ADAPT=0 DBM_MESH=0 \
         DBM_CAPTURE=0 DBM_VERIFY=0 DBM_MMSG=0 DBM_WIRE_FAST=0 \
-        DBM_ROLLUP=0 DBM_DEVLOOP=0 \
+        DBM_ROLLUP=0 DBM_DEVLOOP=0 DBM_GATEWAY=0 DBM_AUDIT_P=0 \
         python -m pytest -q -m 'not slow' \
         tests/test_scheduler_recovery.py tests/test_chaos.py \
         tests/test_conformance.py tests/test_go_replay.py \
@@ -325,6 +353,7 @@ if [ "$rc" -eq 0 ] && [ "${DBM_TIER1_MATRIX:-1}" != "0" ]; then
         tests/test_adapt.py tests/test_capture.py tests/test_verify.py \
         tests/test_wire.py tests/test_transport_fast.py \
         tests/test_rollup.py tests/test_devloop.py \
+        tests/test_federation.py \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1_matrix.log
     mrc=${PIPESTATUS[0]}
@@ -338,6 +367,7 @@ fi
 [ "$replay_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$replay_rc
 [ "$mesh_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$mesh_rc
 [ "$byz_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$byz_rc
+[ "$fed_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$fed_rc
 [ "$procs_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$procs_rc
 [ "$transport_rc" -ne 0 ] && [ "$rc" -eq 0 ] && rc=$transport_rc
 exit $rc
